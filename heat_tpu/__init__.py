@@ -1,0 +1,21 @@
+"""heat_tpu — a TPU-native heat-equation framework.
+
+A from-scratch JAX/XLA/Pallas/shard_map rebuild of the capability set of
+``cssrikanth/CUDA-HIP-MPI-Heat-equation-test``: the 2D (and 3D) explicit
+FTCS diffusion stencil, driven by the same ``input.dat`` contract, with the
+reference's seven programming-model variants re-imagined as four pluggable
+backends over one core:
+
+- ``serial``  numpy oracle
+- ``xla``     jit + fused slice stencil (compiler-generated kernel)
+- ``pallas``  hand-written TPU kernel
+- ``sharded`` shard_map + ppermute halo exchange over a device mesh
+
+See SURVEY.md at the repo root for the reference analysis this build follows.
+"""
+
+from .config import HeatConfig, parse_input, variant_config, VARIANTS  # noqa: F401
+from .grid import coords, initial_condition  # noqa: F401
+from .backends import solve, SolveResult  # noqa: F401
+
+__version__ = "0.1.0"

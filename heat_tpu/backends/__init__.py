@@ -1,0 +1,55 @@
+"""Backend registry.
+
+The reference implements each programming model as a standalone program
+(duplication *is* its architecture, SURVEY.md §2); here the variants are
+pluggable backends behind one registry, keyed by names mirroring the
+reference taxonomy:
+
+- ``serial``  : numpy oracle            (== fortran/serial, python/serial)
+- ``xla``     : jnp + jit, one device   (== cuda_cuf: compiler-generated kernel)
+- ``pallas``  : hand-written TPU kernel (== cuda_kernel, hip heat_kernel.cpp)
+- ``sharded`` : shard_map + ppermute halo exchange over a device mesh
+                (== mpi+cuda / hip MPI layer)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..config import HeatConfig
+from ..runtime.timing import Timing
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+@dataclasses.dataclass
+class SolveResult:
+    cfg: HeatConfig
+    T: np.ndarray            # final field on host
+    timing: Timing
+    gsum: Optional[float] = None   # global temperature sum if report_sum
+    start_step: int = 0            # nonzero when resumed from checkpoint
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get_backend(name: str) -> Callable:
+    # import lazily so e.g. the numpy oracle works without a functioning JAX
+    from . import serial_np, xla, pallas, sharded  # noqa: F401
+
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown backend {name!r}; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, **kw) -> SolveResult:
+    """Run the configured backend end to end."""
+    return get_backend(cfg.backend)(cfg, T0=T0, **kw)

@@ -1,0 +1,81 @@
+"""Serial numpy backend — the correctness oracle.
+
+A faithful, dependency-light reimplementation of the reference's serial
+solvers (``fortran/serial/heat.f90:61-69``, ``python/serial/heat.py:48-58``):
+host-only, per-step full-array snapshot, vectorized slice stencil. Every
+other backend is tested against this one (the test pyramid the reference
+lacks, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..config import HeatConfig
+from ..grid import initial_condition, np_dtype
+from ..runtime import checkpoint
+from ..runtime.logging import master_print
+from ..runtime.timing import Timing
+from . import SolveResult, register
+
+
+def _lap_interior(T: np.ndarray) -> np.ndarray:
+    nd = T.ndim
+    ctr = tuple(slice(1, -1) for _ in range(nd))
+    acc = (-2.0 * nd) * T[ctr]
+    for d in range(nd):
+        up = list(ctr)
+        dn = list(ctr)
+        up[d] = slice(2, None)
+        dn[d] = slice(0, -2)
+        acc = acc + T[tuple(up)] + T[tuple(dn)]
+    return acc
+
+
+def step_edges_np(T: np.ndarray, r: float) -> np.ndarray:
+    """Frozen-boundary step (serial loop bounds 2..n-1, heat.f90:64-68)."""
+    ctr = tuple(slice(1, -1) for _ in range(T.ndim))
+    out = T.copy()
+    out[ctr] = T[ctr] + r * _lap_interior(T)
+    return out
+
+
+def step_ghost_np(T: np.ndarray, r: float, bc_value: float) -> np.ndarray:
+    """Dirichlet-by-ghost step: all cells update against a bc_value ring
+    (the undecomposed equivalent of fortran/mpi+cuda/heat.F90:206-219)."""
+    padded = np.pad(T, 1, mode="constant", constant_values=bc_value)
+    return T + r * _lap_interior(padded)
+
+
+@register("serial")
+def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, **_) -> SolveResult:
+    t_all0 = time.perf_counter()
+    dt = np_dtype(cfg.dtype)
+    start_step = 0
+    if T0 is None and cfg.checkpoint_every:
+        ck = checkpoint.latest(cfg)
+        if ck is not None:
+            T0, start_step = checkpoint.load(ck, cfg)
+            master_print(f"resumed from {ck} at step {start_step}")
+    T = np.array(T0, dtype=dt) if T0 is not None else initial_condition(cfg)
+    r = dt(cfg.r)
+
+    t0 = time.perf_counter()
+    for i in range(start_step + 1, cfg.ntime + 1):
+        if cfg.heartbeat_every and (i % cfg.heartbeat_every == 0 or i == 1):
+            master_print(" time_it:", i)  # fortran/serial/heat.f90:62
+        if cfg.bc == "edges":
+            T = step_edges_np(T, r)
+        else:
+            T = step_ghost_np(T, r, dt(cfg.bc_value))
+        if cfg.checkpoint_every and i % cfg.checkpoint_every == 0:
+            checkpoint.save(cfg, T, i)
+    solve_s = time.perf_counter() - t0
+
+    gsum = float(T.sum(dtype=np.float64)) if cfg.report_sum else None
+    timing = Timing(total_s=time.perf_counter() - t_all0, solve_s=solve_s,
+                    steps=cfg.ntime - start_step, points=cfg.points)
+    return SolveResult(cfg=cfg, T=T, timing=timing, gsum=gsum, start_step=start_step)

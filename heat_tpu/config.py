@@ -1,0 +1,185 @@
+"""Configuration: ``input.dat`` parsing and run options.
+
+The reference drives every variant from a positional whitespace text file
+``input.dat`` holding ``n sigma nu dom_len ntime`` (serial form, see
+``fortran/serial/heat.f90:13``) with a sixth ``soln`` dump flag in the MPI
+variants (``fortran/mpi+cuda/heat.F90:83``). Single-process variants silently
+ignore a trailing sixth field, so one file drives every backend — this parser
+preserves that contract (both arities accepted everywhere).
+
+What the reference expresses as *compile-time* flags (``-DUSE_CUDA``,
+``-DNO_AWARE`` in ``fortran/mpi+cuda/makefile:1-6``; ``SINGLE_PRECISION`` in
+``fortran/hip/heat_kernel.cpp:5-9``) become *runtime* fields here: ``comm``
+(direct vs host-staged halo exchange), ``dtype``, ``backend``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from pathlib import Path
+from typing import Optional, Tuple
+
+_DTYPES = ("float64", "float32", "bfloat16")
+_BACKENDS = ("serial", "xla", "pallas", "sharded")
+_BCS = ("edges", "ghost")
+_ICS = ("hat", "hat_half", "hat_small", "uniform", "zero")
+_COMMS = ("direct", "staged")
+
+
+@dataclasses.dataclass(frozen=True)
+class HeatConfig:
+    """Full run configuration.
+
+    The first six fields mirror ``input.dat`` exactly; the rest are framework
+    options (runtime analogs of the reference's build-time variant choices).
+    """
+
+    # --- input.dat fields (fortran/serial/heat.f90:13, mpi+cuda/heat.F90:83)
+    n: int = 256                # grid points per side
+    sigma: float = 0.25         # CFL number
+    nu: float = 0.05            # diffusivity
+    dom_len: float = 2.0        # domain length
+    ntime: int = 30             # number of timesteps
+    soln: bool = False          # dump solution files at the end
+
+    # --- framework options
+    ndim: int = 2               # 2 -> 5-point stencil, 3 -> 7-point
+    dtype: str = "float32"      # float64 parity / float32 / bfloat16(+f32 acc)
+    backend: str = "xla"
+    ic: str = "hat"             # initial condition preset (see grid.py)
+    bc: str = "edges"           # "edges": frozen boundary cells (serial semantics)
+                                # "ghost": Dirichlet-by-ghost ring (MPI semantics)
+    bc_value: float = 1.0       # boundary temperature
+    comm: str = "direct"        # halo exchange: direct ICI ppermute vs host-staged
+    mesh_shape: Optional[Tuple[int, ...]] = None  # device mesh; None = auto
+    heartbeat_every: int = 0    # print "time_it: i" every k steps (0 = off)
+    report_sum: bool = False    # global temperature sum (the reference's
+                                # commented-out MPI_Reduce, mpi+cuda/heat.F90:266-273)
+    checkpoint_every: int = 0   # periodic snapshot interval (0 = off)
+    checkpoint_dir: str = "checkpoints"
+    parity_order: bool = False  # reference's update-then-swap step ordering
+                                # (mpi+cuda/heat.F90:209-218); equivalent for
+                                # shipped ICs, kept for bit-parity experiments
+
+    def __post_init__(self):
+        if self.n < 3:
+            raise ValueError(f"grid size n must be >= 3, got {self.n}")
+        if self.ntime < 0:
+            raise ValueError(f"ntime must be >= 0, got {self.ntime}")
+        if self.ndim not in (2, 3):
+            raise ValueError(f"ndim must be 2 or 3, got {self.ndim}")
+        if self.dtype not in _DTYPES:
+            raise ValueError(f"dtype must be one of {_DTYPES}, got {self.dtype!r}")
+        if self.backend not in _BACKENDS:
+            raise ValueError(f"backend must be one of {_BACKENDS}, got {self.backend!r}")
+        if self.bc not in _BCS:
+            raise ValueError(f"bc must be one of {_BCS}, got {self.bc!r}")
+        if self.ic not in _ICS:
+            raise ValueError(f"ic must be one of {_ICS}, got {self.ic!r}")
+        if self.comm not in _COMMS:
+            raise ValueError(f"comm must be one of {_COMMS}, got {self.comm!r}")
+        # FTCS stability wants sigma <= 1/(2*ndim); allow mildly unstable
+        # experiments but reject nonsense outright, in every dimension.
+        if self.sigma <= 0 or self.sigma > 10:
+            raise ValueError(f"sigma out of range: {self.sigma}")
+
+    # --- derived quantities (fortran/serial/heat.f90:15-17,59) -------------
+    @property
+    def delta(self) -> float:
+        """Grid spacing: dom_len / (n - 1)."""
+        return self.dom_len / (self.n - 1)
+
+    @property
+    def dt(self) -> float:
+        """Timestep from the CFL condition: sigma * delta^2 / nu."""
+        return (self.sigma * self.delta**2) / self.nu
+
+    @property
+    def r(self) -> float:
+        """Stencil coefficient nu*dt/delta^2.
+
+        Algebraically identical to ``sigma`` (the dt substitution cancels);
+        the reference still derives it through dt (fortran/serial/heat.f90:59)
+        and so do we, keeping the full chain for config parity.
+        """
+        return (self.nu * self.dt) / self.delta**2
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.n,) * self.ndim
+
+    @property
+    def points(self) -> int:
+        return self.n**self.ndim
+
+    def with_(self, **kw) -> "HeatConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def parse_input(path: str | Path) -> HeatConfig:
+    """Parse an ``input.dat`` file (5- or 6-field form).
+
+    Field order: ``n sigma nu dom_len ntime [soln]`` — README.md:7 and
+    ``fortran/mpi+cuda/heat.F90:81-85``. Tokens may span multiple lines
+    (Fortran list-directed reads don't care); extra trailing tokens beyond
+    six are ignored, like the serial variant ignores the ``soln`` flag.
+    """
+    text = Path(path).read_text()
+    toks = re.split(r"\s+", text.strip())
+    if len(toks) < 5:
+        raise ValueError(
+            f"{path}: expected at least 5 fields 'n sigma nu dom_len ntime', got {toks}"
+        )
+    n = int(toks[0])
+    sigma = float(toks[1])
+    nu = float(toks[2])
+    dom_len = float(toks[3])
+    ntime = int(toks[4])
+    soln = bool(int(toks[5])) if len(toks) >= 6 else False
+    return HeatConfig(n=n, sigma=sigma, nu=nu, dom_len=dom_len, ntime=ntime, soln=soln)
+
+
+def write_input(cfg: HeatConfig, path: str | Path) -> None:
+    """Write the 6-field ``input.dat`` form (readable by every variant)."""
+    # repr keeps full precision: a write/parse round-trip must not perturb
+    # the physics (dt, r, checkpoint fingerprints).
+    Path(path).write_text(
+        f"{cfg.n} {cfg.sigma!r} {cfg.nu!r} {cfg.dom_len!r} {cfg.ntime} {int(cfg.soln)}\n"
+    )
+
+
+# Named presets reproducing each reference variant's semantics, so a user of
+# the reference can select their variant by name (see SURVEY.md quirk #1: the
+# IC/BC families differ silently between variants).
+VARIANTS = {
+    # fortran/serial/heat.f90: hat IC on [0.5,1.5]^2, frozen boundary cells
+    "serial": dict(ic="hat", bc="edges", backend="serial", dtype="float64"),
+    # fortran/cuda_kernel/heat.F90:99: hat with y in [0.5,1.0]
+    "cuda_kernel": dict(ic="hat_half", bc="edges", backend="pallas", dtype="float64"),
+    "cuda_managed": dict(ic="hat_half", bc="edges", backend="pallas", dtype="float64"),
+    # fortran/cuda_cuf/heat.F90:86: same IC family, compiler-generated kernels
+    "cuda_cuf": dict(ic="hat_half", bc="edges", backend="xla", dtype="float64"),
+    # fortran/mpi+cuda/heat.F90:243-251: uniform 2.0, Dirichlet-by-ghost walls
+    "mpi_cuda": dict(ic="uniform", bc="ghost", backend="sharded", comm="direct",
+                     dtype="float64"),
+    # same but the staged (NO_AWARE) communication path, makefile:3-4
+    "mpi_cuda_na": dict(ic="uniform", bc="ghost", backend="sharded", comm="staged",
+                        dtype="float64"),
+    # fortran/hip/heat.F90: always-staged swap
+    "hip": dict(ic="uniform", bc="ghost", backend="sharded", comm="staged",
+                dtype="float64"),
+    # python/serial/heat.py: hat on [0.5,1.0]^2 w/ per-step edge reassert == edges BC
+    "python_serial": dict(ic="hat_small", bc="edges", backend="serial", dtype="float64"),
+    # python/cuda/cuda.py: throughput benchmark (IC no-op bug not replicated;
+    # uniform field benchmarks identically)
+    "python_cuda": dict(ic="uniform", bc="edges", backend="pallas", dtype="float32"),
+}
+
+
+def variant_config(name: str, base: Optional[HeatConfig] = None) -> HeatConfig:
+    if name not in VARIANTS:
+        raise KeyError(f"unknown variant {name!r}; choose from {sorted(VARIANTS)}")
+    base = base or HeatConfig()
+    return base.with_(**VARIANTS[name])

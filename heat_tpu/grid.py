@@ -1,0 +1,100 @@
+"""Coordinates, initial conditions, and boundary conditions.
+
+The reference builds coordinates by cumulative addition from 0 with the last
+row pre-pinned to ``dom_len`` (``fortran/serial/heat.f90:28-36``); that is
+``linspace(0, dom_len, n)`` up to rounding, which is what we use. Each
+reference variant silently ships a *different* hat initial condition
+(SURVEY.md quirk #1); they are named presets here:
+
+- ``hat``       : T=2 on [0.5,1.5]x[0.5,1.5], else 1   (fortran/serial/heat.f90:40-48)
+- ``hat_half``  : T=2 on [0.5,1.5]x[0.5,1.0], else 1   (fortran/cuda_kernel/heat.F90:98)
+- ``hat_small`` : T=2 on [0.5,1.0]x[0.5,1.0], else 1   (python/serial/heat.py:25)
+- ``uniform``   : T=2 everywhere — pairs with the "ghost" BC for the MPI
+                  variants' uniform-hot/cold-walls setup (fortran/mpi+cuda/heat.F90:243-251)
+- ``zero``      : T=0 (testing)
+
+All constructors are pure numpy: initial conditions are built once on host
+and shipped to device by the backend, mirroring the reference's host-side IC
+plus one H2D copy (``fortran/mpi+cuda/heat.F90:256``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from .config import HeatConfig
+
+_NP_DTYPES = {"float64": np.float64, "float32": np.float32, "bfloat16": np.float32}
+
+
+def np_dtype(name: str):
+    """Host-side dtype; bfloat16 ICs are built in f32 and cast on device."""
+    return _NP_DTYPES[name]
+
+
+def coords_1d(n: int, dom_len: float, dtype=np.float64) -> np.ndarray:
+    """1-D coordinate axis, 0 .. dom_len inclusive (delta = dom_len/(n-1))."""
+    return np.linspace(0.0, dom_len, n, dtype=dtype)
+
+
+def coords(cfg: HeatConfig) -> Tuple[np.ndarray, ...]:
+    """ndim coordinate axes (all identical: square/cubic domain)."""
+    ax = coords_1d(cfg.n, cfg.dom_len, np_dtype(cfg.dtype))
+    return (ax,) * cfg.ndim
+
+
+# (x-interval, y-interval, z-interval) of the hot region per preset; z reuses
+# the y interval in 3D runs of the half/small presets.
+_HAT_BOXES = {
+    "hat": ((0.5, 1.5), (0.5, 1.5), (0.5, 1.5)),
+    "hat_half": ((0.5, 1.5), (0.5, 1.0), (0.5, 1.0)),
+    "hat_small": ((0.5, 1.0), (0.5, 1.0), (0.5, 1.0)),
+}
+
+
+def initial_condition(cfg: HeatConfig) -> np.ndarray:
+    """Build the full initial field (including boundary/ghost-adjacent cells).
+
+    For the "ghost" BC the returned array is the *owned* field only; the
+    ghost ring (fixed at ``bc_value``) is conceptual and supplied by the halo
+    exchange / boundary fill each step, matching the reference where ghosts
+    are initialized once at 1.0 and global-edge ghosts never change
+    (fortran/mpi+cuda/heat.F90:243-251).
+    """
+    dt = np_dtype(cfg.dtype)
+    shape = cfg.shape
+    if cfg.ic == "uniform":
+        return np.full(shape, 2.0, dtype=dt)
+    if cfg.ic == "zero":
+        return np.zeros(shape, dtype=dt)
+    box = _HAT_BOXES[cfg.ic]
+    ax = coords_1d(cfg.n, cfg.dom_len, dt)
+    field = np.ones(shape, dtype=dt)
+    masks = []
+    for d in range(cfg.ndim):
+        lo, hi = box[d]
+        m1 = (ax >= lo) & (ax <= hi)
+        sh = [1] * cfg.ndim
+        sh[d] = cfg.n
+        masks.append(m1.reshape(sh))
+    hot = masks[0]
+    for m in masks[1:]:
+        hot = hot & m
+    field[np.broadcast_to(hot, shape)] = 2.0
+    return field
+
+
+def boundary_mask(cfg: HeatConfig) -> np.ndarray:
+    """Boolean mask of the outermost cell ring (the frozen cells in "edges" BC,
+    i.e. the cells the serial loop never touches, fortran/serial/heat.f90:64-68)."""
+    mask = np.zeros(cfg.shape, dtype=bool)
+    for d in range(cfg.ndim):
+        sl0 = [slice(None)] * cfg.ndim
+        sl1 = [slice(None)] * cfg.ndim
+        sl0[d] = 0
+        sl1[d] = -1
+        mask[tuple(sl0)] = True
+        mask[tuple(sl1)] = True
+    return mask

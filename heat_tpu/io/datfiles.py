@@ -1,0 +1,98 @@
+"""Text dataset IO: the ``int.dat`` / ``soln.dat`` contract.
+
+File format (fortran/serial/heat.f90:50-55, 77-83): one whitespace-separated
+``x y T`` triplet per line (``x y z T`` quadruplet for the 3-D extension),
+row-major — outer loop over the x index, inner over y — n^2 lines total.
+The reference's viz scripts regex-split each line (fortran/serial/out.py:17-25),
+so any whitespace/precision works; we write %.17g for f64 round-tripping.
+
+MPI variants write one file per rank, ``soln#####.dat``, gated on the
+``soln`` input flag (fortran/mpi+cuda/heat.F90:277-288); the sharded analog
+here writes one file per *shard*, numbered by linear mesh index, so existing
+reference post-processing habits carry over.
+
+A C++ fast path (``native/fastio.cpp``, loaded via ctypes) accelerates the
+O(n^2)-line text dump; numpy is the always-available fallback.
+"""
+
+from __future__ import annotations
+
+import itertools
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .native import fast_write_triplets
+
+
+def _triplet_table(axes: Tuple[np.ndarray, ...], T: np.ndarray) -> np.ndarray:
+    """Flatten coords+field into an (N, ndim+1) float64 table in file order."""
+    grids = np.meshgrid(*axes, indexing="ij")
+    cols = [g.reshape(-1) for g in grids] + [np.asarray(T, np.float64).reshape(-1)]
+    return np.column_stack([np.asarray(c, np.float64) for c in cols])
+
+
+def write_dat(path, axes: Tuple[np.ndarray, ...], T: np.ndarray) -> None:
+    table = _triplet_table(axes, T)
+    if not fast_write_triplets(str(path), table):
+        with open(path, "w") as f:
+            np.savetxt(f, table, fmt="%.17g")
+
+
+def write_int_dat(path, axes, T0) -> None:
+    """Pre-solve dump (fortran/serial/heat.f90:50-55)."""
+    write_dat(path, axes, T0)
+
+
+def write_soln(path, axes, T) -> None:
+    """Post-solve dump (fortran/serial/heat.f90:77-83)."""
+    write_dat(path, axes, T)
+
+
+def write_soln_sharded(directory, axes, T_sharded, mesh, prefix: str = "soln") -> list:
+    """Per-shard solution files ``soln#####.dat``
+    (fortran/mpi+cuda/heat.F90:277-288). Each process writes only its
+    addressable shards; shard number = linear index of its mesh coordinates."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    mesh_shape = mesh.devices.shape
+    dev_to_coords = {}
+    for coords in itertools.product(*[range(s) for s in mesh_shape]):
+        dev_to_coords[mesh.devices[coords].id] = coords
+    written = []
+    for shard in T_sharded.addressable_shards:
+        coords = dev_to_coords[shard.device.id]
+        rank = int(np.ravel_multi_index(coords, mesh_shape))
+        local = np.asarray(shard.data)
+        local_axes = []
+        for d, ax in enumerate(axes):
+            npts = local.shape[d]
+            start = coords[d] * npts
+            local_axes.append(ax[start : start + npts])
+        path = directory / f"{prefix}{rank:05d}.dat"
+        write_dat(path, tuple(local_axes), local)
+        written.append(path)
+    return written
+
+
+def read_dat(path, ndim: int = 2):
+    """Read a .dat file back into (axes, T). Assumes the square row-major
+    layout the writers produce (matches fortran/serial/out.py:27-36)."""
+    table = np.loadtxt(path)
+    ncols = table.shape[1]
+    if ncols != ndim + 1:
+        raise ValueError(f"{path}: expected {ndim + 1} columns, got {ncols}")
+    npoints = table.shape[0]
+    n = round(npoints ** (1.0 / ndim))
+    if n**ndim != npoints:
+        raise ValueError(f"{path}: {npoints} lines is not a perfect {ndim}-cube")
+    shape = (n,) * ndim
+    T = table[:, -1].reshape(shape)
+    axes = []
+    for d in range(ndim):
+        col = table[:, d].reshape(shape)
+        sl = [0] * ndim
+        sl[d] = slice(None)
+        axes.append(col[tuple(sl)])
+    return tuple(axes), T

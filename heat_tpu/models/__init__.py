@@ -1,0 +1,1 @@
+from .heat import Heat2D, Heat3D, MODELS, get_model  # noqa: F401

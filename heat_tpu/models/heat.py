@@ -1,0 +1,53 @@
+"""Model definitions: the PDE problems the framework solves.
+
+The reference solves exactly one model — 2D FTCS diffusion
+(∂T/∂t = ν∇²T, fortran/serial/heat.f90:64-68) — on a square domain. The
+model layer names that problem explicitly and adds the 3D 7-point extension
+(BASELINE.md config 4), bundling the stability law, the step functions each
+backend composes, and analytic invariants the tests check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..config import HeatConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class HeatModel:
+    ndim: int
+    stencil_points: int
+
+    def stability_limit(self) -> float:
+        """Explicit FTCS stability bound on sigma: 1/(2*ndim)."""
+        return 1.0 / (2 * self.ndim)
+
+    def is_stable(self, cfg: HeatConfig) -> bool:
+        return cfg.sigma <= self.stability_limit() + 1e-12
+
+    def bytes_per_point_per_step(self, itemsize: int) -> int:
+        """Minimum HBM traffic: read T_old + write T (the roofline model in
+        BASELINE.md)."""
+        return 2 * itemsize
+
+    def flops_per_point(self) -> int:
+        """adds + muls of the 2*ndim+1-point update."""
+        return 2 * self.ndim + 2 + 2  # neighbor adds, -2nd*c, r*, +c
+
+    def steady_state(self, cfg: HeatConfig) -> np.ndarray:
+        """t→∞ limit: uniform bc_value for both BC families (all heat leaks
+        through the Dirichlet walls)."""
+        return np.full(cfg.shape, cfg.bc_value)
+
+
+Heat2D = HeatModel(ndim=2, stencil_points=5)
+Heat3D = HeatModel(ndim=3, stencil_points=7)
+
+MODELS = {2: Heat2D, 3: Heat3D}
+
+
+def get_model(cfg: HeatConfig) -> HeatModel:
+    return MODELS[cfg.ndim]

@@ -1,0 +1,231 @@
+"""Hand-written Pallas TPU stencil kernels.
+
+The TPU equivalent of the reference's explicit device kernels: CUDA Fortran
+``heat_equation`` (fortran/cuda_kernel/heat.F90:39-54), HIP C++ ``heat_eqn``
+(fortran/hip/heat_kernel.cpp:31-45), and the Jinja2-JIT CUDA C kernel
+(python/cuda/cuda.py:58-86). Where those tile the grid into 32x8 / 128x4
+thread blocks, this kernel tiles rows into VMEM-resident blocks aligned to
+the 8x128 VPU lanes and streams them HBM->VMEM->HBM through Pallas's
+pipelined grid.
+
+Design notes:
+- Grid is 1-D over row tiles; each program sees its own tile plus the
+  *clamped* previous/next tiles (three input BlockSpecs on the same array),
+  which supplies the one-row halo that the reference fetches via its ghost
+  ring. Column neighbors are in-tile shifts (full rows live in the block).
+- The runtime constant ``r`` is baked into the kernel as a closure constant
+  — the Pallas analog of the reference's Jinja2 constant-baking
+  (python/cuda/cuda.py:85), with jit retrace standing in for re-render.
+- bf16 runs upcast to f32 for the accumulate and round once at the store
+  ("bf16 stencil + fp32 accumulate" mode).
+- Boundary cells are masked back to their old value ("edges" BC) exactly
+  like the in-kernel interior guard ``i/=1 .and. i/=ngrid`` of
+  fortran/cuda_kernel/heat.F90:49.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .stencil import accum_dtype_for, ftcs_step_edges, ftcs_step_ghost
+
+# VMEM working-set budget for tile selection (conservative: leaves room for
+# Pallas's double-buffered pipeline and the output tile).
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _sublane(dtype) -> int:
+    return 16 if jnp.dtype(dtype) == jnp.bfloat16 else 8
+
+
+def _pick_row_tile(m: int, n: int, itemsize: int, sublane: int) -> Optional[int]:
+    """Largest divisor of m, multiple of the sublane count, fitting 8 tiles
+    of shape (tile, n) in the VMEM budget. None if no valid tile exists."""
+    cap = max(sublane, _VMEM_BUDGET_BYTES // (8 * n * itemsize))
+    best = None
+    t = sublane
+    while t <= min(m, cap):
+        if m % t == 0:
+            best = t
+        t += sublane
+    return best
+
+
+def _supported(shape, dtype) -> Optional[int]:
+    """Return the row tile if the Pallas path supports this problem."""
+    if jnp.dtype(dtype) == jnp.float64:
+        return None  # no f64 on the TPU vector unit; callers fall back to XLA
+    if len(shape) not in (2, 3):
+        return None
+    m, n = shape[0], shape[-1]
+    if n % 128 != 0:
+        return None
+    if len(shape) == 3 and shape[1] % _sublane(dtype) != 0:
+        return None
+    itemsize = jnp.dtype(dtype).itemsize
+    if len(shape) == 3:
+        itemsize *= shape[1]  # tiles are (t, mid, n)
+    return _pick_row_tile(m, n, itemsize, _sublane(dtype) if len(shape) == 2 else 1)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _ftcs_update(c, up, dn, extra_pairs, r):
+    """new = c + r * (sum(neighbors) - 2*ndim*c), f32-accumulated for bf16.
+
+    ``extra_pairs`` are the in-tile shifted neighbor pairs beyond the
+    up/down (grid-dimension) pair.
+    """
+    acc_dt = accum_dtype_for(c.dtype)
+    ca = c.astype(acc_dt)
+    nd = 1 + len(extra_pairs)
+    acc = up.astype(acc_dt) + dn.astype(acc_dt) - (2.0 * nd) * ca
+    for a, b in extra_pairs:
+        acc = acc + a.astype(acc_dt) + b.astype(acc_dt)
+    return (ca + jnp.asarray(r, acc_dt) * acc).astype(c.dtype)
+
+
+def _make_kernel_2d(r: float, m: int, n: int, tile: int):
+    def kernel(prev_ref, cur_ref, next_ref, out_ref):
+        i = pl.program_id(0)
+        g = pl.num_programs(0)
+        c = cur_ref[:]
+        # One-row halo from neighboring tiles (clamped index maps make the
+        # edge reads safe; their values are masked out below).
+        top_halo = jnp.where(i == 0, c[0:1, :], prev_ref[tile - 1 : tile, :])
+        bot_halo = jnp.where(i == g - 1, c[-1:, :], next_ref[0:1, :])
+        up = jnp.concatenate([top_halo, c[:-1, :]], axis=0)   # value at row j-1
+        dn = jnp.concatenate([c[1:, :], bot_halo], axis=0)    # value at row j+1
+        lf = jnp.concatenate([c[:, 0:1], c[:, :-1]], axis=1)  # value at col k-1
+        rt = jnp.concatenate([c[:, 1:], c[:, -1:]], axis=1)   # value at col k+1
+        new = _ftcs_update(c, up, dn, [(lf, rt)], r)
+        # Freeze the outermost cell ring (interior guard of
+        # fortran/cuda_kernel/heat.F90:49: i,j /= 1, ngrid).
+        grow = i * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, n), 0)
+        gcol = jax.lax.broadcasted_iota(jnp.int32, (tile, n), 1)
+        boundary = (grow == 0) | (grow == m - 1) | (gcol == 0) | (gcol == n - 1)
+        out_ref[:] = jnp.where(boundary, c, new)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("r",))
+def _step_edges_pallas_2d(T: jax.Array, r: float) -> jax.Array:
+    m, n = T.shape
+    tile = _supported(T.shape, T.dtype)
+    assert tile is not None
+    grid = (m // tile,)
+    spec = lambda imap: pl.BlockSpec((tile, n), imap, memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _make_kernel_2d(float(r), m, n, tile),
+        out_shape=jax.ShapeDtypeStruct(T.shape, T.dtype),
+        grid=grid,
+        in_specs=[
+            spec(lambda i: (jnp.maximum(i - 1, 0), 0)),
+            spec(lambda i: (i, 0)),
+            spec(lambda i: (jnp.minimum(i + 1, grid[0] - 1), 0)),
+        ],
+        out_specs=spec(lambda i: (i, 0)),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=2 * _VMEM_BUDGET_BYTES,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * m * n,
+            bytes_accessed=2 * m * n * T.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=_interpret(),
+    )(T, T, T)
+
+
+def _make_kernel_3d(r: float, m: int, mid: int, n: int, tile: int):
+    def kernel(prev_ref, cur_ref, next_ref, out_ref):
+        i = pl.program_id(0)
+        g = pl.num_programs(0)
+        c = cur_ref[:]
+        top_halo = jnp.where(i == 0, c[0:1], prev_ref[tile - 1 : tile])
+        bot_halo = jnp.where(i == g - 1, c[-1:], next_ref[0:1])
+        up = jnp.concatenate([top_halo, c[:-1]], axis=0)
+        dn = jnp.concatenate([c[1:], bot_halo], axis=0)
+        fw = jnp.concatenate([c[:, 0:1, :], c[:, :-1, :]], axis=1)
+        bk = jnp.concatenate([c[:, 1:, :], c[:, -1:, :]], axis=1)
+        lf = jnp.concatenate([c[:, :, 0:1], c[:, :, :-1]], axis=2)
+        rt = jnp.concatenate([c[:, :, 1:], c[:, :, -1:]], axis=2)
+        new = _ftcs_update(c, up, dn, [(fw, bk), (lf, rt)], r)
+        grow = i * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, mid, n), 0)
+        gmid = jax.lax.broadcasted_iota(jnp.int32, (tile, mid, n), 1)
+        gcol = jax.lax.broadcasted_iota(jnp.int32, (tile, mid, n), 2)
+        boundary = (
+            (grow == 0) | (grow == m - 1)
+            | (gmid == 0) | (gmid == mid - 1)
+            | (gcol == 0) | (gcol == n - 1)
+        )
+        out_ref[:] = jnp.where(boundary, c, new)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("r",))
+def _step_edges_pallas_3d(T: jax.Array, r: float) -> jax.Array:
+    m, mid, n = T.shape
+    tile = _supported(T.shape, T.dtype)
+    assert tile is not None
+    grid = (m // tile,)
+    spec = lambda imap: pl.BlockSpec((tile, mid, n), imap, memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _make_kernel_3d(float(r), m, mid, n, tile),
+        out_shape=jax.ShapeDtypeStruct(T.shape, T.dtype),
+        grid=grid,
+        in_specs=[
+            spec(lambda i: (jnp.maximum(i - 1, 0), 0, 0)),
+            spec(lambda i: (i, 0, 0)),
+            spec(lambda i: (jnp.minimum(i + 1, grid[0] - 1), 0, 0)),
+        ],
+        out_specs=spec(lambda i: (i, 0, 0)),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=4 * _VMEM_BUDGET_BYTES,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=8 * m * mid * n,
+            bytes_accessed=2 * m * mid * n * T.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=_interpret(),
+    )(T, T, T)
+
+
+def pallas_available(shape, dtype) -> bool:
+    return _supported(tuple(shape), dtype) is not None
+
+
+def ftcs_step_edges_pallas(T: jax.Array, r: float) -> jax.Array:
+    """One frozen-boundary FTCS step via the Pallas kernel, with transparent
+    XLA fallback for shapes/dtypes the kernel doesn't cover."""
+    if not pallas_available(T.shape, T.dtype):
+        return ftcs_step_edges(T, r)
+    if T.ndim == 2:
+        return _step_edges_pallas_2d(T, r=float(r))
+    return _step_edges_pallas_3d(T, r=float(r))
+
+
+def ftcs_step_ghost_pallas(T: jax.Array, r: float, bc_value: float) -> jax.Array:
+    """Ghost-BC step via Pallas: pad with the bc ring, run the edges kernel
+    on the padded array (its frozen ring IS the ghost ring), crop."""
+    padded = jnp.pad(T, 1, mode="constant",
+                     constant_values=jnp.asarray(bc_value, T.dtype))
+    if not pallas_available(padded.shape, padded.dtype):
+        return ftcs_step_ghost(T, r, bc_value)
+    if T.ndim == 2:
+        out = _step_edges_pallas_2d(padded, r=float(r))
+    else:
+        out = _step_edges_pallas_3d(padded, r=float(r))
+    ctr = tuple(slice(1, -1) for _ in range(T.ndim))
+    return out[ctr]

@@ -1,0 +1,105 @@
+"""XLA stencil ops: the FTCS update as pure functions.
+
+This is the TPU-native analog of the reference's compiler-generated kernels
+(the ``!$cuf kernel do(2)`` loops, ``fortran/cuda_cuf/heat.F90:31-38`` and
+``fortran/mpi+cuda/heat.F90:209-215``): we express the 5-point (2D) / 7-point
+(3D) update as shifted slices and let XLA fuse it into a single
+bandwidth-bound elementwise kernel. The hand-written analog (the reference's
+``attributes(global)`` / HIP C++ kernels) lives in ``pallas_stencil.py``.
+
+Math (fortran/serial/heat.f90:64-68):
+    T[j,k] = T_old[j,k] + r * (T_old[j+1,k] + T_old[j,k+1]
+                               + T_old[j-1,k] + T_old[j,k-1] - 4*T_old[j,k])
+
+Two boundary semantics exist in the reference and both are kept:
+
+- ``edges``: only interior cells 2..n-1 update; the outermost cell ring is
+  frozen (serial + single-GPU variants, fortran/serial/heat.f90:64).
+- ``ghost``: ALL owned cells update, reading a ghost ring fixed at
+  ``bc_value`` at the global domain edge (MPI variants,
+  fortran/mpi+cuda/heat.F90:209-215 with IC at :243-251).
+
+bfloat16 runs compute in float32 and round the result back (the "bf16
+stencil + fp32 accumulate" benchmark mode; the reference's precedent is the
+``SINGLE_PRECISION`` switch in fortran/hip/heat_kernel.cpp:5-9).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def accum_dtype_for(dtype) -> jnp.dtype:
+    """Accumulation dtype: f32 for bf16, else the storage dtype itself."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.bfloat16:
+        return jnp.dtype(jnp.float32)
+    return dtype
+
+
+def laplacian_interior(T: jax.Array) -> jax.Array:
+    """Discrete 2*ndim+1-point Laplacian numerator on the interior.
+
+    Input has shape (m0, ..., m_{d-1}); output (m0-2, ..., m_{d-1}-2) in the
+    accumulation dtype: sum(neighbors) - 2*ndim*center.
+    """
+    nd = T.ndim
+    acc_dt = accum_dtype_for(T.dtype)
+    Tc = T.astype(acc_dt)
+    ctr = tuple(slice(1, -1) for _ in range(nd))
+    acc = (-2.0 * nd) * Tc[ctr]
+    for d in range(nd):
+        up = list(ctr)
+        dn = list(ctr)
+        up[d] = slice(2, None)
+        dn[d] = slice(0, -2)
+        acc = acc + Tc[tuple(up)] + Tc[tuple(dn)]
+    return acc
+
+
+def ftcs_step_edges(T: jax.Array, r) -> jax.Array:
+    """One FTCS step, frozen-boundary ("edges") semantics.
+
+    Interior cells get T + r*lap; the outermost ring is returned unchanged
+    (the serial loop bounds 2..n-1, fortran/serial/heat.f90:64-68).
+    """
+    acc_dt = accum_dtype_for(T.dtype)
+    ctr = tuple(slice(1, -1) for _ in range(T.ndim))
+    interior = T[ctr].astype(acc_dt) + jnp.asarray(r, acc_dt) * laplacian_interior(T)
+    return T.at[ctr].set(interior.astype(T.dtype))
+
+
+def pad_with_ghosts(T: jax.Array, bc_value) -> jax.Array:
+    """Surround the owned field with a one-cell ghost ring at ``bc_value``
+    (the ng=1 ghost allocation of fortran/mpi+cuda/heat.F90:41,107-111 with
+    global-edge ghosts pinned to 1.0 at :243-251)."""
+    return jnp.pad(T, 1, mode="constant", constant_values=jnp.asarray(bc_value, T.dtype))
+
+
+def ftcs_step_ghost(T: jax.Array, r, bc_value) -> jax.Array:
+    """One FTCS step, Dirichlet-by-ghost ("ghost") semantics, single device.
+
+    Every owned cell updates against a conceptual ghost ring held at
+    ``bc_value`` — the global, undecomposed equivalent of one MPI-variant
+    timestep (fortran/mpi+cuda/heat.F90:206-219). Used as the oracle for the
+    sharded backend.
+    """
+    padded = pad_with_ghosts(T, bc_value)
+    acc_dt = accum_dtype_for(T.dtype)
+    out = T.astype(acc_dt) + jnp.asarray(r, acc_dt) * laplacian_interior(padded)
+    return out.astype(T.dtype)
+
+
+def run_steps(T: jax.Array, nsteps: int, step_fn: Callable[[jax.Array], jax.Array]) -> jax.Array:
+    """Apply ``step_fn`` ``nsteps`` times under ``lax.fori_loop``.
+
+    The loop-carried double buffer replaces the reference's explicit
+    ``T_old = T`` device snapshot each step (fortran/cuda_kernel/heat.F90:32);
+    with buffer donation XLA ping-pongs two buffers with no copy at all.
+    """
+    if nsteps == 0:
+        return T
+    return jax.lax.fori_loop(0, nsteps, lambda i, t: step_fn(t), T)

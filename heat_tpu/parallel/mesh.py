@@ -1,0 +1,78 @@
+"""Device mesh construction — the cartesian-topology layer.
+
+TPU-native replacement for the reference's MPI topology setup
+(``fortran/mpi+cuda/heat.F90:87-103``): ``MPI_Dims_create`` becomes a
+balanced factorization of the device count over the spatial axes,
+``mpi_cart_create``/``cart_shift`` become a named ``jax.sharding.Mesh`` whose
+axes the halo exchange addresses by name. Rank→GPU binding
+(``cudaSetDevice`` by shared-node rank, :64-70) has no analog: the JAX
+runtime owns device placement.
+
+The reference decomposes only x (``ndims=1``, :28); here every spatial axis
+is a mesh axis by default (the 2-D 4x4 decomposition targeted by
+BASELINE.json), and a 1-D parity layout is just ``mesh_shape=(N, 1)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES = ("x", "y", "z")
+
+
+def auto_mesh_shape(ndev: int, ndim: int) -> Tuple[int, ...]:
+    """Balanced factorization of ``ndev`` into ``ndim`` factors, largest
+    factors on the leading (most-contiguous) axes — MPI_Dims_create semantics
+    (fortran/mpi+cuda/heat.F90:87-90)."""
+    factors = [1] * ndim
+    remaining = ndev
+    # greedy: repeatedly give the smallest prime factor to the smallest axis
+    primes = []
+    k = 2
+    while remaining > 1:
+        while remaining % k == 0:
+            primes.append(k)
+            remaining //= k
+        k += 1
+    for p in sorted(primes, reverse=True):
+        i = int(np.argmin(factors))
+        factors[i] *= p
+    return tuple(sorted(factors, reverse=True))
+
+
+def build_mesh(
+    ndim: int,
+    mesh_shape: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a named mesh over the spatial axes.
+
+    Like the reference's decomposition announcement
+    ('Automatic MPI decomposition', fortran/mpi+cuda/heat.F90:90), callers
+    should log ``mesh.shape`` once per job.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    shape = tuple(mesh_shape) if mesh_shape else auto_mesh_shape(len(devices), ndim)
+    if len(shape) != ndim:
+        raise ValueError(f"mesh_shape {shape} must have {ndim} dims")
+    n_used = int(np.prod(shape))
+    if n_used > len(devices):
+        raise ValueError(f"mesh {shape} needs {n_used} devices, have {len(devices)}")
+    dev_array = np.asarray(devices[:n_used]).reshape(shape)
+    return Mesh(dev_array, MESH_AXES[:ndim])
+
+
+def validate_divisible(n_interior: int, mesh: Mesh) -> None:
+    """The reference requires grids to divide evenly over ranks
+    (``nx = n/nblocks(1)`` with integer division, fortran/mpi+cuda/heat.F90:92);
+    we keep the constraint but fail loudly (SURVEY.md §5)."""
+    for ax, sz in zip(mesh.axis_names, mesh.devices.shape):
+        if n_interior % sz != 0:
+            raise ValueError(
+                f"grid dim {n_interior} does not divide evenly over mesh axis "
+                f"{ax!r} of size {sz} (reference constraint, kept & validated)"
+            )

@@ -1,0 +1,2 @@
+from .timing import Timing, now, sync  # noqa: F401
+from .logging import get_logger, master_print  # noqa: F401

@@ -1,0 +1,64 @@
+"""Periodic checkpoint / resume.
+
+The reference has no mid-run persistence — its only dumps are the initial
+``int.dat`` and final ``soln.dat`` (fortran/serial/heat.f90:50-55,77-83).
+This module is the genuine extension flagged in SURVEY.md §5: periodic
+``.npz`` snapshots carrying the field, the step index, and a config
+fingerprint, enabling restart of long solves (the 25k-step flagship config,
+``fortran/input_all.dat``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..config import HeatConfig
+
+_FMT = "heat_step{step:08d}.npz"
+
+
+def config_fingerprint(cfg: HeatConfig) -> str:
+    """Hash of the physics-relevant fields; a resume must match these."""
+    phys = dict(n=cfg.n, sigma=cfg.sigma, nu=cfg.nu, dom_len=cfg.dom_len,
+                ndim=cfg.ndim, ic=cfg.ic, bc=cfg.bc, bc_value=cfg.bc_value,
+                dtype=cfg.dtype)
+    return hashlib.sha256(json.dumps(phys, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def save(cfg: HeatConfig, T: np.ndarray, step: int) -> Path:
+    d = Path(cfg.checkpoint_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / _FMT.format(step=step)
+    # Temp name must NOT match latest()'s "heat_step*.npz" glob, or a crash
+    # mid-save would leave a torn file that resume then trips over.
+    tmp = d / (path.name + ".tmp")
+    with open(tmp, "wb") as f:  # file handle: stops numpy appending ".npz"
+        np.savez_compressed(f, T=np.asarray(T), step=step,
+                            fingerprint=config_fingerprint(cfg))
+    tmp.rename(path)  # atomic publish: no torn checkpoint on interrupt
+    return path
+
+
+def latest(cfg: HeatConfig) -> Optional[Path]:
+    d = Path(cfg.checkpoint_dir)
+    if not d.is_dir():
+        return None
+    cks = sorted(d.glob("heat_step*.npz"))
+    return cks[-1] if cks else None
+
+
+def load(path: Path, cfg: HeatConfig) -> Tuple[np.ndarray, int]:
+    with np.load(path, allow_pickle=False) as z:
+        fp = str(z["fingerprint"])
+        if fp != config_fingerprint(cfg):
+            raise ValueError(
+                f"checkpoint {path} was written for a different physics config "
+                f"(fingerprint {fp} != {config_fingerprint(cfg)})"
+            )
+        return z["T"], int(z["step"])

@@ -1,0 +1,38 @@
+"""Process-0-gated logging.
+
+The reference gates every print on ``masterproc`` (rank 0,
+fortran/mpi+cuda/heat.F90:78-79); the JAX equivalent is
+``jax.process_index() == 0``. Single-process runs always log.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def _is_master() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def master_print(*args, **kw) -> None:
+    if _is_master():
+        print(*args, **kw)
+        sys.stdout.flush()
+
+
+def get_logger(name: str = "heat_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter("[%(name)s] %(levelname)s %(message)s"))
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO)
+    if not _is_master():
+        logger.setLevel(logging.ERROR)
+    return logger

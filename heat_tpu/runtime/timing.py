@@ -1,0 +1,61 @@
+"""Wall-clock timing and throughput accounting.
+
+The reference uses two timing styles: ``cpu_time`` around everything
+including IO (fortran/serial/heat.f90:25,71) and barrier-bracketed
+``MPI_Wtime`` around the solve only, reported as *average seconds per
+timestep* (fortran/mpi+cuda/heat.F90:253,264,292 — which mislabels the
+average as "total time"; fortran/hip/heat.F90:323 labels it correctly).
+We report all three, labeled correctly (SURVEY.md quirk #5), plus the
+derived grid-points/sec metric used as the benchmark north star.
+
+``jax.block_until_ready`` stands in for the device sync + MPI barrier pair.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+
+def now() -> float:
+    return time.perf_counter()
+
+
+def sync(x: Any) -> Any:
+    """Block until device work producing x is done (== cudaDeviceSynchronize
+    + MPI_BARRIER before reading the clock, fortran/mpi+cuda/heat.F90:262-264)."""
+    import jax
+
+    return jax.block_until_ready(x)
+
+
+@dataclasses.dataclass
+class Timing:
+    total_s: float = 0.0          # everything: setup + compile + solve + IO
+    compile_s: float = 0.0        # jit compile (the reference has no analog;
+                                  # nvcc JIT in python/cuda/cuda.py:86 is closest)
+    solve_s: float = 0.0          # solve-only wall clock
+    steps: int = 0
+    points: int = 0               # grid points updated per step
+
+    @property
+    def per_step_s(self) -> float:
+        return self.solve_s / self.steps if self.steps else 0.0
+
+    @property
+    def points_per_s(self) -> float:
+        return self.points * self.steps / self.solve_s if self.solve_s > 0 else 0.0
+
+    def report_lines(self) -> list[str]:
+        """Human-readable report, keeping the reference's familiar lines."""
+        lines = [
+            "simulation completed!!!!",                       # serial/heat.f90:73
+            f"total time: {self.total_s:.6f}",                # serial/heat.f90:74
+            f"solve time: {self.solve_s:.6f}",
+            f"Average time per timestep: {self.per_step_s:.9f}",  # hip/heat.F90:323
+            f"throughput: {self.points_per_s:.4g} points/s",
+        ]
+        if self.compile_s:
+            lines.insert(2, f"compile time: {self.compile_s:.6f}")
+        return lines

@@ -1,0 +1,1 @@
+from .dtypes import jnp_dtype, ensure_precision  # noqa: F401

@@ -1,0 +1,26 @@
+"""Dtype plumbing.
+
+The reference is double precision everywhere (``real*8``,
+fortran/serial/heat.f90:5) with a ``SINGLE_PRECISION`` escape hatch
+(fortran/hip/heat_kernel.cpp:5-9). On TPU, f64 is emulated and slow, so the
+framework defaults to f32 with an f64 *parity mode* (for oracle matching,
+typically on CPU) and a bf16-storage/f32-accumulate throughput mode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_JNP = {"float64": "float64", "float32": "float32", "bfloat16": "bfloat16"}
+
+
+def ensure_precision(dtype_name: str) -> None:
+    """Enable jax x64 mode when an f64 run is requested."""
+    if dtype_name == "float64" and not jax.config.read("jax_enable_x64"):
+        jax.config.update("jax_enable_x64", True)
+
+
+def jnp_dtype(dtype_name: str):
+    ensure_precision(dtype_name)
+    return jnp.dtype(_JNP[dtype_name])

@@ -1,0 +1,32 @@
+"""Test harness: 8 virtual CPU devices + f64 enabled.
+
+Mirrors the reference's develop-without-a-cluster story (`mpirun -np N` on
+one node, fortran/mpi+cuda/makefile:1-2): halo-exchange and sharding logic
+run on a fake 8-device CPU mesh; the real chip is only needed for perf.
+"""
+
+import os
+
+# Must land before the first backend initialization.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_cwd(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)

@@ -1,0 +1,99 @@
+"""input.dat parsing and config derivations (fortran/serial/heat.f90:11-17)."""
+
+import pytest
+
+from heat_tpu.config import HeatConfig, parse_input, variant_config, write_input, VARIANTS
+
+
+def test_parse_5_field(tmp_path):
+    p = tmp_path / "input.dat"
+    p.write_text("1024 0.25 0.05 2.0 30\n")  # fortran/serial/input.dat values
+    cfg = parse_input(p)
+    assert (cfg.n, cfg.sigma, cfg.nu, cfg.dom_len, cfg.ntime) == (1024, 0.25, 0.05, 2.0, 30)
+    assert cfg.soln is False
+
+
+def test_parse_6_field(tmp_path):
+    p = tmp_path / "input.dat"
+    p.write_text("100 0.25 0.05 2.0 10 1\n")  # fortran/mpi+cuda/input.dat values
+    cfg = parse_input(p)
+    assert cfg.ntime == 10 and cfg.soln is True
+
+
+def test_parse_flagship(tmp_path):
+    p = tmp_path / "input.dat"
+    p.write_text("32768 0.25 0.05 1.0 25000 0\n")  # fortran/input_all.dat
+    cfg = parse_input(p)
+    assert cfg.n == 32768 and cfg.ntime == 25000 and not cfg.soln
+
+
+def test_parse_multiline_and_extra_tokens(tmp_path):
+    # Fortran list-directed reads span lines and ignore trailing junk.
+    p = tmp_path / "input.dat"
+    p.write_text("64 0.25\n0.05 2.0\n5 1 999\n")
+    cfg = parse_input(p)
+    assert cfg.n == 64 and cfg.soln is True
+
+
+def test_parse_too_few_fields(tmp_path):
+    p = tmp_path / "input.dat"
+    p.write_text("64 0.25 0.05\n")
+    with pytest.raises(ValueError):
+        parse_input(p)
+
+
+def test_write_roundtrip(tmp_path):
+    cfg = HeatConfig(n=128, sigma=0.2, nu=0.1, dom_len=1.0, ntime=7, soln=True)
+    p = tmp_path / "input.dat"
+    write_input(cfg, p)
+    back = parse_input(p)
+    assert back.n == cfg.n and back.ntime == cfg.ntime and back.soln
+
+
+def test_write_roundtrip_full_precision(tmp_path):
+    """A write/parse cycle must not perturb the physics (dt, fingerprints)."""
+    cfg = HeatConfig(n=64, sigma=0.123456789012345, nu=0.0987654321098765,
+                     dom_len=1.9999999999999998, ntime=3)
+    p = tmp_path / "input.dat"
+    write_input(cfg, p)
+    back = parse_input(p)
+    assert back.sigma == cfg.sigma and back.nu == cfg.nu
+    assert back.dom_len == cfg.dom_len and back.dt == cfg.dt
+
+
+def test_r_equals_sigma():
+    # SURVEY.md quirk #4: r = nu*dt/delta^2 with dt = sigma*delta^2/nu
+    # collapses to sigma; the derivation chain is kept for parity.
+    cfg = HeatConfig(n=100, sigma=0.21, nu=0.31, dom_len=1.7, ntime=1)
+    assert abs(cfg.r - cfg.sigma) < 1e-15
+    assert abs(cfg.delta - 1.7 / 99) < 1e-15
+    assert abs(cfg.dt - 0.21 * cfg.delta**2 / 0.31) < 1e-18
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        HeatConfig(n=2)
+    with pytest.raises(ValueError):
+        HeatConfig(dtype="float16")
+    with pytest.raises(ValueError):
+        HeatConfig(backend="mpi")
+    with pytest.raises(ValueError):
+        HeatConfig(bc="periodic")
+    with pytest.raises(ValueError):
+        HeatConfig(ndim=4)
+    # sigma sanity applies in every dimension, not just 2D
+    with pytest.raises(ValueError):
+        HeatConfig(ndim=3, sigma=-1.0)
+    with pytest.raises(ValueError):
+        HeatConfig(ndim=3, sigma=1e9)
+
+
+def test_variants_cover_reference_taxonomy():
+    # one preset per reference variant (SURVEY.md §0 table)
+    for name in ["serial", "cuda_kernel", "cuda_managed", "cuda_cuf",
+                 "mpi_cuda", "mpi_cuda_na", "hip", "python_serial", "python_cuda"]:
+        assert name in VARIANTS
+    cfg = variant_config("mpi_cuda")
+    assert cfg.backend == "sharded" and cfg.bc == "ghost" and cfg.comm == "direct"
+    assert variant_config("hip").comm == "staged"
+    assert variant_config("cuda_kernel").ic == "hat_half"

@@ -1,0 +1,69 @@
+"""Coordinates, IC presets, boundary masks (fortran/serial/heat.f90:28-48)."""
+
+import numpy as np
+
+from heat_tpu.config import HeatConfig
+from heat_tpu.grid import boundary_mask, coords, coords_1d, initial_condition
+
+
+def test_coords_endpoints():
+    ax = coords_1d(101, 2.0)
+    assert ax[0] == 0.0 and ax[-1] == 2.0
+    assert np.allclose(np.diff(ax), 2.0 / 100)
+
+
+def test_hat_ic_serial():
+    # fortran/serial/heat.f90:40-48: T=2 on [0.5,1.5]^2 else 1
+    cfg = HeatConfig(n=41, dom_len=2.0, ic="hat", dtype="float64")
+    T = initial_condition(cfg)
+    ax = coords_1d(41, 2.0)
+    hot = (ax >= 0.5) & (ax <= 1.5)
+    expect = np.where(hot[:, None] & hot[None, :], 2.0, 1.0)
+    assert np.array_equal(T, expect)
+
+
+def test_hat_half_ic():
+    # fortran/cuda_kernel/heat.F90:98: x in [0.5,1.5], y in [0.5,1.0]
+    cfg = HeatConfig(n=41, dom_len=2.0, ic="hat_half", dtype="float64")
+    T = initial_condition(cfg)
+    ax = coords_1d(41, 2.0)
+    hx = (ax >= 0.5) & (ax <= 1.5)
+    hy = (ax >= 0.5) & (ax <= 1.0)
+    expect = np.where(hx[:, None] & hy[None, :], 2.0, 1.0)
+    assert np.array_equal(T, expect)
+
+
+def test_hat_small_ic():
+    # python/serial/heat.py:25: [0.5,1.0]^2
+    cfg = HeatConfig(n=31, dom_len=2.0, ic="hat_small", dtype="float64")
+    T = initial_condition(cfg)
+    ax = coords_1d(31, 2.0)
+    h = (ax >= 0.5) & (ax <= 1.0)
+    expect = np.where(h[:, None] & h[None, :], 2.0, 1.0)
+    assert np.array_equal(T, expect)
+
+
+def test_uniform_ic():
+    cfg = HeatConfig(n=16, ic="uniform")
+    assert np.all(initial_condition(cfg) == 2.0)
+
+
+def test_ic_3d():
+    cfg = HeatConfig(n=17, ndim=3, ic="hat", dtype="float64")
+    T = initial_condition(cfg)
+    assert T.shape == (17, 17, 17)
+    assert set(np.unique(T)) == {1.0, 2.0}
+
+
+def test_boundary_mask():
+    cfg = HeatConfig(n=10)
+    m = boundary_mask(cfg)
+    assert m.sum() == 10 * 10 - 8 * 8
+    assert m[0].all() and m[-1].all() and m[:, 0].all() and m[:, -1].all()
+    assert not m[1:-1, 1:-1].any()
+
+
+def test_coords_ndim():
+    cfg = HeatConfig(n=8, ndim=3)
+    axes = coords(cfg)
+    assert len(axes) == 3 and all(len(a) == 8 for a in axes)

@@ -1,0 +1,125 @@
+"""The numpy oracle vs literal loop transcriptions of the reference, plus
+physics properties (the test pyramid of SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.backends.serial_np import step_edges_np, step_ghost_np
+from heat_tpu.config import HeatConfig
+from heat_tpu.grid import initial_condition
+from heat_tpu.models import get_model
+
+
+def literal_serial_loop(T, r, nsteps):
+    """Direct transcription of the serial triple loop
+    (fortran/serial/heat.f90:61-69): snapshot + interior 5-point update."""
+    T = T.copy()
+    n = T.shape[0]
+    for _ in range(nsteps):
+        T_old = T.copy()
+        for j in range(1, n - 1):
+            for k in range(1, n - 1):
+                T[j, k] = T_old[j, k] + r * (
+                    T_old[j + 1, k] + T_old[j, k + 1]
+                    + T_old[j - 1, k] + T_old[j, k - 1] - 4 * T_old[j, k]
+                )
+    return T
+
+
+def literal_ghost_loop(T, r, nsteps, bc):
+    """Transcription of the MPI-variant step on one rank: ghost ring at bc,
+    ALL owned cells update (fortran/mpi+cuda/heat.F90:206-219, IC :243-251)."""
+    n = T.shape[0]
+    G = np.full((n + 2, n + 2), bc, dtype=T.dtype)
+    G[1:-1, 1:-1] = T
+    for _ in range(nsteps):
+        Gold = G.copy()
+        for j in range(1, n + 1):
+            for k in range(1, n + 1):
+                G[j, k] = Gold[j, k] + r * (
+                    Gold[j + 1, k] + Gold[j, k + 1]
+                    + Gold[j - 1, k] + Gold[j, k - 1] - 4 * Gold[j, k]
+                )
+    return G[1:-1, 1:-1]
+
+
+def test_step_edges_matches_literal_loops():
+    cfg = HeatConfig(n=12, ntime=5, dtype="float64", ic="hat")
+    T0 = initial_condition(cfg)
+    expect = literal_serial_loop(T0, cfg.r, 5)
+    got = T0
+    for _ in range(5):
+        got = step_edges_np(got, cfg.r)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_step_ghost_matches_literal_loops():
+    cfg = HeatConfig(n=12, ntime=4, dtype="float64", ic="uniform", bc="ghost")
+    T0 = initial_condition(cfg)
+    expect = literal_ghost_loop(T0, cfg.r, 4, cfg.bc_value)
+    got = T0
+    for _ in range(4):
+        got = step_ghost_np(got, cfg.r, cfg.bc_value)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_serial_backend_end_to_end():
+    cfg = HeatConfig(n=24, ntime=8, dtype="float64", backend="serial")
+    res = solve(cfg)
+    expect = literal_serial_loop(initial_condition(cfg), cfg.r, 8)
+    np.testing.assert_array_equal(res.T, expect)
+    assert res.timing.steps == 8
+
+
+def test_maximum_principle():
+    """Diffusion can't create new extrema: min/max bounded by IC/BC."""
+    cfg = HeatConfig(n=33, ntime=50, dtype="float64", ic="hat")
+    res = solve(cfg.with_(backend="serial"))
+    assert res.T.max() <= 2.0 + 1e-12
+    assert res.T.min() >= 1.0 - 1e-12
+
+
+def test_heat_decays_toward_walls():
+    """With cold Dirichlet walls the hot spot must lose heat monotonically
+    (the intended invariant behind the reference's commented-out sum,
+    fortran/mpi+cuda/heat.F90:266-273)."""
+    cfg = HeatConfig(n=33, ntime=0, dtype="float64", ic="uniform", bc="ghost",
+                     report_sum=True, backend="serial")
+    sums = []
+    T = initial_condition(cfg)
+    for steps in [5, 10, 20, 40]:
+        r = solve(cfg.with_(ntime=steps))
+        sums.append(r.gsum)
+    assert all(sums[i] > sums[i + 1] for i in range(len(sums) - 1))
+    assert sums[0] < float(T.sum())
+
+
+def test_interior_conservation_without_boundary_flux():
+    """A uniform field with matching wall temperature is a fixed point."""
+    cfg = HeatConfig(n=17, ntime=25, dtype="float64", ic="uniform", bc="ghost",
+                     bc_value=2.0, backend="serial")
+    res = solve(cfg)
+    np.testing.assert_allclose(res.T, 2.0, rtol=0, atol=1e-14)
+
+
+def test_steady_state_convergence():
+    """t->inf: everything relaxes to the wall temperature."""
+    cfg = HeatConfig(n=9, ntime=4000, dtype="float64", ic="hat", bc="ghost",
+                     backend="serial")
+    res = solve(cfg)
+    model = get_model(cfg)
+    np.testing.assert_allclose(res.T, model.steady_state(cfg), atol=1e-6)
+
+
+def test_stability_limit():
+    model = get_model(HeatConfig(ndim=2))
+    assert model.stability_limit() == 0.25
+    assert get_model(HeatConfig(ndim=3)).stability_limit() == pytest.approx(1 / 6)
+
+
+def test_3d_oracle_fixed_point():
+    cfg = HeatConfig(n=9, ndim=3, ntime=10, dtype="float64", ic="uniform",
+                     bc="ghost", bc_value=2.0, backend="serial")
+    res = solve(cfg)
+    np.testing.assert_allclose(res.T, 2.0, atol=1e-14)

@@ -1,0 +1,91 @@
+"""Distributed correctness on the 8-device fake CPU mesh: decomposed runs
+must match the undecomposed oracle (golden test, SURVEY.md §4 item 4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig
+from heat_tpu.parallel.mesh import auto_mesh_shape, build_mesh, validate_divisible
+
+
+BASE = HeatConfig(n=32, ntime=12, dtype="float64", backend="sharded")
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual CPU devices"
+
+
+def test_auto_mesh_shape():
+    assert auto_mesh_shape(8, 2) == (4, 2)
+    assert auto_mesh_shape(16, 2) == (4, 4)   # BASELINE.json config 3
+    assert auto_mesh_shape(8, 3) == (2, 2, 2)
+    assert auto_mesh_shape(1, 2) == (1, 1)
+    assert auto_mesh_shape(6, 2) == (3, 2)
+
+
+def test_validate_divisible():
+    mesh = build_mesh(2, (4, 2))
+    validate_divisible(32, mesh)
+    with pytest.raises(ValueError):
+        validate_divisible(30, mesh)  # 30 % 4 != 0 -> loud failure
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (1, 8), (2, 4), (4, 2)])
+def test_sharded_matches_serial_edges(mesh_shape):
+    """2-D decomposition generalizing the reference's 1-D split
+    (fortran/mpi+cuda/heat.F90:87-93); (8,1) IS the reference layout."""
+    cfg = BASE.with_(mesh_shape=mesh_shape, bc="edges", ic="hat")
+    expect = solve(cfg.with_(backend="serial"))
+    got = solve(cfg)
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4)])
+def test_sharded_matches_serial_ghost(mesh_shape):
+    cfg = BASE.with_(mesh_shape=mesh_shape, bc="ghost", ic="uniform")
+    expect = solve(cfg.with_(backend="serial"))
+    got = solve(cfg)
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=0)
+
+
+def test_sharded_staged_comm_matches_direct():
+    """NO_AWARE staged path == CUDA-aware path numerically
+    (fortran/mpi+cuda/heat.F90:162-172: same data, different route)."""
+    cfg = BASE.with_(mesh_shape=(2, 2), bc="ghost", ic="uniform", ntime=6)
+    direct = solve(cfg.with_(comm="direct"))
+    staged = solve(cfg.with_(comm="staged"))
+    np.testing.assert_allclose(staged.T, direct.T, rtol=0, atol=0)
+
+
+def test_sharded_3d():
+    cfg = HeatConfig(n=16, ndim=3, ntime=5, dtype="float64", sigma=0.15,
+                     ic="hat", backend="sharded", mesh_shape=(2, 2, 2))
+    expect = solve(cfg.with_(backend="serial"))
+    got = solve(cfg)
+    # 7-point sum reassociation: ~1 ulp
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=1e-14)
+
+
+def test_sharded_report_sum():
+    cfg = BASE.with_(mesh_shape=(4, 2), bc="ghost", ic="uniform",
+                     report_sum=True)
+    expect = solve(cfg.with_(backend="serial"))
+    got = solve(cfg)
+    assert got.gsum == pytest.approx(expect.gsum, rel=1e-12)
+
+
+def test_sharded_f32_and_bf16():
+    for dt, atol in [("float32", 1e-6), ("bfloat16", 3e-2)]:
+        cfg = BASE.with_(mesh_shape=(2, 4), dtype=dt, ntime=8)
+        expect = solve(cfg.with_(backend="serial", dtype="float32"))
+        got = solve(cfg)
+        np.testing.assert_allclose(
+            np.asarray(got.T, np.float32), expect.T, rtol=0, atol=atol
+        )
+
+
+def test_mesh_too_large_rejected():
+    with pytest.raises(ValueError):
+        build_mesh(2, (16, 16))
