@@ -1,0 +1,53 @@
+"""Headline benchmark: grid-points/sec/chip on the 4096^2 f32 stencil.
+
+BASELINE.md: the reference publishes no numbers, so this repo establishes
+the baseline. ``vs_baseline`` is reported against the analytic HBM roofline
+for this chip class (v5e: ~819 GB/s / 8 bytes-per-point-per-step f32
+= ~1.0e11 points/s) — i.e. the fraction of the hardware bound achieved.
+The measured config mirrors the reference's single-GPU benchmark shape
+(python/cuda/cuda.py:31-33: 4096^2, 10k steps; we run 2000 steps, identical
+steady-state per-step cost).
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+N = 4096
+STEPS = 2000
+ROOFLINE_POINTS_PER_S = 1.0e11  # v5e HBM-bound estimate (BASELINE.md)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from heat_tpu.backends.pallas import make_advance
+    from heat_tpu.config import HeatConfig
+    from heat_tpu.grid import initial_condition
+
+    cfg = HeatConfig(n=N, ntime=STEPS, dtype="float32", ic="hat",
+                     backend="pallas")
+    T = jax.device_put(jnp.asarray(initial_condition(cfg), jnp.float32))
+    advance = make_advance(cfg)
+
+    compiled = advance.lower(T, STEPS).compile()
+    T = jax.block_until_ready(compiled(T))  # warm run (also checks execution)
+    t0 = time.perf_counter()
+    T = jax.block_until_ready(compiled(T))
+    dt = time.perf_counter() - t0
+
+    pts_per_s = N * N * STEPS / dt
+    print(json.dumps({
+        "metric": f"grid_points_per_sec_per_chip_{N}x{N}_f32_pallas",
+        "value": pts_per_s,
+        "unit": "points/s",
+        "vs_baseline": pts_per_s / ROOFLINE_POINTS_PER_S,
+    }))
+
+
+if __name__ == "__main__":
+    main()

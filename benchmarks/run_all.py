@@ -1,0 +1,118 @@
+"""The five BASELINE.json benchmark configs, measured end to end.
+
+Run on the real chip: ``python benchmarks/run_all.py``
+Smoke mode (CPU, shrunken sizes): ``python benchmarks/run_all.py --smoke``
+
+Writes ``benchmarks/results.json`` and prints one line per config with
+points/s and the fraction of the HBM roofline (BASELINE.md's analytic
+bound: bytes/point/step = 2*itemsize, v5e ~819 GB/s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+HBM_BYTES_PER_S = 819e9  # v5e; v5p would be ~2.76e12
+
+
+def bench_one(name, cfg, repeat=1):
+    import jax
+
+    from heat_tpu.backends import solve
+
+    res = solve(cfg)  # includes AOT warmup; solve_s is steady-state
+    best = res.timing
+    for _ in range(repeat - 1):
+        r = solve(cfg)
+        if r.timing.solve_s < best.solve_s:
+            best = r.timing
+    itemsize = {"float64": 8, "float32": 4, "bfloat16": 2}[cfg.dtype]
+    roofline = HBM_BYTES_PER_S / (2 * itemsize)
+    row = {
+        "name": name,
+        "n": cfg.n, "ndim": cfg.ndim, "steps": best.steps,
+        "dtype": cfg.dtype, "backend": cfg.backend,
+        "mesh": list(cfg.mesh_shape) if cfg.mesh_shape else None,
+        "solve_s": best.solve_s,
+        "per_step_s": best.per_step_s,
+        "points_per_s": best.points_per_s,
+        "roofline_frac": best.points_per_s / roofline,
+        "devices": len(jax.devices()),
+        "platform": jax.default_backend(),
+    }
+    print(f"{name:40s} {row['points_per_s']:.3e} pts/s  "
+          f"({100 * row['roofline_frac']:.1f}% of HBM roofline)  "
+          f"per-step {row['per_step_s'] * 1e6:.1f} us")
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny sizes, CPU-safe")
+    ap.add_argument("--only", help="substring filter on config name")
+    args = ap.parse_args()
+
+    if args.smoke:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from heat_tpu.config import HeatConfig
+
+    s = args.smoke
+    ndev_ok = True
+    try:
+        import jax
+
+        ndev = len(jax.devices())
+    except Exception:
+        ndev = 1
+
+    configs = [
+        # 1. serial/numpy oracle (python/serial analog)
+        ("1_serial_256sq_numpy",
+         HeatConfig(n=256, ntime=8 if s else 200, dtype="float64",
+                    backend="serial")),
+        # 2. single-chip Pallas 4096^2 (python/cuda analog: 4096^2 x 10000)
+        ("2_pallas_4096sq_f32",
+         HeatConfig(n=256 if s else 4096, ntime=20 if s else 2000,
+                    dtype="float32", backend="pallas")),
+        # 3. 16384^2 over a 2-D mesh (mpi+cuda analog, BASELINE 4x4 target)
+        ("3_sharded_16384sq_f32_mesh",
+         HeatConfig(n=256 if s else 16384, ntime=20 if s else 500,
+                    dtype="float32", backend="sharded",
+                    mesh_shape=(4, 2) if (s and ndev >= 8) else None)),
+        # 4. 3-D 512^3 7-point stencil
+        ("4_pallas_512cube_f32",
+         HeatConfig(n=64 if s else 512, ndim=3, ntime=10 if s else 200,
+                    dtype="float32", backend="pallas", sigma=1 / 6)),
+        # 5. bf16 storage + f32 accumulate, 32768^2 (weak-scale flagship,
+        #    fortran/input_all.dat: 32768^2 x 25000)
+        ("5_bf16_32768sq",
+         HeatConfig(n=512 if s else 32768, ntime=10 if s else 100,
+                    dtype="bfloat16", backend="pallas")),
+    ]
+
+    rows = []
+    for name, cfg in configs:
+        if args.only and args.only not in name:
+            continue
+        try:
+            rows.append(bench_one(name, cfg))
+        except Exception as e:  # record failures, keep measuring
+            print(f"{name:40s} FAILED: {type(e).__name__}: {e}")
+            rows.append({"name": name, "error": f"{type(e).__name__}: {e}"})
+
+    out = Path(__file__).parent / "results.json"
+    out.write_text(json.dumps({"ts": time.time(), "rows": rows}, indent=2))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

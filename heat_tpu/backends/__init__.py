@@ -32,6 +32,7 @@ class SolveResult:
     timing: Timing
     gsum: Optional[float] = None   # global temperature sum if report_sum
     start_step: int = 0            # nonzero when resumed from checkpoint
+    mesh_shape: Optional[tuple] = None  # decomposition used (sharded backend)
 
 
 def register(name: str):

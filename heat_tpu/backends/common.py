@@ -80,15 +80,15 @@ def drive(
     jax.block_until_ready(T_dev)
     solve_s = time.perf_counter() - t0
 
+    T_host = to_host(T_dev)
     gsum = None
     if cfg.report_sum:
         # The intended-but-commented-out global reduction of the reference
-        # (mpi+cuda/heat.F90:266-273), done properly: on sharded arrays XLA
-        # lowers this to a psum over the mesh.
-        gsum = float(jnp.sum(T_dev.astype(jnp.float32) if T_dev.dtype == jnp.bfloat16
-                             else T_dev))
-
-    T_host = to_host(T_dev)
+        # (mpi+cuda/heat.F90:266-273), done properly. Accumulate in f64 on
+        # host (T_host is already fetched) so every backend reports the
+        # identical sum regardless of storage dtype. A multi-host deployment
+        # would psum process-local sums instead.
+        gsum = float(np.sum(np.asarray(T_host, np.float64)))
     timing = Timing(total_s=time.perf_counter() - t_all0, compile_s=compile_s,
                     solve_s=solve_s, steps=remaining, points=cfg.points)
     return SolveResult(cfg=cfg, T=T_host, timing=timing, gsum=gsum,
@@ -101,7 +101,7 @@ def load_or_init(cfg: HeatConfig, T0: Optional[np.ndarray]):
 
     start_step = 0
     if T0 is None and cfg.checkpoint_every:
-        ck = checkpoint.latest(cfg)
+        ck = checkpoint.latest(cfg, max_step=cfg.ntime)
         if ck is not None:
             T0, start_step = checkpoint.load(ck, cfg)
             master_print(f"resumed from {ck} at step {start_step}")

@@ -15,7 +15,7 @@ from typing import Optional
 import numpy as np
 
 from ..config import HeatConfig
-from ..grid import initial_condition, np_dtype
+from ..grid import np_dtype
 from ..runtime import checkpoint
 from ..runtime.logging import master_print
 from ..runtime.timing import Timing
@@ -52,20 +52,17 @@ def step_ghost_np(T: np.ndarray, r: float, bc_value: float) -> np.ndarray:
 
 @register("serial")
 def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, **_) -> SolveResult:
+    from .common import load_or_init
+
     t_all0 = time.perf_counter()
     dt = np_dtype(cfg.dtype)
-    start_step = 0
-    if T0 is None and cfg.checkpoint_every:
-        ck = checkpoint.latest(cfg)
-        if ck is not None:
-            T0, start_step = checkpoint.load(ck, cfg)
-            master_print(f"resumed from {ck} at step {start_step}")
-    T = np.array(T0, dtype=dt) if T0 is not None else initial_condition(cfg)
+    T0_host, start_step = load_or_init(cfg, T0)
+    T = np.array(T0_host, dtype=dt)
     r = dt(cfg.r)
 
     t0 = time.perf_counter()
     for i in range(start_step + 1, cfg.ntime + 1):
-        if cfg.heartbeat_every and (i % cfg.heartbeat_every == 0 or i == 1):
+        if cfg.heartbeat_every and i % cfg.heartbeat_every == 0:
             master_print(" time_it:", i)  # fortran/serial/heat.f90:62
         if cfg.bc == "edges":
             T = step_edges_np(T, r)

@@ -102,4 +102,6 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None, **_) -> S
     T0_host, start_step = load_or_init(cfg, T0)
     sharding = NamedSharding(mesh, P(*mesh.axis_names))
     T = jax.device_put(jnp.asarray(T0_host).astype(dt), sharding)
-    return drive(cfg, T, make_advance(cfg, mesh), start_step=start_step)
+    res = drive(cfg, T, make_advance(cfg, mesh), start_step=start_step)
+    res.mesh_shape = tuple(mesh.devices.shape)
+    return res

@@ -1,0 +1,173 @@
+"""Command-line driver: the ``program heat`` analog.
+
+One CLI replaces the reference's seven compiled main programs while keeping
+their external contract: discover ``input.dat`` in the working directory,
+run the solve, write ``int.dat``/``soln.dat``, print the familiar stdout
+lines ("simulation completed!!!!", timing) —
+fortran/serial/heat.f90:11-13,50-55,73-83. The reference's build-time
+variant choice (which makefile target you compiled) becomes ``--backend`` /
+``--variant`` flags; its compile-time ``-DUSE_CUDA/-DNO_AWARE`` become
+``--comm``; ``SINGLE_PRECISION`` becomes ``--dtype``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .config import HeatConfig, VARIANTS, parse_input, variant_config
+from .grid import coords, initial_condition
+from .runtime.logging import master_print
+
+
+def _parse_mesh(s: str):
+    try:
+        return tuple(int(t) for t in s.lower().replace("x", " ").split())
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"mesh must look like '4x2', got {s!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="heat-tpu",
+        description="TPU-native heat-equation framework "
+        "(capability rebuild of CUDA-HIP-MPI-Heat-equation-test)",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="solve the heat equation (input.dat contract)")
+    run.add_argument("--input", default="input.dat",
+                     help="input.dat path: 'n sigma nu dom_len ntime [soln]'")
+    run.add_argument("--variant", choices=sorted(VARIANTS),
+                     help="reference-variant preset (sets ic/bc/backend/comm/dtype)")
+    run.add_argument("--backend", choices=["serial", "xla", "pallas", "sharded"])
+    run.add_argument("--dtype", choices=["float64", "float32", "bfloat16"])
+    run.add_argument("--ic", choices=["hat", "hat_half", "hat_small", "uniform", "zero"])
+    run.add_argument("--bc", choices=["edges", "ghost"])
+    run.add_argument("--bc-value", type=float)
+    run.add_argument("--ndim", type=int, choices=[2, 3])
+    run.add_argument("--comm", choices=["direct", "staged"],
+                     help="halo exchange: device-direct (CUDA-aware analog) "
+                          "or host-staged (NO_AWARE analog)")
+    run.add_argument("--mesh", type=_parse_mesh,
+                     help="device mesh shape, e.g. 4x2 (sharded backend)")
+    run.add_argument("--heartbeat-every", type=int,
+                     help="print 'time_it: i' every k steps (reference prints every step)")
+    run.add_argument("--report-sum", action="store_true",
+                     help="global temperature sum via psum (the reference's "
+                          "commented-out MPI_Reduce, made real)")
+    run.add_argument("--checkpoint-every", type=int)
+    run.add_argument("--checkpoint-dir")
+    run.add_argument("--write-int", action="store_true",
+                     help="dump the initial field to int.dat before solving")
+    run.add_argument("--out", default="soln.dat", help="solution file path")
+    run.add_argument("--soln", action="store_true",
+                     help="force solution dump even if input.dat flag is 0")
+    run.add_argument("--json", action="store_true",
+                     help="also print a machine-readable result line")
+
+    viz = sub.add_parser("viz", help="render a .dat file as a 3D surface")
+    viz.add_argument("datfile")
+    viz.add_argument("--save", default="sol.png")
+
+    info = sub.add_parser("info", help="show devices / native-lib status")  # noqa: F841
+    return p
+
+
+def _apply_overrides(cfg: HeatConfig, args) -> HeatConfig:
+    over = {}
+    for field in ("backend", "dtype", "ic", "bc", "ndim", "comm",
+                  "heartbeat_every", "checkpoint_every", "checkpoint_dir"):
+        v = getattr(args, field, None)
+        if v is not None:
+            over[field] = v
+    if args.bc_value is not None:
+        over["bc_value"] = args.bc_value
+    if args.mesh is not None:
+        over["mesh_shape"] = args.mesh
+    if args.report_sum:
+        over["report_sum"] = True
+    if args.soln:
+        over["soln"] = True
+    return cfg.with_(**over)
+
+
+def cmd_run(args) -> int:
+    path = Path(args.input)
+    if not path.exists():
+        print(f"error: {path} not found (expected 'n sigma nu dom_len ntime [soln]')",
+              file=sys.stderr)
+        return 2
+    cfg = parse_input(path)
+    if args.variant:
+        cfg = variant_config(args.variant, cfg)
+    cfg = _apply_overrides(cfg, args)
+
+    axes = coords(cfg)
+    if args.write_int:
+        from .io import write_int_dat
+
+        write_int_dat("int.dat", axes, initial_condition(cfg))
+
+    from .backends import solve  # deferred: import cost only when running
+
+    res = solve(cfg)
+    for line in res.timing.report_lines():
+        master_print(line)
+    if res.gsum is not None:
+        master_print(f"Sum of Temperature: {res.gsum:.10g}")
+
+    if cfg.soln:
+        from .io import write_soln, write_soln_blocks
+
+        if res.mesh_shape and any(s > 1 for s in res.mesh_shape):
+            # per-shard files, reference per-rank contract
+            files = write_soln_blocks(Path(args.out).parent or ".", axes,
+                                      res.T, res.mesh_shape)
+            master_print(f"wrote {len(files)} per-shard files "
+                         f"({files[0].name} .. {files[-1].name})")
+        write_soln(args.out, axes, res.T)
+        master_print(f"wrote {args.out}")
+
+    if args.json:
+        master_print(json.dumps({
+            "n": cfg.n, "ndim": cfg.ndim, "ntime": cfg.ntime,
+            "backend": cfg.backend, "dtype": cfg.dtype,
+            "solve_s": res.timing.solve_s,
+            "per_step_s": res.timing.per_step_s,
+            "points_per_s": res.timing.points_per_s,
+            "gsum": res.gsum,
+        }))
+    return 0
+
+
+def cmd_viz(args) -> int:
+    from .viz import render_dat
+
+    out = render_dat(args.datfile, args.save)
+    print(f"wrote {out}")
+    return 0
+
+
+def cmd_info(_args) -> int:
+    import jax
+
+    from .io.native import native_available
+
+    print(f"jax {jax.__version__}, backend={jax.default_backend()}")
+    print(f"devices: {jax.devices()}")
+    print(f"process {jax.process_index()}/{jax.process_count()}")
+    print(f"native fastio: {'available' if native_available() else 'unavailable (numpy fallback)'}")
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return {"run": cmd_run, "viz": cmd_viz, "info": cmd_info}[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,28 @@ def write_soln_sharded(directory, axes, T_sharded, mesh, prefix: str = "soln") -
     return written
 
 
+def write_soln_blocks(directory, axes, T: np.ndarray, mesh_shape,
+                      prefix: str = "soln") -> list:
+    """Per-shard solution files from the gathered host field: slice the
+    global array back into its mesh blocks and write one ``soln#####.dat``
+    per block — the single-process analog of the reference's per-rank dumps
+    (fortran/mpi+cuda/heat.F90:277-288), rank = linear mesh index."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    written = []
+    locals_per_dim = [T.shape[d] // mesh_shape[d] for d in range(len(mesh_shape))]
+    for coords in itertools.product(*[range(s) for s in mesh_shape]):
+        rank = int(np.ravel_multi_index(coords, mesh_shape))
+        sl = tuple(
+            slice(c * lp, (c + 1) * lp) for c, lp in zip(coords, locals_per_dim)
+        )
+        local_axes = tuple(ax[s] for ax, s in zip(axes, sl))
+        path = directory / f"{prefix}{rank:05d}.dat"
+        write_dat(path, local_axes, T[sl])
+        written.append(path)
+    return written
+
+
 def read_dat(path, ndim: int = 2):
     """Read a .dat file back into (axes, T). Assumes the square row-major
     layout the writers produce (matches fortran/serial/out.py:27-36)."""
@@ -84,10 +106,13 @@ def read_dat(path, ndim: int = 2):
     if ncols != ndim + 1:
         raise ValueError(f"{path}: expected {ndim + 1} columns, got {ncols}")
     npoints = table.shape[0]
-    n = round(npoints ** (1.0 / ndim))
-    if n**ndim != npoints:
-        raise ValueError(f"{path}: {npoints} lines is not a perfect {ndim}-cube")
-    shape = (n,) * ndim
+    # infer the grid extents from the coordinate columns (blocks from a
+    # rectangular decomposition need not be square)
+    shape = tuple(len(np.unique(table[:, d])) for d in range(ndim))
+    if int(np.prod(shape)) != npoints:
+        raise ValueError(
+            f"{path}: {npoints} lines inconsistent with inferred grid {shape}"
+        )
     T = table[:, -1].reshape(shape)
     axes = []
     for d in range(ndim):

@@ -45,11 +45,15 @@ def save(cfg: HeatConfig, T: np.ndarray, step: int) -> Path:
     return path
 
 
-def latest(cfg: HeatConfig) -> Optional[Path]:
+def latest(cfg: HeatConfig, max_step: Optional[int] = None) -> Optional[Path]:
+    """Newest checkpoint, optionally capped at ``max_step`` — resuming a run
+    whose ntime is *smaller* than an old checkpoint must not time-travel."""
     d = Path(cfg.checkpoint_dir)
     if not d.is_dir():
         return None
     cks = sorted(d.glob("heat_step*.npz"))
+    if max_step is not None:
+        cks = [c for c in cks if int(c.stem.replace("heat_step", "")) <= max_step]
     return cks[-1] if cks else None
 
 

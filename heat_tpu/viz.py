@@ -1,0 +1,48 @@
+"""Visualization: 3-D surface plots of .dat files.
+
+One shared renderer replacing the six near-identical ``init.py``/``out.py``
+copies in the reference (byte-identical across variants, SURVEY.md §1 L5).
+Same presentation so plots are visually comparable: matplotlib
+``plot_surface`` with viridis, x,y in [0,2], z in [1,2.5]
+(fortran/serial/out.py:37-41), saved to file (the mpi variant's ``sol.eps``
+behavior, fortran/mpi+cuda/out.py:45) rather than shown — headless-friendly.
+
+Because our .dat files keep the reference format, the reference's own
+``out.py`` continues to work on our output, and this module renders
+reference-produced files too.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from .io import read_dat
+
+
+def render_dat(path, save="sol.png", ndim: int = 2, zlim=(1.0, 2.5)):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from matplotlib import cm
+
+    if ndim != 2:
+        raise NotImplementedError("surface rendering is 2-D only (like the reference)")
+    axes, T = read_dat(path, ndim=2)
+    x, y = axes
+    X, Y = np.meshgrid(x, y, indexing="ij")
+    fig = plt.figure(figsize=(8, 6))
+    ax = fig.add_subplot(projection="3d")
+    ax.plot_surface(X, Y, T, rstride=1, cstride=1, cmap=cm.viridis,
+                    linewidth=0, antialiased=False)
+    ax.set_xlim(float(x.min()), float(x.max()))
+    ax.set_ylim(float(y.min()), float(y.max()))
+    ax.set_zlim(*zlim)
+    ax.set_xlabel("$x$")
+    ax.set_ylabel("$y$")
+    out = Path(save)
+    fig.savefig(out, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    return out

@@ -119,6 +119,27 @@ def test_torn_checkpoint_tmp_is_ignored(tmp_cwd):
     assert step == 4
 
 
+def test_resume_ignores_checkpoints_beyond_ntime(tmp_cwd):
+    """Re-running with a smaller ntime must not 'resume' from the future."""
+    cfg = HeatConfig(n=16, ntime=10, dtype="float64", backend="xla",
+                     checkpoint_every=5, checkpoint_dir=str(tmp_cwd / "ck"))
+    solve(cfg)  # saves steps 5 and 10
+    short = solve(cfg.with_(ntime=3))
+    assert short.start_step == 0 and short.timing.steps == 3
+    fresh = solve(cfg.with_(ntime=3, checkpoint_every=0))
+    np.testing.assert_array_equal(short.T, fresh.T)
+    # ntime=5 may legitimately reuse the step-5 checkpoint verbatim
+    at5 = solve(cfg.with_(ntime=5))
+    assert at5.start_step == 5 and at5.timing.steps == 0
+
+
+def test_gsum_identical_across_backends_f32():
+    cfg = HeatConfig(n=64, ntime=10, dtype="float32", report_sum=True)
+    sums = {b: solve(cfg.with_(backend=b)).gsum
+            for b in ("serial", "xla", "pallas")}
+    assert sums["serial"] == sums["xla"] == sums["pallas"]
+
+
 def test_checkpoint_rejects_mismatched_config(tmp_cwd):
     cfg = HeatConfig(n=16, ntime=4, backend="serial", dtype="float64",
                      checkpoint_every=2, checkpoint_dir=str(tmp_cwd / "ck"))
