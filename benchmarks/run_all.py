@@ -66,7 +66,6 @@ def main():
     from heat_tpu.config import HeatConfig
 
     s = args.smoke
-    ndev_ok = True
     try:
         import jax
 

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import HeatConfig
-from ..runtime import checkpoint
+from ..runtime import checkpoint, debug
 from ..runtime.logging import master_print
 from ..runtime.timing import Timing
 from . import SolveResult
@@ -67,17 +67,20 @@ def drive(
 
     t0 = time.perf_counter()
     step = start_step
-    while step < cfg.ntime:
-        k = min(chunk, cfg.ntime - step)
-        fn = compiled.get(k)
-        T_dev = fn(T_dev) if fn is not None else advance(T_dev, k)
-        step += k
-        if cfg.heartbeat_every and step % cfg.heartbeat_every == 0:
-            master_print(" time_it:", step)  # fortran/serial/heat.f90:62
-        if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
-            jax.block_until_ready(T_dev)
-            checkpoint.save(cfg, to_host(T_dev), step)
-    jax.block_until_ready(T_dev)
+    with debug.maybe_profile(cfg.profile_dir):
+        while step < cfg.ntime:
+            k = min(chunk, cfg.ntime - step)
+            fn = compiled.get(k)
+            T_dev = fn(T_dev) if fn is not None else advance(T_dev, k)
+            step += k
+            if cfg.check_numerics:
+                debug.check_finite(T_dev, step)
+            if cfg.heartbeat_every and step % cfg.heartbeat_every == 0:
+                master_print(" time_it:", step)  # fortran/serial/heat.f90:62
+            if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
+                jax.block_until_ready(T_dev)
+                checkpoint.save(cfg, to_host(T_dev), step)
+        jax.block_until_ready(T_dev)
     solve_s = time.perf_counter() - t0
 
     T_host = to_host(T_dev)

@@ -16,7 +16,7 @@ import numpy as np
 
 from ..config import HeatConfig
 from ..grid import np_dtype
-from ..runtime import checkpoint
+from ..runtime import checkpoint, debug
 from ..runtime.logging import master_print
 from ..runtime.timing import Timing
 from . import SolveResult, register
@@ -68,6 +68,9 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, **_) -> SolveResult:
             T = step_edges_np(T, r)
         else:
             T = step_ghost_np(T, r, dt(cfg.bc_value))
+        if cfg.check_numerics:
+            debug.check_finite(T, i)  # per step: name the blow-up step and
+                                      # never checkpoint a NaN field
         if cfg.checkpoint_every and i % cfg.checkpoint_every == 0:
             checkpoint.save(cfg, T, i)
     solve_s = time.perf_counter() - t0

@@ -25,9 +25,13 @@ from .runtime.logging import master_print
 
 def _parse_mesh(s: str):
     try:
-        return tuple(int(t) for t in s.lower().replace("x", " ").split())
+        dims = tuple(int(t) for t in s.lower().replace("x", " ").split())
     except ValueError:
-        raise argparse.ArgumentTypeError(f"mesh must look like '4x2', got {s!r}")
+        dims = ()
+    if not dims or any(d < 1 for d in dims):
+        raise argparse.ArgumentTypeError(
+            f"mesh must be positive dims like '4x2', got {s!r}")
+    return dims
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -54,6 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
                           "or host-staged (NO_AWARE analog)")
     run.add_argument("--mesh", type=_parse_mesh,
                      help="device mesh shape, e.g. 4x2 (sharded backend)")
+    run.add_argument("--fuse-steps", type=int,
+                     help="pallas temporal blocking depth (0=auto, 1=off)")
     run.add_argument("--heartbeat-every", type=int,
                      help="print 'time_it: i' every k steps (reference prints every step)")
     run.add_argument("--report-sum", action="store_true",
@@ -61,6 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "commented-out MPI_Reduce, made real)")
     run.add_argument("--checkpoint-every", type=int)
     run.add_argument("--checkpoint-dir")
+    run.add_argument("--profile", dest="profile_dir", metavar="DIR",
+                     help="write a jax.profiler trace of the solve to DIR")
+    run.add_argument("--check-numerics", action="store_true",
+                     help="detect NaN/Inf per chunk (debug; forces syncs)")
     run.add_argument("--write-int", action="store_true",
                      help="dump the initial field to int.dat before solving")
     run.add_argument("--out", default="soln.dat", help="solution file path")
@@ -79,8 +89,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def _apply_overrides(cfg: HeatConfig, args) -> HeatConfig:
     over = {}
-    for field in ("backend", "dtype", "ic", "bc", "ndim", "comm",
-                  "heartbeat_every", "checkpoint_every", "checkpoint_dir"):
+    for field in ("backend", "dtype", "ic", "bc", "ndim", "comm", "fuse_steps",
+                  "heartbeat_every", "checkpoint_every", "checkpoint_dir",
+                  "profile_dir"):
         v = getattr(args, field, None)
         if v is not None:
             over[field] = v
@@ -90,6 +101,8 @@ def _apply_overrides(cfg: HeatConfig, args) -> HeatConfig:
         over["mesh_shape"] = args.mesh
     if args.report_sum:
         over["report_sum"] = True
+    if args.check_numerics:
+        over["check_numerics"] = True
     if args.soln:
         over["soln"] = True
     return cfg.with_(**over)

@@ -59,6 +59,10 @@ class HeatConfig:
                                 # commented-out MPI_Reduce, mpi+cuda/heat.F90:266-273)
     checkpoint_every: int = 0   # periodic snapshot interval (0 = off)
     checkpoint_dir: str = "checkpoints"
+    profile_dir: Optional[str] = None  # jax.profiler trace output dir
+    check_numerics: bool = False  # per-chunk NaN/Inf detection (debug mode)
+    fuse_steps: int = 0         # pallas temporal blocking: FTCS steps fused
+                                # per kernel pass (0 = auto, 1 = off)
     parity_order: bool = False  # reference's update-then-swap step ordering
                                 # (mpi+cuda/heat.F90:209-218); equivalent for
                                 # shipped ICs, kept for bit-parity experiments
@@ -84,6 +88,8 @@ class HeatConfig:
         # experiments but reject nonsense outright, in every dimension.
         if self.sigma <= 0 or self.sigma > 10:
             raise ValueError(f"sigma out of range: {self.sigma}")
+        if self.fuse_steps < 0:
+            raise ValueError(f"fuse_steps must be >= 0, got {self.fuse_steps}")
 
     # --- derived quantities (fortran/serial/heat.f90:15-17,59) -------------
     @property

@@ -11,8 +11,20 @@ pipelined grid.
 Design notes:
 - Grid is 1-D over row tiles; each program sees its own tile plus the
   *clamped* previous/next tiles (three input BlockSpecs on the same array),
-  which supplies the one-row halo that the reference fetches via its ghost
-  ring. Column neighbors are in-tile shifts (full rows live in the block).
+  which supplies the row halo that the reference fetches via its ghost ring.
+  Column neighbors are in-tile shifts (full rows live in the block).
+- **Temporal blocking**: the 2D kernel runs ``ksteps`` FTCS steps per HBM
+  pass. One pass costs ~16 bytes/point (3 tile reads + 1 write); fusing k
+  steps amortizes that to ~16/k — the stencil analog of kernel fusion that
+  the reference's one-kernel-launch-per-step model cannot express
+  (fortran/cuda_kernel/heat.F90:30-34). Valid because a point's k-step
+  dependency cone spans rows within distance k <= tile, all inside the
+  3-tile band, and the frozen boundary ring is re-pinned after every
+  mini-step (which also walls off garbage from the clamped out-of-range
+  tiles at the first/last grid step).
+- **Arbitrary shapes**: inputs are padded to lane/tile alignment inside the
+  wrapper; padding cells are frozen (never read by logical cells beyond the
+  frozen logical boundary) and cropped on return.
 - The runtime constant ``r`` is baked into the kernel as a closure constant
   — the Pallas analog of the reference's Jinja2 constant-baking
   (python/cuda/cuda.py:85), with jit retrace standing in for re-render.
@@ -20,7 +32,8 @@ Design notes:
   ("bf16 stencil + fp32 accumulate" mode).
 - Boundary cells are masked back to their old value ("edges" BC) exactly
   like the in-kernel interior guard ``i/=1 .and. i/=ngrid`` of
-  fortran/cuda_kernel/heat.F90:49.
+  fortran/cuda_kernel/heat.F90:49; the Dirichlet-by-ghost ("ghost") BC is
+  the same kernel on a bc-padded array whose frozen ring IS the ghost ring.
 """
 
 from __future__ import annotations
@@ -44,34 +57,8 @@ def _sublane(dtype) -> int:
     return 16 if jnp.dtype(dtype) == jnp.bfloat16 else 8
 
 
-def _pick_row_tile(m: int, n: int, itemsize: int, sublane: int) -> Optional[int]:
-    """Largest divisor of m, multiple of the sublane count, fitting 8 tiles
-    of shape (tile, n) in the VMEM budget. None if no valid tile exists."""
-    cap = max(sublane, _VMEM_BUDGET_BYTES // (8 * n * itemsize))
-    best = None
-    t = sublane
-    while t <= min(m, cap):
-        if m % t == 0:
-            best = t
-        t += sublane
-    return best
-
-
-def _supported(shape, dtype) -> Optional[int]:
-    """Return the row tile if the Pallas path supports this problem."""
-    if jnp.dtype(dtype) == jnp.float64:
-        return None  # no f64 on the TPU vector unit; callers fall back to XLA
-    if len(shape) not in (2, 3):
-        return None
-    m, n = shape[0], shape[-1]
-    if n % 128 != 0:
-        return None
-    if len(shape) == 3 and shape[1] % _sublane(dtype) != 0:
-        return None
-    itemsize = jnp.dtype(dtype).itemsize
-    if len(shape) == 3:
-        itemsize *= shape[1]  # tiles are (t, mid, n)
-    return _pick_row_tile(m, n, itemsize, _sublane(dtype) if len(shape) == 2 else 1)
+def _round_up(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult
 
 
 def _interpret() -> bool:
@@ -93,40 +80,63 @@ def _ftcs_update(c, up, dn, extra_pairs, r):
     return (ca + jnp.asarray(r, acc_dt) * acc).astype(c.dtype)
 
 
-def _make_kernel_2d(r: float, m: int, n: int, tile: int):
+# --------------------------------------------------------------------------
+# 2D: unified single/multi-step kernel on arbitrary shapes
+# --------------------------------------------------------------------------
+
+
+def _tile_2d(n_pad: int, dtype, ksteps: int) -> int:
+    """Row-tile height: sublane-aligned, >= ksteps (dependency cone), sized
+    so ~8 tiles of (tile, n_pad) stay inside the VMEM budget."""
+    sub = _sublane(dtype)
+    cap = max(sub, (_VMEM_BUDGET_BYTES // (8 * n_pad * jnp.dtype(dtype).itemsize)))
+    cap = (cap // sub) * sub
+    tile = min(256, max(sub, cap))
+    return max(tile, _round_up(ksteps, sub))
+
+
+def _make_kernel_2d(r: float, m: int, n: int, tile: int, n_pad: int, ksteps: int):
     def kernel(prev_ref, cur_ref, next_ref, out_ref):
         i = pl.program_id(0)
-        g = pl.num_programs(0)
-        c = cur_ref[:]
-        # One-row halo from neighboring tiles (clamped index maps make the
-        # edge reads safe; their values are masked out below).
-        top_halo = jnp.where(i == 0, c[0:1, :], prev_ref[tile - 1 : tile, :])
-        bot_halo = jnp.where(i == g - 1, c[-1:, :], next_ref[0:1, :])
-        up = jnp.concatenate([top_halo, c[:-1, :]], axis=0)   # value at row j-1
-        dn = jnp.concatenate([c[1:, :], bot_halo], axis=0)    # value at row j+1
-        lf = jnp.concatenate([c[:, 0:1], c[:, :-1]], axis=1)  # value at col k-1
-        rt = jnp.concatenate([c[:, 1:], c[:, -1:]], axis=1)   # value at col k+1
-        new = _ftcs_update(c, up, dn, [(lf, rt)], r)
-        # Freeze the outermost cell ring (interior guard of
-        # fortran/cuda_kernel/heat.F90:49: i,j /= 1, ngrid).
-        grow = i * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, n), 0)
-        gcol = jax.lax.broadcasted_iota(jnp.int32, (tile, n), 1)
-        boundary = (grow == 0) | (grow == m - 1) | (gcol == 0) | (gcol == n - 1)
-        out_ref[:] = jnp.where(boundary, c, new)
+        band0 = jnp.concatenate([prev_ref[:], cur_ref[:], next_ref[:]], axis=0)
+        grow = (i - 1) * tile + jax.lax.broadcasted_iota(
+            jnp.int32, (3 * tile, n_pad), 0
+        )
+        gcol = jax.lax.broadcasted_iota(jnp.int32, (3 * tile, n_pad), 1)
+        # freeze the logical boundary ring plus all alignment padding (and,
+        # via <=0 / >=m-1, the garbage rows of clamped out-of-range tiles)
+        frozen = (grow <= 0) | (grow >= m - 1) | (gcol == 0) | (gcol >= n - 1)
+
+        def mini_step(band):
+            up = jnp.concatenate([band[0:1], band[:-1]], axis=0)
+            dn = jnp.concatenate([band[1:], band[-1:]], axis=0)
+            lf = jnp.concatenate([band[:, 0:1], band[:, :-1]], axis=1)
+            rt = jnp.concatenate([band[:, 1:], band[:, -1:]], axis=1)
+            new = _ftcs_update(band, up, dn, [(lf, rt)], r)
+            return jnp.where(frozen, band0, new)
+
+        band = band0
+        for _ in range(ksteps):  # static unroll
+            band = mini_step(band)
+        out_ref[:] = band[tile : 2 * tile]
 
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("r",))
-def _step_edges_pallas_2d(T: jax.Array, r: float) -> jax.Array:
+@functools.partial(jax.jit, static_argnames=("r", "ksteps"))
+def _pallas_2d(T: jax.Array, r: float, ksteps: int) -> jax.Array:
+    """``ksteps`` frozen-boundary FTCS steps on an arbitrary 2D array."""
     m, n = T.shape
-    tile = _supported(T.shape, T.dtype)
-    assert tile is not None
-    grid = (m // tile,)
-    spec = lambda imap: pl.BlockSpec((tile, n), imap, memory_space=pltpu.VMEM)
-    return pl.pallas_call(
-        _make_kernel_2d(float(r), m, n, tile),
-        out_shape=jax.ShapeDtypeStruct(T.shape, T.dtype),
+    n_pad = _round_up(max(n, 128), 128)
+    tile = _tile_2d(n_pad, T.dtype, ksteps)
+    m_pad = _round_up(max(m, tile), tile)
+    padded = (m_pad != m) or (n_pad != n)
+    Tp = jnp.pad(T, ((0, m_pad - m), (0, n_pad - n))) if padded else T
+    grid = (m_pad // tile,)
+    spec = lambda imap: pl.BlockSpec((tile, n_pad), imap, memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _make_kernel_2d(float(r), m, n, tile, n_pad, ksteps),
+        out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
         grid=grid,
         in_specs=[
             spec(lambda i: (jnp.maximum(i - 1, 0), 0)),
@@ -135,15 +145,41 @@ def _step_edges_pallas_2d(T: jax.Array, r: float) -> jax.Array:
         ],
         out_specs=spec(lambda i: (i, 0)),
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=2 * _VMEM_BUDGET_BYTES,
+            vmem_limit_bytes=8 * _VMEM_BUDGET_BYTES,
         ),
         cost_estimate=pl.CostEstimate(
-            flops=6 * m * n,
-            bytes_accessed=2 * m * n * T.dtype.itemsize,
+            flops=6 * m_pad * n_pad * ksteps * 3,
+            bytes_accessed=2 * m_pad * n_pad * Tp.dtype.itemsize,
             transcendentals=0,
         ),
         interpret=_interpret(),
-    )(T, T, T)
+    )(Tp, Tp, Tp)
+    return out[:m, :n] if padded else out
+
+
+# --------------------------------------------------------------------------
+# 3D: single-step kernel over plane tiles (aligned shapes only)
+# --------------------------------------------------------------------------
+
+
+def _supported_3d(shape, dtype) -> Optional[int]:
+    """Return the plane tile if the 3D kernel supports this problem."""
+    if jnp.dtype(dtype) == jnp.float64:
+        return None
+    if len(shape) != 3:
+        return None
+    m, mid, n = shape
+    if n % 128 != 0 or mid % _sublane(dtype) != 0:
+        return None
+    itemsize = jnp.dtype(dtype).itemsize * mid
+    cap = max(1, _VMEM_BUDGET_BYTES // (8 * n * itemsize))
+    best = None
+    t = 1
+    while t <= min(m, cap):
+        if m % t == 0:
+            best = t
+        t += 1
+    return best
 
 
 def _make_kernel_3d(r: float, m: int, mid: int, n: int, tile: int):
@@ -176,7 +212,7 @@ def _make_kernel_3d(r: float, m: int, mid: int, n: int, tile: int):
 @functools.partial(jax.jit, static_argnames=("r",))
 def _step_edges_pallas_3d(T: jax.Array, r: float) -> jax.Array:
     m, mid, n = T.shape
-    tile = _supported(T.shape, T.dtype)
+    tile = _supported_3d(T.shape, T.dtype)
     assert tile is not None
     grid = (m // tile,)
     spec = lambda imap: pl.BlockSpec((tile, mid, n), imap, memory_space=pltpu.VMEM)
@@ -202,8 +238,20 @@ def _step_edges_pallas_3d(T: jax.Array, r: float) -> jax.Array:
     )(T, T, T)
 
 
+# --------------------------------------------------------------------------
+# public entry points (with transparent XLA fallback)
+# --------------------------------------------------------------------------
+
+
 def pallas_available(shape, dtype) -> bool:
-    return _supported(tuple(shape), dtype) is not None
+    shape = tuple(shape)
+    if jnp.dtype(dtype) == jnp.float64:
+        return False  # no f64 on the TPU vector unit; callers fall back to XLA
+    if len(shape) == 2:
+        return True  # arbitrary 2D shapes via internal alignment padding
+    if len(shape) == 3:
+        return _supported_3d(shape, dtype) is not None
+    return False
 
 
 def ftcs_step_edges_pallas(T: jax.Array, r: float) -> jax.Array:
@@ -212,11 +260,11 @@ def ftcs_step_edges_pallas(T: jax.Array, r: float) -> jax.Array:
     if not pallas_available(T.shape, T.dtype):
         return ftcs_step_edges(T, r)
     if T.ndim == 2:
-        return _step_edges_pallas_2d(T, r=float(r))
+        return _pallas_2d(T, r=float(r), ksteps=1)
     return _step_edges_pallas_3d(T, r=float(r))
 
 
-def ftcs_step_ghost_pallas(T: jax.Array, r: float, bc_value: float) -> jax.Array:
+def ftcs_step_ghost_pallas(T: jax.Array, r: float, bc_value) -> jax.Array:
     """Ghost-BC step via Pallas: pad with the bc ring, run the edges kernel
     on the padded array (its frozen ring IS the ghost ring), crop."""
     padded = jnp.pad(T, 1, mode="constant",
@@ -224,8 +272,34 @@ def ftcs_step_ghost_pallas(T: jax.Array, r: float, bc_value: float) -> jax.Array
     if not pallas_available(padded.shape, padded.dtype):
         return ftcs_step_ghost(T, r, bc_value)
     if T.ndim == 2:
-        out = _step_edges_pallas_2d(padded, r=float(r))
+        out = _pallas_2d(padded, r=float(r), ksteps=1)
     else:
         out = _step_edges_pallas_3d(padded, r=float(r))
     ctr = tuple(slice(1, -1) for _ in range(T.ndim))
     return out[ctr]
+
+
+def ftcs_multistep_edges_pallas(T: jax.Array, r: float, ksteps: int) -> jax.Array:
+    """``ksteps`` frozen-boundary FTCS steps in one fused kernel pass, with
+    sequential fallback where the kernel doesn't apply."""
+    if T.ndim == 2 and pallas_available(T.shape, T.dtype):
+        return _pallas_2d(T, r=float(r), ksteps=ksteps)
+    out = T
+    for _ in range(ksteps):
+        out = ftcs_step_edges_pallas(out, r)
+    return out
+
+
+def ftcs_multistep_ghost_pallas(T: jax.Array, r: float, bc_value, ksteps: int) -> jax.Array:
+    """``ksteps`` ghost-BC steps fused: the padded array's frozen outer ring
+    IS the ghost ring, which never changes — so the edges multistep kernel on
+    the padded array is exactly k ghost-BC steps."""
+    if T.ndim == 2 and pallas_available(T.shape, T.dtype):
+        padded = jnp.pad(T, 1, mode="constant",
+                         constant_values=jnp.asarray(bc_value, T.dtype))
+        out = _pallas_2d(padded, r=float(r), ksteps=ksteps)
+        return out[1:-1, 1:-1]
+    out = T
+    for _ in range(ksteps):
+        out = ftcs_step_ghost_pallas(out, r, bc_value)
+    return out

@@ -1,0 +1,58 @@
+"""Multi-host initialization.
+
+The TPU-native analog of the reference's MPI world setup
+(``mpi_init``/``comm_rank``/``comm_size`` + per-node GPU binding,
+fortran/mpi+cuda/heat.F90:60-70): ``jax.distributed.initialize`` joins this
+process to the job; device binding is owned by the JAX runtime (no
+``cudaSetDevice`` analog needed). After initialization, ``jax.devices()``
+spans the whole job and the mesh/halo machinery works unchanged — shard_map
+collectives ride ICI within a slice and DCN across slices.
+
+On a single host this is a no-op; call ``init_distributed()`` early (before
+any backend use) when launching one process per host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..runtime.logging import get_logger
+
+_log = get_logger("heat_tpu.dist")
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-process JAX job (env-var driven when args are None).
+
+    Mirrors ``jax.distributed.initialize`` semantics: on TPU pods with no
+    args it auto-discovers from the runtime environment; elsewhere pass the
+    coordinator address and process ids (or set JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if explicit is None and jax.default_backend() != "tpu":
+        _log.info("single-process run (no coordinator configured)")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _log.info(
+        "joined distributed job: process %d/%d, %d local of %d global devices",
+        jax.process_index(), jax.process_count(),
+        len(jax.local_devices()), len(jax.devices()),
+    )
+
+
+def is_master() -> bool:
+    return jax.process_index() == 0

@@ -21,7 +21,7 @@ collective axis names.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
@@ -53,30 +53,39 @@ def halo_exchange(
     axis_sizes: Sequence[int],
     bc_value,
     staged: bool = False,
+    width: int = 1,
 ) -> jax.Array:
-    """Refresh the one-cell ghost ring of a padded local shard.
+    """Refresh a ``width``-cell ghost ring of a padded local shard.
 
-    ``padded`` has shape ``(nx+2, ny+2[, nz+2])``: owned cells in the
+    ``padded`` has shape ``(nx+2w, ny+2w[, nz+2w])``: owned cells in the
     interior, ghosts in the outer ring (the reference's
-    ``(1-ng:nx+ng, 1-ng:ny+ng)`` allocation, fortran/mpi+cuda/heat.F90:107).
-    For each decomposed axis the owned edge slabs travel to the neighbors'
-    ghost slots; at global domain edges ghosts hold ``bc_value`` (Dirichlet,
-    :243-251). Corner ghosts keep ``bc_value`` — the 5/7-point stencil never
-    reads them.
+    ``(1-ng:nx+ng, 1-ng:ny+ng)`` allocation with ng=1,
+    fortran/mpi+cuda/heat.F90:41,107; here ng is a parameter to support
+    communication-avoiding fused steps). For each decomposed axis the owned
+    edge slabs travel to the neighbors' ghost slots; at global domain edges
+    ghosts hold ``bc_value`` (Dirichlet, :243-251).
+
+    Axes are exchanged **sequentially with full-extent slabs**: the slab for
+    axis d spans the entire padded extent of every other axis, so later-axis
+    exchanges forward the ghosts just received — after all axes, corner
+    ghost regions hold true diagonal-neighbor data (needed by fused
+    multi-step updates; a single 5/7-point step never reads corners, so
+    ng=1 behavior is unchanged).
     """
     nd = padded.ndim
+    w = width
     bc = jnp.asarray(bc_value, padded.dtype)
     out = padded
     for d, (name, size) in enumerate(zip(axis_names, axis_sizes)):
         idx = lax.axis_index(name)
 
-        def owned_slab(pos):
-            sl = [slice(1, -1)] * nd
-            sl[d] = slice(pos, pos + 1)
-            return out[tuple(sl)]
+        def slab(sl_d):
+            sl = [slice(None)] * nd
+            sl[d] = sl_d
+            return tuple(sl)
 
-        send_lo = owned_slab(1)        # my first owned plane  -> prev's high ghost
-        send_hi = owned_slab(-2)       # my last owned plane   -> next's low ghost
+        send_lo = out[slab(slice(w, 2 * w))]       # first owned planes -> prev
+        send_hi = out[slab(slice(-2 * w, -w))]     # last owned planes  -> next
         if staged:
             send_lo = _stage_through_host(send_lo)
             send_hi = _stage_through_host(send_hi)
@@ -90,32 +99,14 @@ def halo_exchange(
         from_prev = jnp.where(idx == 0, bc, from_prev)
         from_next = jnp.where(idx == size - 1, bc, from_next)
 
-        lo_ghost = [slice(1, -1)] * nd
-        hi_ghost = [slice(1, -1)] * nd
-        lo_ghost[d] = slice(0, 1)
-        hi_ghost[d] = slice(-1, None)
-        out = out.at[tuple(lo_ghost)].set(from_prev)
-        out = out.at[tuple(hi_ghost)].set(from_next)
+        out = out.at[slab(slice(0, w))].set(from_prev)
+        out = out.at[slab(slice(-w, None))].set(from_next)
     return out
 
 
-def halo_pad(local: jax.Array, bc_value) -> jax.Array:
+def halo_pad(local: jax.Array, bc_value, width: int = 1) -> jax.Array:
     """Allocate the ghost ring around an owned shard (ghosts = bc_value)."""
-    return jnp.pad(local, 1, mode="constant",
+    return jnp.pad(local, width, mode="constant",
                    constant_values=jnp.asarray(bc_value, local.dtype))
 
 
-def global_cell_index(
-    local_shape: Tuple[int, ...],
-    axis_names: Sequence[str],
-) -> Tuple[jax.Array, ...]:
-    """Global (row, col, ...) index arrays for the owned cells of a shard —
-    the analog of locating a rank by its cartesian coords
-    (fortran/mpi+cuda/heat.F90:134-137)."""
-    idxs = []
-    for d, name in enumerate(axis_names):
-        coord = lax.axis_index(name)
-        base = coord * local_shape[d]
-        iota = lax.broadcasted_iota(jnp.int32, local_shape, d)
-        idxs.append(base + iota)
-    return tuple(idxs)

@@ -1,0 +1,39 @@
+"""Numerics checking and profiler hooks.
+
+The reference has no sanitizer story beyond hard device syncs after every
+kernel (fortran/hip/heat.F90:207,220,225,246) — races are impossible in
+XLA's functional model, so the debug mode that actually matters on TPU is
+*numerics*: catching NaN/Inf blow-ups (e.g. sigma above the FTCS stability
+bound) at the step where they appear instead of in the final output.
+Profiling upgrades the reference's two wall-clock timers (SURVEY.md §5) to
+a real trace (``jax.profiler``) viewable in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: Optional[str]):
+    """Wrap a region in a jax.profiler trace when a directory is given."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+def check_finite(T, step: int, label: str = "field") -> None:
+    """Raise with step context if the field has NaN/Inf (device or host array)."""
+    import numpy as np
+
+    ok = bool(np.isfinite(np.asarray(T).astype(np.float32)).all())
+    if not ok:
+        raise FloatingPointError(
+            f"non-finite values in {label} at step {step} — check the CFL "
+            f"bound sigma <= 1/(2*ndim) and the fuse/halo configuration"
+        )

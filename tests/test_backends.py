@@ -54,14 +54,23 @@ def test_3d_backends(backend):
     np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=1e-14)
 
 
-def test_pallas_tileable_shape_uses_kernel():
-    """On a 128-multiple grid the Pallas path must actually engage."""
+def test_pallas_availability():
     from heat_tpu.ops.pallas_stencil import pallas_available
 
     assert pallas_available((256, 256), np.float32)
+    assert pallas_available((100, 100), np.float32)      # internal padding
+    assert pallas_available((130, 130), np.float32)      # ghost-padded sizes
     assert pallas_available((256, 128, 128), np.float32)
-    assert not pallas_available((100, 100), np.float32)   # -> XLA fallback
-    assert not pallas_available((256, 256), np.float64)   # no f64 on TPU VPU
+    assert not pallas_available((100, 100, 100), np.float32)  # 3D unaligned
+    assert not pallas_available((256, 256), np.float64)  # no f64 on TPU VPU
+
+
+def test_pallas_on_unaligned_shape_matches_oracle():
+    """Non-128-multiple grids run through the kernel via padding."""
+    cfg = HeatConfig(n=100, ntime=9, dtype="float32", ic="hat")
+    expect = solve(cfg.with_(backend="serial"))
+    got = solve(cfg.with_(backend="pallas", fuse_steps=4))
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=5e-6)
 
 
 def test_pallas_kernel_on_tileable_shape():
@@ -69,6 +78,62 @@ def test_pallas_kernel_on_tileable_shape():
     expect = solve(cfg.with_(backend="xla"))
     got = solve(cfg.with_(backend="pallas"))
     np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=1e-6)
+
+
+def test_multistep_kernel_matches_sequential():
+    """Temporal blocking must be step-for-step identical to sequential."""
+    import jax.numpy as jnp
+
+    from heat_tpu.ops.pallas_stencil import (
+        ftcs_multistep_edges_pallas,
+        ftcs_multistep_ghost_pallas,
+        ftcs_step_edges_pallas,
+        ftcs_step_ghost_pallas,
+    )
+    from heat_tpu.grid import initial_condition
+
+    cfg = HeatConfig(n=128, dtype="float32", ic="hat")
+    T = jnp.asarray(initial_condition(cfg), jnp.float32)
+    seq = T
+    for _ in range(4):
+        seq = ftcs_step_edges_pallas(seq, cfg.r)
+    fused = ftcs_multistep_edges_pallas(T, cfg.r, 4)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(seq),
+                               rtol=0, atol=1e-6)
+
+    seq_g = T
+    for _ in range(3):
+        seq_g = ftcs_step_ghost_pallas(seq_g, cfg.r, 1.0)
+    fused_g = ftcs_multistep_ghost_pallas(T, cfg.r, 1.0, 3)
+    np.testing.assert_allclose(np.asarray(fused_g), np.asarray(seq_g),
+                               rtol=0, atol=1e-6)
+
+
+@pytest.mark.parametrize("bc,ic", [("edges", "hat"), ("ghost", "uniform")])
+def test_pallas_backend_with_fusion_matches_oracle(bc, ic):
+    cfg = HeatConfig(n=128, ntime=21, dtype="float32", ic=ic, bc=bc,
+                     backend="pallas", fuse_steps=4)  # 5 fused passes + 1
+    expect = solve(cfg.with_(backend="serial"))
+    got = solve(cfg)
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=5e-6)
+
+
+def test_multistep_fallback_when_k_exceeds_tile():
+    import jax.numpy as jnp
+
+    from heat_tpu.ops.pallas_stencil import (
+        ftcs_multistep_edges_pallas,
+        ftcs_step_edges_pallas,
+    )
+
+    T = jnp.ones((16, 128), jnp.float32).at[8, 60:70].set(2.0)
+    # tile for a 16-row grid is at most 16 < 32 -> sequential fallback
+    fused = ftcs_multistep_edges_pallas(T, 0.25, 32)
+    seq = T
+    for _ in range(32):
+        seq = ftcs_step_edges_pallas(seq, 0.25)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(seq),
+                               rtol=0, atol=1e-6)
 
 
 def test_heartbeat_and_zero_steps():

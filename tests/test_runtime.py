@@ -1,0 +1,75 @@
+"""Debug/observability subsystems: numerics checking, profiling, dist init,
+timing report."""
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig
+from heat_tpu.runtime.debug import check_finite, maybe_profile
+from heat_tpu.runtime.timing import Timing
+
+
+def test_check_finite_passes_on_good_field():
+    check_finite(np.ones((4, 4)), step=3)
+
+
+def test_check_finite_raises_with_step_context():
+    bad = np.ones((4, 4))
+    bad[2, 2] = np.nan
+    with pytest.raises(FloatingPointError, match="step 7"):
+        check_finite(bad, step=7)
+
+
+def test_unstable_sigma_detected_by_check_numerics():
+    """sigma far above the FTCS bound blows up; debug mode names the step."""
+    cfg = HeatConfig(n=32, ntime=200, sigma=2.0, dtype="float32",
+                     backend="xla", check_numerics=True, heartbeat_every=10)
+    with pytest.raises(FloatingPointError):
+        solve(cfg)
+    # serial backend path too (checks at end)
+    with pytest.raises(FloatingPointError):
+        solve(cfg.with_(backend="serial", heartbeat_every=0))
+
+
+def test_stable_run_with_check_numerics_is_clean():
+    cfg = HeatConfig(n=32, ntime=20, dtype="float32", backend="xla",
+                     check_numerics=True)
+    res = solve(cfg)
+    assert np.isfinite(res.T).all()
+
+
+def test_maybe_profile_writes_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    with maybe_profile(str(tmp_path / "trace")):
+        jax.block_until_ready(jnp.ones((8, 8)) * 2)
+    assert any((tmp_path / "trace").rglob("*"))
+
+
+def test_maybe_profile_none_is_noop():
+    with maybe_profile(None):
+        pass
+
+
+def test_profile_flag_through_solve(tmp_path):
+    cfg = HeatConfig(n=32, ntime=4, dtype="float32", backend="xla",
+                     profile_dir=str(tmp_path / "prof"))
+    solve(cfg)
+    assert any((tmp_path / "prof").rglob("*"))
+
+
+def test_init_distributed_single_process_noop():
+    from heat_tpu.parallel.dist import init_distributed, is_master
+
+    init_distributed()  # CPU, no coordinator: must be a clean no-op
+    assert is_master()
+
+
+def test_timing_report_lines():
+    t = Timing(total_s=2.0, compile_s=0.5, solve_s=1.0, steps=10, points=100)
+    lines = t.report_lines()
+    assert lines[0] == "simulation completed!!!!"
+    assert any("Average time per timestep: 0.1" in l for l in lines)
+    assert t.points_per_s == pytest.approx(1000.0)

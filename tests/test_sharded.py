@@ -50,6 +50,26 @@ def test_sharded_matches_serial_ghost(mesh_shape):
     np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=0)
 
 
+@pytest.mark.parametrize("bc,ic", [("edges", "hat"), ("ghost", "uniform")])
+def test_fused_halo_matches_per_step_exchange(bc, ic):
+    """Communication-avoiding wide-halo steps == every-step exchange, exactly."""
+    cfg = BASE.with_(mesh_shape=(2, 4), bc=bc, ic=ic, ntime=11)
+    per_step = solve(cfg.with_(fuse_steps=1))
+    fused = solve(cfg.with_(fuse_steps=4))  # 2 fused exchanges + remainder 3
+    np.testing.assert_allclose(fused.T, per_step.T, rtol=0, atol=0)
+    serial = solve(cfg.with_(backend="serial"))
+    np.testing.assert_allclose(fused.T, serial.T, rtol=0, atol=0)
+
+
+def test_fuse_depth_capped_by_local_extent():
+    from heat_tpu.backends.sharded import fuse_depth_sharded
+
+    cfg = BASE.with_(fuse_steps=0)          # auto -> want 8
+    assert fuse_depth_sharded(cfg, (8, 1)) == 4   # local 4 rows caps it
+    assert fuse_depth_sharded(cfg, (2, 2)) == 8
+    assert fuse_depth_sharded(cfg.with_(fuse_steps=3), (2, 2)) == 3
+
+
 def test_sharded_staged_comm_matches_direct():
     """NO_AWARE staged path == CUDA-aware path numerically
     (fortran/mpi+cuda/heat.F90:162-172: same data, different route)."""
