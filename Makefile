@@ -1,0 +1,34 @@
+# Convenience targets mirroring the reference's per-variant makefiles
+# (fortran/*/makefile: main/init/out/clean) in one place.
+
+PY ?= python
+
+.PHONY: test bench bench-all weak-scaling native run viz clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+bench-all:
+	$(PY) benchmarks/run_all.py
+
+bench-smoke:
+	$(PY) benchmarks/run_all.py --smoke
+
+weak-scaling:
+	$(PY) benchmarks/weak_scaling.py --virtual 8
+
+native:
+	$(MAKE) -C heat_tpu/io/native
+
+run:            # ≙ the reference's `make main && ./a.out`
+	$(PY) -m heat_tpu run
+
+viz:            # ≙ the reference's `make out` (plot soln.dat)
+	$(PY) -m heat_tpu viz soln.dat
+
+clean:
+	rm -rf __pycache__ .pytest_cache checkpoints
+	$(MAKE) -C heat_tpu/io/native clean

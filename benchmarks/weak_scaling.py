@@ -1,0 +1,99 @@
+"""Weak-scaling harness: constant work per device, growing mesh.
+
+The BASELINE.md north star is >=90% weak-scaling efficiency at 32768^2 on a
+v5p-32 pod. This harness measures efficiency = T(1 device) / T(N devices)
+at constant per-device grid volume, sweeping mesh shapes. On real pods run
+it as-is (devices come from the job); without hardware, ``--virtual N``
+exercises the identical sharded code path on N virtual CPU devices —
+correctness-grade, not perf-grade, like the reference's single-node
+``mpirun -np N`` development mode (fortran/mpi+cuda/makefile:1-2).
+
+Writes ``benchmarks/weak_scaling.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--virtual", type=int, default=0,
+                    help="use N virtual CPU devices (no hardware needed)")
+    ap.add_argument("--local-n", type=int, default=0,
+                    help="per-device grid side (default: 1024 real, 64 virtual)")
+    ap.add_argument("--steps", type=int, default=0)
+    ap.add_argument("--dtype", default="float32")
+    args = ap.parse_args()
+
+    import os
+
+    if args.virtual:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.virtual}"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from heat_tpu.backends import solve
+    from heat_tpu.config import HeatConfig
+    from heat_tpu.parallel.mesh import auto_mesh_shape
+
+    ndev_total = len(jax.devices())
+    local_n = args.local_n or (64 if args.virtual else 1024)
+    steps = args.steps or (10 if args.virtual else 200)
+
+    sweep = []
+    d = 1
+    while d <= ndev_total:
+        sweep.append(d)
+        d *= 2
+
+    rows = []
+    for ndev in sweep:
+        mesh_shape = auto_mesh_shape(ndev, 2)
+        n = local_n * max(mesh_shape)  # keep shards square-ish & divisible
+        for s in mesh_shape:
+            assert n % s == 0
+        cfg = HeatConfig(n=n, ntime=steps, dtype=args.dtype,
+                         backend="sharded", mesh_shape=mesh_shape)
+        res = solve(cfg)
+        per_step = res.timing.per_step_s
+        # weak efficiency compares seconds per (point/device): constant under
+        # perfect scaling as the global grid grows with the mesh
+        pts_per_dev = n * n / ndev
+        t_norm = per_step / pts_per_dev  # seconds per (point/device)
+        rows.append({
+            "devices": ndev, "mesh": list(mesh_shape), "n": n,
+            "per_step_s": per_step,
+            "points_per_s_total": res.timing.points_per_s,
+            "s_per_point_per_device": t_norm,
+        })
+        print(f"{ndev:3d} devices mesh {mesh_shape}: n={n:6d} "
+              f"per-step {per_step * 1e6:9.1f} us  "
+              f"{res.timing.points_per_s:.3e} pts/s")
+
+    base = rows[0]["s_per_point_per_device"]
+    for row in rows:
+        row["weak_efficiency"] = base / row["s_per_point_per_device"]
+        print(f"{row['devices']:3d} devices: weak efficiency "
+              f"{100 * row['weak_efficiency']:.1f}%")
+
+    out = Path(__file__).parent / "weak_scaling.json"
+    out.write_text(json.dumps({"ts": time.time(),
+                               "platform": jax.default_backend(),
+                               "rows": rows}, indent=2))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -3,8 +3,9 @@
 The hand-written-kernel variant: analog of the reference's explicit CUDA
 Fortran kernel (fortran/cuda_kernel/heat.F90) and the HIP C++ kernels
 (fortran/hip/heat_kernel.cpp). Shares the chunked driver with the XLA
-backend; only the per-step kernel differs. Falls back to the XLA step for
-shapes the kernel doesn't tile (non-128-multiple columns, f64).
+backend; only the per-step kernel differs. Arbitrary grid shapes run
+through the kernel via internal alignment padding; only f64 (unsupported on
+the TPU vector unit) falls back to the XLA step.
 """
 
 from __future__ import annotations
@@ -37,8 +38,8 @@ _AUTO_FUSE = 8
 def fuse_depth(cfg: HeatConfig) -> int:
     if cfg.fuse_steps:
         return cfg.fuse_steps
-    if cfg.ndim == 2 and cfg.dtype != "float64":
-        return _AUTO_FUSE
+    if cfg.dtype != "float64":
+        return _AUTO_FUSE  # 3D chunks itself down to what VMEM affords
     return 1
 
 

@@ -39,7 +39,6 @@ Design notes:
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -158,67 +157,78 @@ def _pallas_2d(T: jax.Array, r: float, ksteps: int) -> jax.Array:
 
 
 # --------------------------------------------------------------------------
-# 3D: single-step kernel over plane tiles (aligned shapes only)
+# 3D: plane-tiled kernel, arbitrary shapes, temporal blocking within VMEM
 # --------------------------------------------------------------------------
 
 
-def _supported_3d(shape, dtype) -> Optional[int]:
-    """Return the plane tile if the 3D kernel supports this problem."""
-    if jnp.dtype(dtype) == jnp.float64:
-        return None
-    if len(shape) != 3:
-        return None
-    m, mid, n = shape
-    if n % 128 != 0 or mid % _sublane(dtype) != 0:
-        return None
-    itemsize = jnp.dtype(dtype).itemsize * mid
-    cap = max(1, _VMEM_BUDGET_BYTES // (8 * n * itemsize))
-    best = None
-    t = 1
-    while t <= min(m, cap):
-        if m % t == 0:
-            best = t
-        t += 1
-    return best
+def _tile_3d(mid_pad: int, n_pad: int, dtype) -> int:
+    """Planes per tile, sized so ~8 tiles of (tile, mid_pad, n_pad) fit the
+    VMEM budget, capped at 8. The fusion invariant ksteps <= tile is owned
+    by _pallas_3d's assert and _multistep's chunking."""
+    plane = mid_pad * n_pad * jnp.dtype(dtype).itemsize
+    cap = max(1, _VMEM_BUDGET_BYTES // (8 * plane))
+    return max(1, min(8, cap))
 
 
-def _make_kernel_3d(r: float, m: int, mid: int, n: int, tile: int):
+def _make_kernel_3d(r: float, shape_logical, tile: int, shape_pad, ksteps: int):
+    m, mid, n = shape_logical
+    _, mid_p, n_p = shape_pad
+
     def kernel(prev_ref, cur_ref, next_ref, out_ref):
         i = pl.program_id(0)
-        g = pl.num_programs(0)
-        c = cur_ref[:]
-        top_halo = jnp.where(i == 0, c[0:1], prev_ref[tile - 1 : tile])
-        bot_halo = jnp.where(i == g - 1, c[-1:], next_ref[0:1])
-        up = jnp.concatenate([top_halo, c[:-1]], axis=0)
-        dn = jnp.concatenate([c[1:], bot_halo], axis=0)
-        fw = jnp.concatenate([c[:, 0:1, :], c[:, :-1, :]], axis=1)
-        bk = jnp.concatenate([c[:, 1:, :], c[:, -1:, :]], axis=1)
-        lf = jnp.concatenate([c[:, :, 0:1], c[:, :, :-1]], axis=2)
-        rt = jnp.concatenate([c[:, :, 1:], c[:, :, -1:]], axis=2)
-        new = _ftcs_update(c, up, dn, [(fw, bk), (lf, rt)], r)
-        grow = i * tile + jax.lax.broadcasted_iota(jnp.int32, (tile, mid, n), 0)
-        gmid = jax.lax.broadcasted_iota(jnp.int32, (tile, mid, n), 1)
-        gcol = jax.lax.broadcasted_iota(jnp.int32, (tile, mid, n), 2)
-        boundary = (
-            (grow == 0) | (grow == m - 1)
-            | (gmid == 0) | (gmid == mid - 1)
-            | (gcol == 0) | (gcol == n - 1)
+        band0 = jnp.concatenate([prev_ref[:], cur_ref[:], next_ref[:]], axis=0)
+        bshape = (3 * tile, mid_p, n_p)
+        grow = (i - 1) * tile + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
+        gmid = jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
+        gcol = jax.lax.broadcasted_iota(jnp.int32, bshape, 2)
+        frozen = (
+            (grow <= 0) | (grow >= m - 1)
+            | (gmid == 0) | (gmid >= mid - 1)
+            | (gcol == 0) | (gcol >= n - 1)
         )
-        out_ref[:] = jnp.where(boundary, c, new)
+
+        def mini_step(band):
+            up = jnp.concatenate([band[0:1], band[:-1]], axis=0)
+            dn = jnp.concatenate([band[1:], band[-1:]], axis=0)
+            fw = jnp.concatenate([band[:, 0:1, :], band[:, :-1, :]], axis=1)
+            bk = jnp.concatenate([band[:, 1:, :], band[:, -1:, :]], axis=1)
+            lf = jnp.concatenate([band[:, :, 0:1], band[:, :, :-1]], axis=2)
+            rt = jnp.concatenate([band[:, :, 1:], band[:, :, -1:]], axis=2)
+            new = _ftcs_update(band, up, dn, [(fw, bk), (lf, rt)], r)
+            return jnp.where(frozen, band0, new)
+
+        band = band0
+        for _ in range(ksteps):  # static unroll
+            band = mini_step(band)
+        out_ref[:] = band[tile : 2 * tile]
 
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("r",))
-def _step_edges_pallas_3d(T: jax.Array, r: float) -> jax.Array:
-    m, mid, n = T.shape
-    tile = _supported_3d(T.shape, T.dtype)
-    assert tile is not None
-    grid = (m // tile,)
-    spec = lambda imap: pl.BlockSpec((tile, mid, n), imap, memory_space=pltpu.VMEM)
+def _aligned_shape_3d(shape, dtype):
+    m, mid, n = shape
+    n_pad = _round_up(max(n, 128), 128)
+    mid_pad = _round_up(max(mid, _sublane(dtype)), _sublane(dtype))
+    tile = _tile_3d(mid_pad, n_pad, dtype)
+    m_pad = _round_up(max(m, tile), tile)
+    return (m_pad, mid_pad, n_pad), tile
+
+
+@functools.partial(jax.jit, static_argnames=("r", "ksteps", "logical_shape"))
+def _pallas_3d_aligned(Tp: jax.Array, r: float, ksteps: int,
+                       logical_shape) -> jax.Array:
+    """``ksteps`` frozen-boundary FTCS steps on an already tile-aligned 3D
+    array whose logical (unpadded) extents are ``logical_shape``. ksteps
+    must not exceed the plane tile (callers chunk; see _multistep)."""
+    (m_pad, mid_pad, n_pad), tile = _aligned_shape_3d(logical_shape, Tp.dtype)
+    assert Tp.shape == (m_pad, mid_pad, n_pad) and ksteps <= tile
+    m, mid, n = logical_shape
+    grid = (m_pad // tile,)
+    spec = lambda imap: pl.BlockSpec((tile, mid_pad, n_pad), imap,
+                                     memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        _make_kernel_3d(float(r), m, mid, n, tile),
-        out_shape=jax.ShapeDtypeStruct(T.shape, T.dtype),
+        _make_kernel_3d(float(r), (m, mid, n), tile, Tp.shape, ksteps),
+        out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
         grid=grid,
         in_specs=[
             spec(lambda i: (jnp.maximum(i - 1, 0), 0, 0)),
@@ -227,15 +237,21 @@ def _step_edges_pallas_3d(T: jax.Array, r: float) -> jax.Array:
         ],
         out_specs=spec(lambda i: (i, 0, 0)),
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=4 * _VMEM_BUDGET_BYTES,
+            vmem_limit_bytes=8 * _VMEM_BUDGET_BYTES,
         ),
         cost_estimate=pl.CostEstimate(
-            flops=8 * m * mid * n,
-            bytes_accessed=2 * m * mid * n * T.dtype.itemsize,
+            flops=8 * m_pad * mid_pad * n_pad * ksteps * 3,
+            bytes_accessed=2 * m_pad * mid_pad * n_pad * Tp.dtype.itemsize,
             transcendentals=0,
         ),
         interpret=_interpret(),
-    )(T, T, T)
+    )(Tp, Tp, Tp)
+
+
+def max_fuse_3d(shape, dtype) -> int:
+    """Largest temporal-blocking depth the 3D kernel affords for this shape."""
+    _, tile = _aligned_shape_3d(shape, dtype)
+    return tile
 
 
 # --------------------------------------------------------------------------
@@ -244,49 +260,55 @@ def _step_edges_pallas_3d(T: jax.Array, r: float) -> jax.Array:
 
 
 def pallas_available(shape, dtype) -> bool:
+    """Arbitrary 2D/3D shapes are supported via internal alignment padding;
+    only f64 (no TPU VPU support) falls back to XLA."""
     shape = tuple(shape)
     if jnp.dtype(dtype) == jnp.float64:
-        return False  # no f64 on the TPU vector unit; callers fall back to XLA
-    if len(shape) == 2:
-        return True  # arbitrary 2D shapes via internal alignment padding
-    if len(shape) == 3:
-        return _supported_3d(shape, dtype) is not None
-    return False
+        return False
+    return len(shape) in (2, 3)
+
+
+def _multistep(T: jax.Array, r: float, ksteps: int) -> jax.Array:
+    """Dispatch ksteps fused frozen-boundary steps, chunking 3D fusion down
+    to what VMEM affords (pad/crop hoisted outside the chunk loop)."""
+    if T.ndim == 2:
+        return _pallas_2d(T, r=float(r), ksteps=ksteps)
+    logical = tuple(T.shape)
+    aligned, kmax = _aligned_shape_3d(logical, T.dtype)
+    if aligned != logical:
+        T = jnp.pad(T, [(0, p - s) for p, s in zip(aligned, logical)])
+    done = 0
+    while done < ksteps:
+        k = min(kmax, ksteps - done)
+        T = _pallas_3d_aligned(T, r=float(r), ksteps=k, logical_shape=logical)
+        done += k
+    if aligned != logical:
+        T = T[: logical[0], : logical[1], : logical[2]]
+    return T
 
 
 def ftcs_step_edges_pallas(T: jax.Array, r: float) -> jax.Array:
     """One frozen-boundary FTCS step via the Pallas kernel, with transparent
-    XLA fallback for shapes/dtypes the kernel doesn't cover."""
+    XLA fallback for dtypes the kernel doesn't cover."""
     if not pallas_available(T.shape, T.dtype):
         return ftcs_step_edges(T, r)
-    if T.ndim == 2:
-        return _pallas_2d(T, r=float(r), ksteps=1)
-    return _step_edges_pallas_3d(T, r=float(r))
+    return _multistep(T, r, 1)
 
 
 def ftcs_step_ghost_pallas(T: jax.Array, r: float, bc_value) -> jax.Array:
     """Ghost-BC step via Pallas: pad with the bc ring, run the edges kernel
     on the padded array (its frozen ring IS the ghost ring), crop."""
-    padded = jnp.pad(T, 1, mode="constant",
-                     constant_values=jnp.asarray(bc_value, T.dtype))
-    if not pallas_available(padded.shape, padded.dtype):
-        return ftcs_step_ghost(T, r, bc_value)
-    if T.ndim == 2:
-        out = _pallas_2d(padded, r=float(r), ksteps=1)
-    else:
-        out = _step_edges_pallas_3d(padded, r=float(r))
-    ctr = tuple(slice(1, -1) for _ in range(T.ndim))
-    return out[ctr]
+    return ftcs_multistep_ghost_pallas(T, r, bc_value, 1)
 
 
 def ftcs_multistep_edges_pallas(T: jax.Array, r: float, ksteps: int) -> jax.Array:
-    """``ksteps`` frozen-boundary FTCS steps in one fused kernel pass, with
-    sequential fallback where the kernel doesn't apply."""
-    if T.ndim == 2 and pallas_available(T.shape, T.dtype):
-        return _pallas_2d(T, r=float(r), ksteps=ksteps)
+    """``ksteps`` frozen-boundary FTCS steps in fused kernel passes, with
+    sequential XLA fallback where the kernel doesn't apply."""
+    if pallas_available(T.shape, T.dtype):
+        return _multistep(T, r, ksteps)
     out = T
     for _ in range(ksteps):
-        out = ftcs_step_edges_pallas(out, r)
+        out = ftcs_step_edges(out, r)
     return out
 
 
@@ -294,12 +316,13 @@ def ftcs_multistep_ghost_pallas(T: jax.Array, r: float, bc_value, ksteps: int) -
     """``ksteps`` ghost-BC steps fused: the padded array's frozen outer ring
     IS the ghost ring, which never changes — so the edges multistep kernel on
     the padded array is exactly k ghost-BC steps."""
-    if T.ndim == 2 and pallas_available(T.shape, T.dtype):
+    if pallas_available(T.shape, T.dtype):
         padded = jnp.pad(T, 1, mode="constant",
                          constant_values=jnp.asarray(bc_value, T.dtype))
-        out = _pallas_2d(padded, r=float(r), ksteps=ksteps)
-        return out[1:-1, 1:-1]
+        out = _multistep(padded, r, ksteps)
+        ctr = tuple(slice(1, -1) for _ in range(T.ndim))
+        return out[ctr]
     out = T
     for _ in range(ksteps):
-        out = ftcs_step_ghost_pallas(out, r, bc_value)
+        out = ftcs_step_ghost(out, r, bc_value)
     return out

@@ -61,8 +61,27 @@ def test_pallas_availability():
     assert pallas_available((100, 100), np.float32)      # internal padding
     assert pallas_available((130, 130), np.float32)      # ghost-padded sizes
     assert pallas_available((256, 128, 128), np.float32)
-    assert not pallas_available((100, 100, 100), np.float32)  # 3D unaligned
+    assert pallas_available((100, 100, 100), np.float32)  # 3D padding too
     assert not pallas_available((256, 256), np.float64)  # no f64 on TPU VPU
+
+
+def test_pallas_3d_multistep_matches_sequential():
+    import jax.numpy as jnp
+
+    from heat_tpu.grid import initial_condition
+    from heat_tpu.ops.pallas_stencil import (
+        ftcs_multistep_edges_pallas,
+        ftcs_step_edges_pallas,
+    )
+
+    cfg = HeatConfig(n=24, ndim=3, dtype="float32", ic="hat", sigma=0.15)
+    T = jnp.asarray(initial_condition(cfg), jnp.float32)
+    seq = T
+    for _ in range(5):
+        seq = ftcs_step_edges_pallas(seq, cfg.r)
+    fused = ftcs_multistep_edges_pallas(T, cfg.r, 5)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(seq),
+                               rtol=0, atol=1e-6)
 
 
 def test_pallas_on_unaligned_shape_matches_oracle():
