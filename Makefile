@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test bench bench-all weak-scaling native run viz clean
+.PHONY: test bench bench-all bench-smoke weak-scaling native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q

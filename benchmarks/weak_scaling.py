@@ -58,10 +58,16 @@ def main():
         sweep.append(d)
         d *= 2
 
+    import math
+
     rows = []
     for ndev in sweep:
         mesh_shape = auto_mesh_shape(ndev, 2)
-        n = local_n * max(mesh_shape)  # keep shards square-ish & divisible
+        # constant per-device volume: n^2 = local_n^2 * ndev, rounded to a
+        # multiple of lcm(mesh) so shards divide evenly (non-square device
+        # counts land within ~2% of local_n^2 per device)
+        mult = math.lcm(*mesh_shape)
+        n = max(mult, round(local_n * math.sqrt(ndev) / mult) * mult)
         for s in mesh_shape:
             assert n % s == 0
         cfg = HeatConfig(n=n, ntime=steps, dtype=args.dtype,

@@ -22,7 +22,7 @@ import numpy as np
 from ..config import HeatConfig
 from ..runtime import checkpoint, debug
 from ..runtime.logging import master_print
-from ..runtime.timing import Timing
+from ..runtime.timing import Timing, sync
 from . import SolveResult
 
 
@@ -78,9 +78,9 @@ def drive(
             if cfg.heartbeat_every and step % cfg.heartbeat_every == 0:
                 master_print(" time_it:", step)  # fortran/serial/heat.f90:62
             if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
-                jax.block_until_ready(T_dev)
+                sync(T_dev)
                 checkpoint.save(cfg, to_host(T_dev), step)
-        jax.block_until_ready(T_dev)
+        sync(T_dev)
     solve_s = time.perf_counter() - t0
 
     T_host = to_host(T_dev)

@@ -24,10 +24,25 @@ def now() -> float:
 
 def sync(x: Any) -> Any:
     """Block until device work producing x is done (== cudaDeviceSynchronize
-    + MPI_BARRIER before reading the clock, fortran/mpi+cuda/heat.F90:262-264)."""
-    import jax
+    + MPI_BARRIER before reading the clock, fortran/mpi+cuda/heat.F90:262-264).
 
-    return jax.block_until_ready(x)
+    ``jax.block_until_ready`` alone is NOT sufficient on every platform: on
+    the tunneled single-chip ``axon`` platform it returns while work is still
+    queued, which silently inflates throughput numbers by orders of
+    magnitude. A 1-element device->host fetch is the only reliable fence, so
+    we slice one scalar out of the first array leaf (a few bytes over the
+    wire — the full-buffer fetch can be seconds on a tunnel)."""
+    import jax
+    import numpy as np
+
+    x = jax.block_until_ready(x)
+    for leaf in jax.tree_util.tree_leaves(x):
+        # indexing would raise on a multi-host array spanning non-addressable
+        # devices; there block_until_ready is a real fence already
+        if isinstance(leaf, jax.Array) and leaf.size and leaf.is_fully_addressable:
+            np.asarray(leaf[(0,) * leaf.ndim])
+            break
+    return x
 
 
 @dataclasses.dataclass
