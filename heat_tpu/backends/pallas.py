@@ -66,8 +66,15 @@ def make_advance(cfg: HeatConfig):
 
 
 @register("pallas")
-def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, **_) -> SolveResult:
+def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None,
+          fetch: bool = True, warm_exec: bool = False, **_) -> SolveResult:
     dt = jnp_dtype(cfg.dtype)
-    T0_host, start_step = load_or_init(cfg, T0)
-    T = jax.device_put(jnp.asarray(T0_host).astype(dt))
-    return drive(cfg, T, make_advance(cfg), start_step=start_step)
+    T0_host, start_step = load_or_init(cfg, T0, default_ic=False)
+    if T0_host is None:
+        from ..grid import initial_condition_device
+
+        T = initial_condition_device(cfg)
+    else:
+        T = jax.device_put(jnp.asarray(T0_host).astype(dt))
+    return drive(cfg, T, make_advance(cfg), start_step=start_step, fetch=fetch,
+                 warm_exec=warm_exec)

@@ -39,6 +39,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from ..config import HeatConfig
+from ..ops.pallas_stencil import ftcs_multistep_bounded_pallas, pallas_available
 from ..ops.stencil import accum_dtype_for, laplacian_interior
 from ..parallel.halo import halo_exchange, halo_pad
 from ..parallel.mesh import build_mesh, validate_divisible
@@ -62,7 +63,42 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
     staged = cfg.comm == "staged"
     n = cfg.n
 
+    kernel_ok = pallas_available((cfg.n,) * cfg.ndim, jnp_dtype(cfg.dtype))
+    if cfg.local_kernel == "pallas" and not kernel_ok:
+        raise ValueError(
+            f"local_kernel='pallas' does not support dtype={cfg.dtype!r} "
+            f"(no f64 on the TPU VPU); use local_kernel='xla' or 'auto'")
+    use_pallas = cfg.local_kernel == "pallas" or (
+        cfg.local_kernel == "auto"
+        and jax.default_backend() == "tpu"
+        and kernel_ok
+    )
+
+    def local_multi_pallas(local: jax.Array, w: int) -> jax.Array:
+        # per-shard fast path: one width-w exchange, then w steps fused in
+        # the Pallas kernel. Only global-domain edges freeze (the bounds);
+        # the w-cell discard margin owns all array-edge garbage — the same
+        # dependency-cone invariant as the XLA path below.
+        padded0 = halo_exchange(
+            halo_pad(local, bc_value, w), axis_names, axis_sizes, bc_value,
+            staged=staged, width=w,
+        )
+        edges = 1 if cfg.bc == "edges" else 0
+        bounds = []
+        for d, name in enumerate(axis_names):
+            coord = jax.lax.axis_index(name)
+            M = local.shape[d] + 2 * w
+            bounds.append(jnp.where(coord == 0, w - 1 + edges, -1))
+            bounds.append(jnp.where(coord == axis_sizes[d] - 1,
+                                    M - w - edges, M))
+        out = ftcs_multistep_bounded_pallas(
+            padded0, r, w, jnp.stack(bounds).astype(jnp.int32))
+        ctr = tuple(slice(w, -w) for _ in range(out.ndim))
+        return out[ctr]
+
     def local_multi(local: jax.Array, w: int) -> jax.Array:
+        if use_pallas:
+            return local_multi_pallas(local, w)
         acc_dt = accum_dtype_for(local.dtype)
         rr = jnp.asarray(r, acc_dt)
         padded0 = halo_exchange(
@@ -139,15 +175,22 @@ def make_advance(cfg: HeatConfig, mesh):
 
 
 @register("sharded")
-def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None, **_) -> SolveResult:
+def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
+          fetch: bool = True, warm_exec: bool = False, **_) -> SolveResult:
     dt = jnp_dtype(cfg.dtype)
     mesh = mesh or build_mesh(cfg.ndim, cfg.mesh_shape)
     validate_divisible(cfg.n, mesh)
     master_print(f"Automatic mesh decomposition: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
-    T0_host, start_step = load_or_init(cfg, T0)
     sharding = NamedSharding(mesh, P(*mesh.axis_names))
-    T = jax.device_put(jnp.asarray(T0_host).astype(dt), sharding)
-    res = drive(cfg, T, make_advance(cfg, mesh), start_step=start_step)
+    T0_host, start_step = load_or_init(cfg, T0, default_ic=False)
+    if T0_host is None:
+        from ..grid import initial_condition_device
+
+        T = initial_condition_device(cfg, sharding=sharding)
+    else:
+        T = jax.device_put(jnp.asarray(T0_host).astype(dt), sharding)
+    res = drive(cfg, T, make_advance(cfg, mesh), start_step=start_step, fetch=fetch,
+                 warm_exec=warm_exec)
     res.mesh_shape = tuple(mesh.devices.shape)
     return res

@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="device mesh shape, e.g. 4x2 (sharded backend)")
     run.add_argument("--fuse-steps", type=int,
                      help="pallas temporal blocking depth (0=auto, 1=off)")
+    run.add_argument("--local-kernel", choices=["auto", "xla", "pallas"],
+                     help="sharded per-shard compute kernel "
+                          "(auto = pallas on TPU, xla elsewhere)")
     run.add_argument("--heartbeat-every", type=int,
                      help="print 'time_it: i' every k steps (reference prints every step)")
     run.add_argument("--report-sum", action="store_true",
@@ -90,8 +93,8 @@ def build_parser() -> argparse.ArgumentParser:
 def _apply_overrides(cfg: HeatConfig, args) -> HeatConfig:
     over = {}
     for field in ("backend", "dtype", "ic", "bc", "ndim", "comm", "fuse_steps",
-                  "heartbeat_every", "checkpoint_every", "checkpoint_dir",
-                  "profile_dir"):
+                  "local_kernel", "heartbeat_every", "checkpoint_every",
+                  "checkpoint_dir", "profile_dir"):
         v = getattr(args, field, None)
         if v is not None:
             over[field] = v

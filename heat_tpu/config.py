@@ -26,6 +26,7 @@ _BACKENDS = ("serial", "xla", "pallas", "sharded")
 _BCS = ("edges", "ghost")
 _ICS = ("hat", "hat_half", "hat_small", "uniform", "zero")
 _COMMS = ("direct", "staged")
+_LOCAL_KERNELS = ("auto", "xla", "pallas")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +54,8 @@ class HeatConfig:
                                 # "ghost": Dirichlet-by-ghost ring (MPI semantics)
     bc_value: float = 1.0       # boundary temperature
     comm: str = "direct"        # halo exchange: direct ICI ppermute vs host-staged
+    local_kernel: str = "auto"  # sharded per-shard compute: auto (pallas on
+                                # TPU, xla elsewhere), or forced
     mesh_shape: Optional[Tuple[int, ...]] = None  # device mesh; None = auto
     heartbeat_every: int = 0    # print "time_it: i" every k steps (0 = off)
     report_sum: bool = False    # global temperature sum (the reference's
@@ -84,6 +87,9 @@ class HeatConfig:
             raise ValueError(f"ic must be one of {_ICS}, got {self.ic!r}")
         if self.comm not in _COMMS:
             raise ValueError(f"comm must be one of {_COMMS}, got {self.comm!r}")
+        if self.local_kernel not in _LOCAL_KERNELS:
+            raise ValueError(
+                f"local_kernel must be one of {_LOCAL_KERNELS}, got {self.local_kernel!r}")
         # FTCS stability wants sigma <= 1/(2*ndim); allow mildly unstable
         # experiments but reject nonsense outright, in every dimension.
         if self.sigma <= 0 or self.sigma > 10:

@@ -60,8 +60,10 @@ from .stencil import accum_dtype_for, ftcs_step_edges, ftcs_step_ghost
 # the unrolled mini-step chain's live temporaries fit alongside the
 # double-buffered pipeline.
 _VMEM_LIMIT_BYTES = 100 * 1024 * 1024
-# target in-kernel band footprint (accumulation dtype)
-_BAND_BUDGET_BYTES = 6 * 1024 * 1024
+# target in-kernel band footprint (accumulation dtype); measured on v5e:
+# 6 MiB caps 32768^2 bf16 at 69 Gpts/s (16-row tiles, 3x halo-compute
+# overhead), 12 MiB doubles it to 135 Gpts/s (64-row tiles)
+_BAND_BUDGET_BYTES = 12 * 1024 * 1024
 # per-pass fusion cap: halo rows (and compile-time unroll) stay bounded;
 # measured throughput is flat past 16
 _KMAX_2D = 32
@@ -87,20 +89,24 @@ def _halo_2d(ksteps: int, dtype) -> int:
     return _round_up(max(ksteps, 1), _sublane(dtype))
 
 
-def _tile_2d(n_pad: int, dtype, kpad: int) -> int:
+def _tile_2d(n_pad: int, kpad: int) -> int:
     """Row-tile height: a multiple of kpad (so halo blocks index evenly),
-    sized to keep the (tile + 2*kpad)-row f32 band near the budget."""
-    acc_item = 4  # band is held in the accumulation dtype
-    cap = _BAND_BUDGET_BYTES // (n_pad * acc_item) - 2 * kpad
+    sized to keep the (tile + 2*kpad)-row band near the budget (the band is
+    held in the f32 accumulation dtype regardless of storage dtype)."""
+    cap = _BAND_BUDGET_BYTES // (n_pad * 4) - 2 * kpad
     tile = min(256, max(cap, kpad))
     return max(kpad, (tile // kpad) * kpad)
 
 
-def _make_kernel_2d(r: float, m: int, n: int, tile: int, kpad: int,
-                    n_pad: int, ksteps: int):
+def _make_kernel_2d(r: float, tile: int, kpad: int, n_pad: int, ksteps: int):
+    """Kernel body. ``bounds_ref`` is an SMEM (1,4) i32 array
+    [row_lo, row_hi, col_lo, col_hi]: cells with index <= lo or >= hi on
+    either axis are frozen. For a plain solve that is the boundary ring
+    (0, m-1, 0, n-1); the sharded backend passes per-shard values so only
+    global-domain edges freeze (see ftcs_multistep_bounded_pallas)."""
     rows = tile + 2 * kpad
 
-    def kernel(prev_ref, cur_ref, next_ref, out_ref):
+    def kernel(bounds_ref, prev_ref, cur_ref, next_ref, out_ref):
         i = pl.program_id(0)
         store_dt = out_ref.dtype
         acc_dt = accum_dtype_for(store_dt)
@@ -111,11 +117,10 @@ def _make_kernel_2d(r: float, m: int, n: int, tile: int, kpad: int,
             jnp.int32, (rows, n_pad), 0
         )
         gcol = jax.lax.broadcasted_iota(jnp.int32, (rows, n_pad), 1)
-        # freeze the logical boundary ring plus all alignment padding; the
-        # clamped out-of-range halo blocks at the first/last grid step hold
-        # garbage, but it is only ever read by frozen (grow<=0 / >=m-1)
-        # rows, so it cannot propagate
-        frozen = (grow <= 0) | (grow >= m - 1) | (gcol == 0) | (gcol >= n - 1)
+        frozen = (
+            (grow <= bounds_ref[0, 0]) | (grow >= bounds_ref[0, 1])
+            | (gcol <= bounds_ref[0, 2]) | (gcol >= bounds_ref[0, 3])
+        )
         maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
 
         for _ in range(ksteps):  # static unroll
@@ -130,13 +135,21 @@ def _make_kernel_2d(r: float, m: int, n: int, tile: int, kpad: int,
 
 
 @functools.partial(jax.jit, static_argnames=("r", "ksteps"))
-def _pallas_2d(T: jax.Array, r: float, ksteps: int) -> jax.Array:
-    """``ksteps`` frozen-boundary FTCS steps on an arbitrary 2D array.
+def _pallas_2d(T: jax.Array, r: float, ksteps: int,
+               bounds: jax.Array | None = None) -> jax.Array:
+    """``ksteps`` FTCS steps on an arbitrary 2D array, freezing cells at or
+    beyond ``bounds`` (default: the boundary ring — "edges" semantics).
     ksteps must not exceed _KMAX_2D (callers chunk; see _multistep)."""
     m, n = T.shape
+    if bounds is None:
+        bounds = jnp.asarray([[0, m - 1, 0, n - 1]], jnp.int32)
+        # with the boundary ring frozen, garbage in the clamped out-of-range
+        # halo blocks of the first/last grid step is only read by frozen
+        # rows; custom bounds callers own a discard margin >= ksteps instead
+    bounds = bounds.reshape(1, 4).astype(jnp.int32)
     n_pad = _round_up(max(n, 128), 128)
     kpad = _halo_2d(ksteps, T.dtype)
-    tile = _tile_2d(n_pad, T.dtype, kpad)
+    tile = _tile_2d(n_pad, kpad)
     assert ksteps <= kpad <= tile and tile % kpad == 0
     m_pad = _round_up(max(m, tile), tile)
     padded = (m_pad != m) or (n_pad != n)
@@ -144,13 +157,15 @@ def _pallas_2d(T: jax.Array, r: float, ksteps: int) -> jax.Array:
     grid = (m_pad // tile,)
     ratio = tile // kpad
     nhblk = m_pad // kpad
+    smem = pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=pltpu.SMEM)
     halo = lambda imap: pl.BlockSpec((kpad, n_pad), imap, memory_space=pltpu.VMEM)
     main = lambda imap: pl.BlockSpec((tile, n_pad), imap, memory_space=pltpu.VMEM)
     out = pl.pallas_call(
-        _make_kernel_2d(float(r), m, n, tile, kpad, n_pad, ksteps),
+        _make_kernel_2d(float(r), tile, kpad, n_pad, ksteps),
         out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
         grid=grid,
         in_specs=[
+            smem,
             halo(lambda i: (jnp.maximum(i * ratio - 1, 0), 0)),
             main(lambda i: (i, 0)),
             halo(lambda i: (jnp.minimum((i + 1) * ratio, nhblk - 1), 0)),
@@ -166,7 +181,7 @@ def _pallas_2d(T: jax.Array, r: float, ksteps: int) -> jax.Array:
             transcendentals=0,
         ),
         interpret=_interpret(),
-    )(Tp, Tp, Tp)
+    )(bounds, Tp, Tp, Tp)
     return out[:m, :n] if padded else out
 
 
@@ -184,12 +199,13 @@ def _tile_3d(mid_pad: int, n_pad: int, dtype) -> int:
     return max(1, min(8, cap))
 
 
-def _make_kernel_3d(r: float, shape_logical, tile: int, shape_pad, ksteps: int):
-    m, mid, n = shape_logical
+def _make_kernel_3d(r: float, tile: int, shape_pad, ksteps: int):
+    """Kernel body; ``bounds_ref`` is SMEM (1,6) i32
+    [row_lo, row_hi, mid_lo, mid_hi, col_lo, col_hi] (see 2D)."""
     _, mid_p, n_p = shape_pad
     rows = 3 * tile
 
-    def kernel(prev_ref, cur_ref, next_ref, out_ref):
+    def kernel(bounds_ref, prev_ref, cur_ref, next_ref, out_ref):
         i = pl.program_id(0)
         store_dt = out_ref.dtype
         acc_dt = accum_dtype_for(store_dt)
@@ -201,9 +217,9 @@ def _make_kernel_3d(r: float, shape_logical, tile: int, shape_pad, ksteps: int):
         gmid = jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
         gcol = jax.lax.broadcasted_iota(jnp.int32, bshape, 2)
         frozen = (
-            (grow <= 0) | (grow >= m - 1)
-            | (gmid == 0) | (gmid >= mid - 1)
-            | (gcol == 0) | (gcol >= n - 1)
+            (grow <= bounds_ref[0, 0]) | (grow >= bounds_ref[0, 1])
+            | (gmid <= bounds_ref[0, 2]) | (gmid >= bounds_ref[0, 3])
+            | (gcol <= bounds_ref[0, 4]) | (gcol >= bounds_ref[0, 5])
         )
         maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
 
@@ -231,21 +247,27 @@ def _aligned_shape_3d(shape, dtype):
 
 @functools.partial(jax.jit, static_argnames=("r", "ksteps", "logical_shape"))
 def _pallas_3d_aligned(Tp: jax.Array, r: float, ksteps: int,
-                       logical_shape) -> jax.Array:
-    """``ksteps`` frozen-boundary FTCS steps on an already tile-aligned 3D
-    array whose logical (unpadded) extents are ``logical_shape``. ksteps
-    must not exceed the plane tile (callers chunk; see _multistep)."""
+                       logical_shape, bounds: jax.Array | None = None) -> jax.Array:
+    """``ksteps`` FTCS steps on an already tile-aligned 3D array whose
+    logical (unpadded) extents are ``logical_shape``, freezing cells at or
+    beyond ``bounds`` (default: the boundary shell). ksteps must not exceed
+    the plane tile (callers chunk; see _multistep)."""
     (m_pad, mid_pad, n_pad), tile = _aligned_shape_3d(logical_shape, Tp.dtype)
     assert Tp.shape == (m_pad, mid_pad, n_pad) and ksteps <= tile
     m, mid, n = logical_shape
+    if bounds is None:
+        bounds = jnp.asarray([[0, m - 1, 0, mid - 1, 0, n - 1]], jnp.int32)
+    bounds = bounds.reshape(1, 6).astype(jnp.int32)
     grid = (m_pad // tile,)
+    smem = pl.BlockSpec((1, 6), lambda i: (0, 0), memory_space=pltpu.SMEM)
     spec = lambda imap: pl.BlockSpec((tile, mid_pad, n_pad), imap,
                                      memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        _make_kernel_3d(float(r), (m, mid, n), tile, Tp.shape, ksteps),
+        _make_kernel_3d(float(r), tile, Tp.shape, ksteps),
         out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
         grid=grid,
         in_specs=[
+            smem,
             spec(lambda i: (jnp.maximum(i - 1, 0), 0, 0)),
             spec(lambda i: (i, 0, 0)),
             spec(lambda i: (jnp.minimum(i + 1, grid[0] - 1), 0, 0)),
@@ -260,7 +282,7 @@ def _pallas_3d_aligned(Tp: jax.Array, r: float, ksteps: int,
             transcendentals=0,
         ),
         interpret=_interpret(),
-    )(Tp, Tp, Tp)
+    )(bounds, Tp, Tp, Tp)
 
 
 # --------------------------------------------------------------------------
@@ -277,14 +299,15 @@ def pallas_available(shape, dtype) -> bool:
     return len(shape) in (2, 3)
 
 
-def _multistep(T: jax.Array, r: float, ksteps: int) -> jax.Array:
+def _multistep(T: jax.Array, r: float, ksteps: int,
+               bounds: jax.Array | None = None) -> jax.Array:
     """Dispatch ksteps fused frozen-boundary steps, chunking fusion down to
     what each kernel's dependency-cone bound affords."""
     if T.ndim == 2:
         done = 0
         while done < ksteps:
             k = min(_KMAX_2D, ksteps - done)
-            T = _pallas_2d(T, r=float(r), ksteps=k)
+            T = _pallas_2d(T, r=float(r), ksteps=k, bounds=bounds)
             done += k
         return T
     logical = tuple(T.shape)
@@ -294,11 +317,28 @@ def _multistep(T: jax.Array, r: float, ksteps: int) -> jax.Array:
     done = 0
     while done < ksteps:
         k = min(kmax, ksteps - done)
-        T = _pallas_3d_aligned(T, r=float(r), ksteps=k, logical_shape=logical)
+        T = _pallas_3d_aligned(T, r=float(r), ksteps=k, logical_shape=logical,
+                               bounds=bounds)
         done += k
     if aligned != logical:
         T = T[: logical[0], : logical[1], : logical[2]]
     return T
+
+
+def ftcs_multistep_bounded_pallas(T: jax.Array, r: float, ksteps: int,
+                                  bounds: jax.Array) -> jax.Array:
+    """``ksteps`` fused FTCS steps freezing cells at or beyond ``bounds``
+    (i32 [lo, hi] pair per dimension, flattened; may be traced values —
+    e.g. computed from ``lax.axis_index`` inside shard_map).
+
+    Contract: cells NOT frozen by ``bounds`` include array-edge cells whose
+    out-of-range neighbors are garbage (wrap rotates / clamped halo blocks),
+    so the caller MUST own a discard margin >= ksteps on every non-frozen
+    side — exactly the halo-width invariant of the sharded backend's
+    communication-avoiding exchange (one width-k exchange buys k steps).
+    """
+    assert pallas_available(T.shape, T.dtype), (T.shape, T.dtype)
+    return _multistep(T, r, ksteps, bounds=jnp.asarray(bounds, jnp.int32))
 
 
 def ftcs_step_edges_pallas(T: jax.Array, r: float) -> jax.Array:

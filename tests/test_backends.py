@@ -166,3 +166,48 @@ def test_heartbeat_and_zero_steps():
 def test_unknown_backend():
     with pytest.raises(KeyError):
         get_backend("nccl")
+
+
+def test_warm_exec_and_fetch_flags():
+    cfg = HeatConfig(n=48, ntime=8, dtype="float32", backend="xla")
+    plain = solve(cfg)
+    warm = solve(cfg, warm_exec=True)
+    np.testing.assert_allclose(warm.T, plain.T, rtol=0, atol=0)
+    nofetch = solve(cfg, fetch=False)
+    assert nofetch.T is None
+    assert nofetch.timing.solve_s > 0
+
+
+def test_bounded_pallas_kernel_contract():
+    """Bounded kernel with a discard margin >= ksteps reproduces the plain
+    frozen-ring kernel on the interior it owns."""
+    import jax.numpy as jnp
+
+    from heat_tpu.ops.pallas_stencil import (
+        _multistep,
+        ftcs_multistep_bounded_pallas,
+    )
+
+    rng = np.random.default_rng(3)
+    w = 4
+    T = jnp.asarray(rng.random((40, 40)), jnp.float32)
+    # reference: plain kernel freezes ring-1 of the same array
+    ref = _multistep(T, 0.2, w)
+    bounds = jnp.asarray([0, 39, 0, 39], jnp.int32)
+    got = ftcs_multistep_bounded_pallas(T, 0.2, w, bounds)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=0, atol=0)
+    # freeze-nothing bounds: only the interior >= w cells from every edge is
+    # trustworthy (the caller's discard margin)
+    open_bounds = jnp.asarray([-1, 40, -1, 40], jnp.int32)
+    got2 = ftcs_multistep_bounded_pallas(T, 0.2, w, open_bounds)
+    # compare against w unconstrained FTCS steps computed densely in numpy
+    dense = np.asarray(T, np.float64)
+    for _ in range(w):
+        nxt = dense.copy()
+        nxt[1:-1, 1:-1] = dense[1:-1, 1:-1] + 0.2 * (
+            dense[2:, 1:-1] + dense[:-2, 1:-1] + dense[1:-1, 2:]
+            + dense[1:-1, :-2] - 4 * dense[1:-1, 1:-1])
+        dense = nxt
+    inner = slice(w, -w)
+    np.testing.assert_allclose(np.asarray(got2)[inner, inner],
+                               dense[inner, inner], rtol=0, atol=2e-6)

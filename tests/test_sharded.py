@@ -109,3 +109,47 @@ def test_sharded_f32_and_bf16():
 def test_mesh_too_large_rejected():
     with pytest.raises(ValueError):
         build_mesh(2, (16, 16))
+
+
+@pytest.mark.parametrize("bc", ["edges", "ghost"])
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (1, 1)])
+def test_sharded_pallas_local_kernel_matches_serial(bc, mesh_shape):
+    """The per-shard Pallas fast path (bounded frozen region, interpret mode
+    on CPU) must match the serial oracle like the XLA path does."""
+    cfg = BASE.with_(mesh_shape=mesh_shape, bc=bc, ic="hat", dtype="float32",
+                     local_kernel="pallas", fuse_steps=3)
+    expect = solve(cfg.with_(backend="serial", mesh_shape=None,
+                             local_kernel="auto"))
+    got = solve(cfg)
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=2e-6)
+
+
+def test_sharded_pallas_matches_xla_local_kernel():
+    cfg = BASE.with_(mesh_shape=(2, 4), bc="ghost", ic="uniform",
+                     dtype="float32", fuse_steps=4)
+    x = solve(cfg.with_(local_kernel="xla"))
+    p = solve(cfg.with_(local_kernel="pallas"))
+    np.testing.assert_allclose(p.T, x.T, rtol=0, atol=2e-6)
+
+
+def test_sharded_pallas_3d_matches_serial():
+    cfg = HeatConfig(n=16, ndim=3, ntime=6, dtype="float32", sigma=1 / 6,
+                     backend="sharded", mesh_shape=(2, 2, 2), bc="ghost",
+                     ic="hat", local_kernel="pallas", fuse_steps=2)
+    expect = solve(cfg.with_(backend="serial", mesh_shape=None))
+    got = solve(cfg)
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=2e-6)
+
+
+def test_sharded_pallas_f64_rejected_loudly():
+    cfg = BASE.with_(mesh_shape=(2, 2), local_kernel="pallas", dtype="float64")
+    with pytest.raises(ValueError, match="local_kernel='pallas'"):
+        solve(cfg)
+
+
+def test_report_sum_survives_fetch_false():
+    cfg = BASE.with_(mesh_shape=(2, 2), report_sum=True, dtype="float32")
+    fetched = solve(cfg)
+    nofetch = solve(cfg, fetch=False)
+    assert nofetch.gsum is not None
+    np.testing.assert_allclose(nofetch.gsum, fetched.gsum, rtol=1e-6)
