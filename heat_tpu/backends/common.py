@@ -116,6 +116,22 @@ def drive(
                        start_step=start_step)
 
 
+def resolve_initial_field(cfg: HeatConfig, T0: Optional[np.ndarray],
+                          sharding=None):
+    """(T_device, start_step) for device backends: explicit T0 > checkpoint
+    (both host arrays, shipped over) > IC built directly on device."""
+    from ..utils import jnp_dtype
+
+    T0_host, start_step = load_or_init(cfg, T0, default_ic=False)
+    if T0_host is None:
+        from ..grid import initial_condition_device
+
+        return initial_condition_device(cfg, sharding=sharding), start_step
+    T = jnp.asarray(T0_host).astype(jnp_dtype(cfg.dtype))
+    T = jax.device_put(T, sharding) if sharding is not None else jax.device_put(T)
+    return T, start_step
+
+
 def load_or_init(cfg: HeatConfig, T0: Optional[np.ndarray], default_ic: bool = True):
     """Resolve the starting field: explicit T0 > latest checkpoint > IC.
 

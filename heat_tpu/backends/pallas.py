@@ -14,7 +14,6 @@ import functools
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..config import HeatConfig
@@ -25,9 +24,8 @@ from ..ops.pallas_stencil import (
     ftcs_step_ghost_pallas,
 )
 from ..ops.stencil import run_steps
-from ..utils import jnp_dtype
 from . import SolveResult, register
-from .common import drive, load_or_init
+from .common import drive, resolve_initial_field
 
 # default temporal-blocking depth: amortizes the kernel's per-pass HBM
 # traffic over 16 steps (measured throughput on v5e is flat past 16); the
@@ -68,13 +66,6 @@ def make_advance(cfg: HeatConfig):
 @register("pallas")
 def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None,
           fetch: bool = True, warm_exec: bool = False, **_) -> SolveResult:
-    dt = jnp_dtype(cfg.dtype)
-    T0_host, start_step = load_or_init(cfg, T0, default_ic=False)
-    if T0_host is None:
-        from ..grid import initial_condition_device
-
-        T = initial_condition_device(cfg)
-    else:
-        T = jax.device_put(jnp.asarray(T0_host).astype(dt))
+    T, start_step = resolve_initial_field(cfg, T0)
     return drive(cfg, T, make_advance(cfg), start_step=start_step, fetch=fetch,
                  warm_exec=warm_exec)

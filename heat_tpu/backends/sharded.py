@@ -46,7 +46,7 @@ from ..parallel.mesh import build_mesh, validate_divisible
 from ..runtime.logging import master_print
 from ..utils import jnp_dtype
 from . import SolveResult, register
-from .common import drive, load_or_init
+from .common import drive, resolve_initial_field
 
 
 def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
@@ -177,19 +177,12 @@ def make_advance(cfg: HeatConfig, mesh):
 @register("sharded")
 def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
           fetch: bool = True, warm_exec: bool = False, **_) -> SolveResult:
-    dt = jnp_dtype(cfg.dtype)
     mesh = mesh or build_mesh(cfg.ndim, cfg.mesh_shape)
     validate_divisible(cfg.n, mesh)
     master_print(f"Automatic mesh decomposition: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
     sharding = NamedSharding(mesh, P(*mesh.axis_names))
-    T0_host, start_step = load_or_init(cfg, T0, default_ic=False)
-    if T0_host is None:
-        from ..grid import initial_condition_device
-
-        T = initial_condition_device(cfg, sharding=sharding)
-    else:
-        T = jax.device_put(jnp.asarray(T0_host).astype(dt), sharding)
+    T, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
     res = drive(cfg, T, make_advance(cfg, mesh), start_step=start_step, fetch=fetch,
                  warm_exec=warm_exec)
     res.mesh_shape = tuple(mesh.devices.shape)

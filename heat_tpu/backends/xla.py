@@ -14,14 +14,12 @@ import functools
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..config import HeatConfig
 from ..ops.stencil import ftcs_step_edges, ftcs_step_ghost, run_steps
-from ..utils import jnp_dtype
 from . import SolveResult, register
-from .common import drive, load_or_init
+from .common import drive, resolve_initial_field
 
 
 def make_advance(cfg: HeatConfig):
@@ -44,13 +42,6 @@ def make_advance(cfg: HeatConfig):
 @register("xla")
 def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None,
           fetch: bool = True, warm_exec: bool = False, **_) -> SolveResult:
-    dt = jnp_dtype(cfg.dtype)
-    T0_host, start_step = load_or_init(cfg, T0, default_ic=False)
-    if T0_host is None:
-        from ..grid import initial_condition_device
-
-        T = initial_condition_device(cfg)
-    else:
-        T = jax.device_put(jnp.asarray(T0_host).astype(dt))
+    T, start_step = resolve_initial_field(cfg, T0)
     return drive(cfg, T, make_advance(cfg), start_step=start_step, fetch=fetch,
                  warm_exec=warm_exec)

@@ -13,9 +13,12 @@ reference variant silently ships a *different* hat initial condition
                   variants' uniform-hot/cold-walls setup (fortran/mpi+cuda/heat.F90:243-251)
 - ``zero``      : T=0 (testing)
 
-All constructors are pure numpy: initial conditions are built once on host
-and shipped to device by the backend, mirroring the reference's host-side IC
-plus one H2D copy (``fortran/mpi+cuda/heat.F90:256``).
+Two construction paths, bit-identical by design: ``initial_condition`` is
+pure numpy on host (mirroring the reference's host-side IC plus one H2D
+copy, ``fortran/mpi+cuda/heat.F90:256``) and remains the oracle; device
+backends default to ``initial_condition_device``, which builds the same
+field directly on device (optionally pre-sharded) so no n^d host array or
+host->device transfer exists at benchmark scale.
 """
 
 from __future__ import annotations

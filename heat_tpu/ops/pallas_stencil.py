@@ -190,20 +190,50 @@ def _pallas_2d(T: jax.Array, r: float, ksteps: int,
 # --------------------------------------------------------------------------
 
 
-def _tile_3d(mid_pad: int, n_pad: int, dtype) -> int:
-    """Planes per tile, sized so the 3-tile f32 band stays near the budget,
-    capped at 8. The fusion invariant ksteps <= tile is owned by
-    _pallas_3d's assert and _multistep's chunking."""
+# rough v5e machine balance for the 3D plan's cost model: effective VPU
+# elementwise rate (backed out of the measured 2D kernel: ~10 ops/pt-step
+# at 1.4e11 pts/s) and HBM bandwidth
+_VPU_OPS_PER_S = 1.4e12
+_HBM_BYTES_PER_S = 819e9
+
+
+def _plan_3d(shape, dtype, ksteps: int):
+    """Choose (padded_shape, tile, kchunk) for the plane-tiled 3D kernel.
+
+    The halo here is whole (mid, n) planes, so — unlike 2D, where the halo
+    slab is a thin strip — deeper fusion shrinks HBM traffic but inflates
+    the redundantly-computed band fraction (tile+2k)/tile. Pick the
+    (tile, k) minimizing max(compute, bandwidth) per point-step under the
+    band budget."""
+    m, mid, n = shape
+    n_pad = _round_up(max(n, 128), 128)
+    mid_pad = _round_up(max(mid, _sublane(dtype)), _sublane(dtype))
     plane = mid_pad * n_pad * 4  # band is held in the accumulation dtype
-    cap = max(1, _BAND_BUDGET_BYTES // (3 * plane))
-    return max(1, min(8, cap))
+    budget_planes = max(3, _BAND_BUDGET_BYTES // plane)
+    item = jnp.dtype(dtype).itemsize
+    best = None
+    for k in range(1, min(max(ksteps, 1), 8) + 1):
+        cap = budget_planes - 2 * k
+        if cap < k:
+            continue
+        # don't tile far past the array itself (padding is wasted work)
+        cap = min(cap, _round_up(max(m, k), k))
+        tile = (cap // k) * k
+        compute = 11.0 * (tile + 2 * k) / tile / _VPU_OPS_PER_S
+        bw = (2.0 * tile + 2 * k) / (tile * k) * item / _HBM_BYTES_PER_S
+        key = (max(compute, bw), -k)
+        if best is None or key < best[0]:
+            best = (key, tile, k)
+    _, tile, kchunk = best
+    m_pad = _round_up(max(m, tile), tile)
+    return (m_pad, mid_pad, n_pad), tile, kchunk
 
 
-def _make_kernel_3d(r: float, tile: int, shape_pad, ksteps: int):
+def _make_kernel_3d(r: float, tile: int, kpad: int, shape_pad, ksteps: int):
     """Kernel body; ``bounds_ref`` is SMEM (1,6) i32
     [row_lo, row_hi, mid_lo, mid_hi, col_lo, col_hi] (see 2D)."""
     _, mid_p, n_p = shape_pad
-    rows = 3 * tile
+    rows = tile + 2 * kpad
 
     def kernel(bounds_ref, prev_ref, cur_ref, next_ref, out_ref):
         i = pl.program_id(0)
@@ -213,7 +243,7 @@ def _make_kernel_3d(r: float, tile: int, shape_pad, ksteps: int):
             [prev_ref[:], cur_ref[:], next_ref[:]], axis=0
         ).astype(acc_dt)
         bshape = (rows, mid_p, n_p)
-        grow = (i - 1) * tile + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
+        grow = i * tile - kpad + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
         gmid = jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
         gcol = jax.lax.broadcasted_iota(jnp.int32, bshape, 2)
         frozen = (
@@ -231,54 +261,52 @@ def _make_kernel_3d(r: float, tile: int, shape_pad, ksteps: int):
             lf = pltpu.roll(band, 1, 2)
             rt = pltpu.roll(band, n_p - 1, 2)
             band = band + maskr * (up + dn + fw + bk + lf + rt - 6.0 * band)
-        out_ref[:] = band[tile : 2 * tile].astype(store_dt)
+        out_ref[:] = band[kpad : kpad + tile].astype(store_dt)
 
     return kernel
 
 
-def _aligned_shape_3d(shape, dtype):
-    m, mid, n = shape
-    n_pad = _round_up(max(n, 128), 128)
-    mid_pad = _round_up(max(mid, _sublane(dtype)), _sublane(dtype))
-    tile = _tile_3d(mid_pad, n_pad, dtype)
-    m_pad = _round_up(max(m, tile), tile)
-    return (m_pad, mid_pad, n_pad), tile
-
-
-@functools.partial(jax.jit, static_argnames=("r", "ksteps", "logical_shape"))
-def _pallas_3d_aligned(Tp: jax.Array, r: float, ksteps: int,
+@functools.partial(jax.jit,
+                   static_argnames=("r", "ksteps", "kpad", "logical_shape"))
+def _pallas_3d_aligned(Tp: jax.Array, r: float, ksteps: int, kpad: int,
                        logical_shape, bounds: jax.Array | None = None) -> jax.Array:
     """``ksteps`` FTCS steps on an already tile-aligned 3D array whose
-    logical (unpadded) extents are ``logical_shape``, freezing cells at or
-    beyond ``bounds`` (default: the boundary shell). ksteps must not exceed
-    the plane tile (callers chunk; see _multistep)."""
-    (m_pad, mid_pad, n_pad), tile = _aligned_shape_3d(logical_shape, Tp.dtype)
-    assert Tp.shape == (m_pad, mid_pad, n_pad) and ksteps <= tile
+    logical (unpadded) extents are ``logical_shape``. ``kpad`` is the plan's
+    halo depth (fixed block geometry across chunks); a remainder pass may
+    run ksteps < kpad. Callers chunk — see _multistep."""
+    (m_pad, mid_pad, n_pad), tile, kplan = _plan_3d(logical_shape, Tp.dtype, kpad)
+    assert Tp.shape == (m_pad, mid_pad, n_pad)
+    assert kplan == kpad and ksteps <= kpad and tile % kpad == 0
     m, mid, n = logical_shape
     if bounds is None:
         bounds = jnp.asarray([[0, m - 1, 0, mid - 1, 0, n - 1]], jnp.int32)
     bounds = bounds.reshape(1, 6).astype(jnp.int32)
     grid = (m_pad // tile,)
+    ratio = tile // kpad
+    nhblk = m_pad // kpad
     smem = pl.BlockSpec((1, 6), lambda i: (0, 0), memory_space=pltpu.SMEM)
-    spec = lambda imap: pl.BlockSpec((tile, mid_pad, n_pad), imap,
+    halo = lambda imap: pl.BlockSpec((kpad, mid_pad, n_pad), imap,
+                                     memory_space=pltpu.VMEM)
+    main = lambda imap: pl.BlockSpec((tile, mid_pad, n_pad), imap,
                                      memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        _make_kernel_3d(float(r), tile, Tp.shape, ksteps),
+        _make_kernel_3d(float(r), tile, kpad, Tp.shape, ksteps),
         out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
         grid=grid,
         in_specs=[
             smem,
-            spec(lambda i: (jnp.maximum(i - 1, 0), 0, 0)),
-            spec(lambda i: (i, 0, 0)),
-            spec(lambda i: (jnp.minimum(i + 1, grid[0] - 1), 0, 0)),
+            halo(lambda i: (jnp.maximum(i * ratio - 1, 0), 0, 0)),
+            main(lambda i: (i, 0, 0)),
+            halo(lambda i: (jnp.minimum((i + 1) * ratio, nhblk - 1), 0, 0)),
         ],
-        out_specs=spec(lambda i: (i, 0, 0)),
+        out_specs=main(lambda i: (i, 0, 0)),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES,
         ),
         cost_estimate=pl.CostEstimate(
-            flops=11 * m_pad * mid_pad * n_pad * ksteps * 3,
-            bytes_accessed=2 * m_pad * mid_pad * n_pad * Tp.dtype.itemsize,
+            flops=11 * (tile + 2 * kpad) * grid[0] * mid_pad * n_pad * ksteps,
+            bytes_accessed=(2 * m_pad + 2 * kpad * grid[0]) * mid_pad * n_pad
+            * Tp.dtype.itemsize,
             transcendentals=0,
         ),
         interpret=_interpret(),
@@ -311,14 +339,14 @@ def _multistep(T: jax.Array, r: float, ksteps: int,
             done += k
         return T
     logical = tuple(T.shape)
-    aligned, kmax = _aligned_shape_3d(logical, T.dtype)
+    aligned, _, kchunk = _plan_3d(logical, T.dtype, ksteps)
     if aligned != logical:
         T = jnp.pad(T, [(0, p - s) for p, s in zip(aligned, logical)])
     done = 0
     while done < ksteps:
-        k = min(kmax, ksteps - done)
-        T = _pallas_3d_aligned(T, r=float(r), ksteps=k, logical_shape=logical,
-                               bounds=bounds)
+        k = min(kchunk, ksteps - done)
+        T = _pallas_3d_aligned(T, r=float(r), ksteps=k, kpad=kchunk,
+                               logical_shape=logical, bounds=bounds)
         done += k
     if aligned != logical:
         T = T[: logical[0], : logical[1], : logical[2]]
