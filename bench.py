@@ -12,17 +12,30 @@ one-pass implementation on this chip. The measured config mirrors the
 reference's single-GPU benchmark shape (python/cuda/cuda.py:31-33: 4096^2,
 10k steps; we run 8192 steps, identical steady-state per-step cost).
 
+Capture robustness (round 2): the tunneled TPU backend is transiently
+unavailable — round 1's driver capture died with rc=1 on
+"Unable to initialize backend 'axon'", and a bare device probe can HANG
+rather than raise. So the measurement runs in a *subprocess* under a hard
+timeout (a hang becomes a retryable failure), the supervisor retries with
+backoff, and on final failure it still prints exactly one parseable JSON
+line carrying an "error" field — the bench never again exits without a
+machine-readable verdict. Run with ``--worker`` to execute the measurement
+inline (no supervision).
+
 Timing uses a scalar device->host fetch as the completion fence:
 ``block_until_ready`` does not block on queued work on the tunneled
 single-chip platform, and a full-buffer fetch over the tunnel costs seconds
 (see heat_tpu/runtime/timing.py::sync).
 
-Prints exactly one JSON line.
+Prints exactly one JSON line on stdout.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 N = 4096
@@ -32,8 +45,31 @@ REPEATS = 3
 # step f32 (read + write once; the reference's snapshot copy doubles this)
 ROOFLINE_POINTS_PER_S = 1.024e11
 
+METRIC = f"grid_points_per_sec_per_chip_{N}x{N}_f32_pallas"
 
-def main() -> None:
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# per-attempt wall clock: H2D of the 64 MiB field over the ~8 MB/s tunnel
+# (~10 s), first compile (tens of s), lazy runtime init (tens of s on a cold
+# tunnel), then ~1 s/rep of actual compute — 900 s is a hang detector, not
+# a tight budget
+ATTEMPT_TIMEOUT_S = _env_int("HEAT_BENCH_TIMEOUT_S", 900)
+ATTEMPTS = _env_int("HEAT_BENCH_ATTEMPTS", 4)
+BACKOFF_S = (15, 45, 90)
+# failure signatures worth retrying (transient tunnel/backend states); any
+# other worker crash is deterministic — fail fast with the error line.
+# (Timeouts always retry; this list is only consulted for nonzero exits.)
+_RETRYABLE = ("Unable to initialize backend", "UNAVAILABLE", "DEADLINE")
+
+
+def measure() -> None:
+    """The actual benchmark (runs in the supervised subprocess)."""
     import jax
     import jax.numpy as jnp
 
@@ -41,6 +77,8 @@ def main() -> None:
     from heat_tpu.config import HeatConfig
     from heat_tpu.grid import initial_condition
     from heat_tpu.runtime.timing import sync
+
+    platform = jax.default_backend()  # first device touch; may raise/hang
 
     cfg = HeatConfig(n=N, ntime=STEPS, dtype="float32", ic="hat",
                      backend="pallas")
@@ -64,13 +102,107 @@ def main() -> None:
             best = min(best, dt)
 
     pts_per_s = N * N * STEPS / best
+    # flush: the pipe is block-buffered and JAX atexit teardown can hang
+    # before interpreter stdio flush — the supervisor's salvage path needs
+    # this line physically in the pipe the moment it's produced
     print(json.dumps({
-        "metric": f"grid_points_per_sec_per_chip_{N}x{N}_f32_pallas",
+        "metric": METRIC,
         "value": pts_per_s,
         "unit": "points/s",
         "vs_baseline": pts_per_s / ROOFLINE_POINTS_PER_S,
+        "platform": platform,
+    }), flush=True)
+
+
+def _parse_result_line(stdout: str):
+    """The worker's result is the last stdout line that parses as a JSON
+    object with our metric (tolerates stray runtime chatter on stdout)."""
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and obj.get("metric") == METRIC:
+            return obj
+    return None
+
+
+def supervise() -> int:
+    """Run ``measure`` in a subprocess with timeout + retry; always print
+    one parseable JSON line."""
+    last_err = "no attempt ran"
+    for attempt in range(1, ATTEMPTS + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired as e:
+            # the worker may have finished the measurement and printed its
+            # result, then hung in runtime teardown over the flaky tunnel —
+            # salvage a valid result line before declaring the attempt dead
+            out = e.stdout or ""  # bytes on POSIX even in text mode
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            result = _parse_result_line(out)
+            if result is not None:
+                print(json.dumps(result))
+                return 0
+            last_err = (f"attempt {attempt}: no result within "
+                        f"{ATTEMPT_TIMEOUT_S}s (hung backend init?)")
+        except OSError as e:  # spawn failure (ENOMEM etc.)
+            last_err = f"attempt {attempt}: failed to spawn worker: {e}"
+        else:
+            result = _parse_result_line(proc.stdout)
+            if result is not None:
+                # a parsed result is a completed measurement even if runtime
+                # teardown crashed afterwards (nonzero rc) — same salvage
+                # rule as the timeout branch
+                print(json.dumps(result))
+                return 0
+            full = (proc.stderr or "") + (proc.stdout or "")
+            tail = full.strip().splitlines()
+            last_err = (f"attempt {attempt}: rc={proc.returncode}: "
+                        + " | ".join(tail[-3:]))
+            if not any(sig in full for sig in _RETRYABLE):
+                # deterministic crash (import error, bad config, code bug):
+                # retrying reruns the identical failure — emit the verdict now
+                print(f"bench attempt {attempt}/{ATTEMPTS} failed "
+                      f"(non-retryable): {last_err}", file=sys.stderr)
+                break
+        print(f"bench attempt {attempt}/{ATTEMPTS} failed: {last_err}",
+              file=sys.stderr)
+        if attempt < ATTEMPTS:
+            time.sleep(BACKOFF_S[min(attempt - 1, len(BACKOFF_S) - 1)])
+    # final failure: still emit one machine-readable line (round 1's capture
+    # produced rc=1 with nothing parseable — never again)
+    print(json.dumps({
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "points/s",
+        "vs_baseline": 0.0,
+        "error": last_err,
     }))
+    return 1
+
+
+def main() -> int:
+    if "--worker" in sys.argv:
+        measure()
+        return 0
+    try:
+        return supervise()
+    except Exception as e:  # the one-parseable-line contract survives bugs
+        print(json.dumps({
+            "metric": METRIC, "value": 0.0, "unit": "points/s",
+            "vs_baseline": 0.0, "error": f"supervisor crashed: {e!r}",
+        }))
+        return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
