@@ -15,7 +15,7 @@ reference taxonomy:
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
@@ -28,11 +28,16 @@ _REGISTRY: Dict[str, Callable] = {}
 @dataclasses.dataclass
 class SolveResult:
     cfg: HeatConfig
-    T: np.ndarray            # final field on host
+    T: Optional[np.ndarray]  # final field on host; None when the global
+                             # array spans other processes (multi-host) or
+                             # the caller skipped the fetch — use T_dev +
+                             # per-shard IO then
     timing: Timing
     gsum: Optional[float] = None   # global temperature sum if report_sum
     start_step: int = 0            # nonzero when resumed from checkpoint
     mesh_shape: Optional[tuple] = None  # decomposition used (sharded backend)
+    T_dev: Any = None              # final field on device (jax.Array)
+    mesh: Any = None               # jax.sharding.Mesh (sharded backend)
 
 
 def register(name: str):

@@ -26,6 +26,23 @@ from ..runtime.timing import Timing, sync
 from . import SolveResult
 
 
+def _addressable(x) -> bool:
+    """True when every shard of x lives on this process's devices.
+
+    Injectable seam (tests fake a multi-host world by patching this): in a
+    real multi-host job a mesh-sharded global array is NOT fully
+    addressable and ``np.asarray`` on it raises — the same case
+    ``timing.sync`` already guards."""
+    return not isinstance(x, jax.Array) or x.is_fully_addressable
+
+
+def host_fetch(x):
+    """Fetch to host, or None when the array spans other processes (the
+    caller must then use per-shard paths: ``io.write_soln_sharded``,
+    ``checkpoint.save_shards``)."""
+    return np.asarray(x) if _addressable(x) else None
+
+
 def event_interval(cfg: HeatConfig) -> int:
     """Steps per device program: gcd of the host-visible event intervals."""
     ivals = [v for v in (cfg.heartbeat_every, cfg.checkpoint_every) if v > 0]
@@ -42,7 +59,7 @@ def drive(
     T_dev: jax.Array,
     advance: Callable[[jax.Array, int], jax.Array],
     start_step: int = 0,
-    to_host: Callable[[jax.Array], np.ndarray] = lambda x: np.asarray(x),
+    to_host: Callable[[jax.Array], Optional[np.ndarray]] = host_fetch,
     warmup: bool = True,
     fetch: bool = True,
     warm_exec: bool = False,
@@ -88,7 +105,11 @@ def drive(
                 master_print(" time_it:", step)  # fortran/serial/heat.f90:62
             if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
                 sync(T_dev)
-                checkpoint.save(cfg, to_host(T_dev), step)
+                T_ck = to_host(T_dev)
+                if T_ck is not None:
+                    checkpoint.save(cfg, T_ck, step)
+                else:  # multi-host: each process persists its own shards
+                    checkpoint.save_shards(cfg, T_dev, step)
         sync(T_dev)
     solve_s = time.perf_counter() - t0
 
@@ -113,7 +134,55 @@ def drive(
     timing = Timing(total_s=time.perf_counter() - t_all0, compile_s=compile_s,
                     solve_s=solve_s, steps=remaining, points=cfg.points)
     return SolveResult(cfg=cfg, T=T_host, timing=timing, gsum=gsum,
-                       start_step=start_step)
+                       start_step=start_step, T_dev=T_dev)
+
+
+def _rebuild_from_shard_blocks(cfg: HeatConfig, sharding, blocks):
+    """Reassemble this process's checkpointed blocks into the global sharded
+    array (multi-host resume: every process contributes its own blocks)."""
+    from ..utils import jnp_dtype
+
+    dt = jnp_dtype(cfg.dtype)
+    idx_map = sharding.addressable_devices_indices_map(cfg.shape)
+    by_start = {
+        tuple(s.start or 0 for s in idx): dev for dev, idx in idx_map.items()
+    }
+    arrays = []
+    for starts, data in blocks:
+        dev = by_start.get(tuple(starts))
+        if dev is None:
+            raise ValueError(
+                f"shard checkpoint block at offset {starts} does not match "
+                f"the current mesh layout {sorted(by_start)} — resume with "
+                f"the mesh shape the checkpoint was written under")
+        # host->target device in one hop (jnp.asarray would stage through
+        # the default device first: a doubled transfer at GiB scale)
+        arrays.append(jax.device_put(np.asarray(data).astype(dt), dev))
+    return jax.make_array_from_single_device_arrays(cfg.shape, sharding, arrays)
+
+
+def _agree_resume_step(local_step: Optional[int]) -> Optional[int]:
+    """Cross-process agreement on the shard-checkpoint resume step.
+
+    Processes can hold different latest steps (a crash between one
+    process's save and the others'): resuming at different start_steps
+    would desynchronize the collectives. Everyone resumes at the MINIMUM —
+    the newest step that every process holds. If any process has no shard
+    files at all the minimum is "none": all fall back together (never a
+    silent IC start against peers mid-run)."""
+    local = -1 if local_step is None else int(local_step)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        steps = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(local, jnp.int32)))
+        agreed = int(steps.min())
+        if agreed != local:
+            master_print(f"shard-checkpoint resume: local step {local} vs "
+                         f"job-wide agreed step {agreed}")
+    else:
+        agreed = local
+    return None if agreed < 0 else agreed
 
 
 def resolve_initial_field(cfg: HeatConfig, T0: Optional[np.ndarray],
@@ -121,6 +190,20 @@ def resolve_initial_field(cfg: HeatConfig, T0: Optional[np.ndarray],
     """(T_device, start_step) for device backends: explicit T0 > checkpoint
     (both host arrays, shipped over) > IC built directly on device."""
     from ..utils import jnp_dtype
+
+    if (T0 is None and cfg.checkpoint_every and sharding is not None
+            and hasattr(sharding, "addressable_devices_indices_map")):
+        # multi-host runs checkpoint per-process shard files; prefer them
+        # over a (possibly stale) single-host global snapshot
+        sstep = _agree_resume_step(
+            checkpoint.latest_shards(cfg, max_step=cfg.ntime))
+        if sstep is not None:
+            gstep = checkpoint.latest_step(cfg, max_step=cfg.ntime)
+            if gstep is None or sstep >= gstep:
+                blocks, step = checkpoint.load_shards(cfg, sstep)
+                T = _rebuild_from_shard_blocks(cfg, sharding, blocks)
+                master_print(f"resumed from shard checkpoints at step {step}")
+                return T, step
 
     T0_host, start_step = load_or_init(cfg, T0, default_ic=False)
     if T0_host is None:

@@ -9,11 +9,14 @@ binary on every rank" structure of the reference comes for free.
 
 Step ordering: the reference updates then swaps (update-then-swap,
 fortran/mpi+cuda/heat.F90:206-219), relying on ICs pre-filling the ghosts for
-the first step; we default to the causally-clean swap-then-update. For every
-shipped IC the two orders are *numerically identical* (the IC ghost values
-equal what the first exchange delivers); ``parity_order=True`` requests the
-reference's literal ordering, which we honor by noting the equivalence —
-both orders share this implementation.
+the first step; we default to the causally-clean swap-then-update.
+``parity_order=True`` runs the literal reference ordering instead
+(``make_parity_machinery``): the padded field is the carried state, every
+step updates owned cells against ghosts as-they-are, then swaps. IC starts
+bit-match the default order (the IC fills ghosts with exactly what the
+first exchange delivers); explicit-T0 starts expose the reference's
+stale-ghost first step, where the orders genuinely diverge — see
+tests/test_parity_order.py for the literal transcription oracle.
 
 BC semantics:
 - ``ghost`` (MPI parity): all owned cells update; global-edge ghosts pinned
@@ -46,7 +49,7 @@ from ..parallel.mesh import build_mesh, validate_divisible
 from ..runtime.logging import master_print
 from ..utils import jnp_dtype
 from . import SolveResult, register
-from .common import drive, resolve_initial_field
+from .common import drive, host_fetch, resolve_initial_field
 
 
 def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
@@ -142,6 +145,122 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
     return local_multi
 
 
+def make_parity_machinery(cfg: HeatConfig, mesh):
+    """Literal update-then-swap stepping (fortran/mpi+cuda/heat.F90:206-219).
+
+    Unlike the default communication-avoiding order (exchange, then update),
+    the reference updates every owned cell against the ghosts *as they are*,
+    then swaps. That forces the ghost ring to be carried state: here the
+    sharded global array is the PADDED field (each shard = owned + width-1
+    ghosts), exactly the reference's ``(1-ng:nx+ng, 1-ng:ny+ng)`` per-rank
+    allocation (:107).
+
+    Ghost seeding decides whether the orders are distinguishable:
+    - IC starts seed ghosts by one exchange — identical to the reference's
+      whole-padded-array IC fill (``T = 2.0`` at :243 evaluates the IC at
+      ghost coordinates too), so shipped-IC runs bit-match the default
+      order (the equivalence round 1 claimed, now executable).
+    - explicit-T0 starts seed ghosts with ``bc_value`` only (nothing fills
+      them, as in a raw restart): the first update reads stale ghosts and
+      the two orders genuinely diverge — the reference's latent
+      stale-first-step behavior, made observable.
+
+    Returns (seed, advance, crop): seed builds the padded global from the
+    owned global, advance runs k literal steps, crop recovers the owned
+    global.
+    """
+    axis_names = mesh.axis_names
+    axis_sizes = mesh.devices.shape
+    r = cfg.r
+    bc_value = cfg.bc_value
+    staged = cfg.comm == "staged"
+    n = cfg.n
+    spec = P(*axis_names)
+    smap = functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec, check_vma=False)
+
+    def _pinned_mask(padded):
+        # cells the update must never change: the ghost ring itself (w=1),
+        # plus the global boundary ring under "edges" semantics
+        gidx = []
+        for d, name in enumerate(axis_names):
+            coord = jax.lax.axis_index(name)
+            base = coord * (padded.shape[d] - 2) - 1
+            gidx.append(base + jax.lax.broadcasted_iota(
+                jnp.int32, padded.shape, d))
+        ghost = functools.reduce(
+            jnp.logical_or, [(g < 0) | (g > n - 1) for g in gidx])
+        if cfg.bc == "edges":
+            ring = functools.reduce(
+                jnp.logical_or, [(g == 0) | (g == n - 1) for g in gidx])
+            return ghost | ring
+        return ghost
+
+    def local_parity_step(padded):
+        acc_dt = accum_dtype_for(padded.dtype)
+        rr = jnp.asarray(r, acc_dt)
+        lap = laplacian_interior(padded)  # owned region, reading ghosts
+        new = padded.astype(acc_dt)
+        ctr = tuple(slice(1, -1) for _ in range(padded.ndim))
+        new = new.at[ctr].add(rr * lap)
+        new = jnp.where(_pinned_mask(padded), padded,
+                        new.astype(padded.dtype))
+        # ghost update AFTER the stencil — the literal :218 ``call swap()``
+        return halo_exchange(new, axis_names, axis_sizes, bc_value,
+                             staged=staged, width=1)
+
+    def seed(T_owned: jax.Array, from_ic: bool) -> jax.Array:
+        def body(local):
+            padded = halo_pad(local, bc_value, 1)
+            if from_ic:
+                padded = halo_exchange(padded, axis_names, axis_sizes,
+                                       bc_value, staged=staged, width=1)
+            return padded
+
+        return jax.jit(smap(body))(T_owned)
+
+    @functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
+    def advance(Tp, k: int):
+        def body(padded):
+            return jax.lax.fori_loop(
+                0, k, lambda i, t: local_parity_step(t), padded)
+
+        return smap(body)(Tp)
+
+    @jax.jit
+    def crop(Tp):
+        return smap(
+            lambda p: p[tuple(slice(1, -1) for _ in range(p.ndim))])(Tp)
+
+    return seed, advance, crop
+
+
+def _solve_parity(cfg: HeatConfig, T0, mesh, fetch: bool, warm_exec: bool):
+    """Parity-ordered solve path (cfg.parity_order)."""
+    if cfg.checkpoint_every:
+        raise ValueError(
+            "parity_order is a bit-parity experiment mode and does not "
+            "support checkpointing (the carried state is the padded field)")
+    master_print("step ordering: update-then-swap "
+                 "(reference parity, mpi+cuda/heat.F90:206-219)")
+    sharding = NamedSharding(mesh, P(*mesh.axis_names))
+    T_owned, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
+    seed, advance, crop = make_parity_machinery(cfg, mesh)
+    Tp = seed(T_owned, from_ic=T0 is None)
+    res = drive(cfg.with_(report_sum=False), Tp, advance,
+                start_step=start_step, fetch=False, warm_exec=warm_exec)
+    res.cfg = cfg
+    res.T_dev = crop(res.T_dev)
+    res.T = host_fetch(res.T_dev) if fetch else None
+    if cfg.report_sum:
+        if res.T is not None:
+            res.gsum = float(np.sum(np.asarray(res.T, np.float64)))
+        else:
+            acc = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+            res.gsum = float(np.asarray(jnp.sum(res.T_dev, dtype=acc)))
+    return res
+
+
 def fuse_depth_sharded(cfg: HeatConfig, axis_sizes) -> int:
     """Halo width per exchange: requested fuse depth capped by the smallest
     local extent (a shard can't lend deeper halo than it owns)."""
@@ -180,10 +299,25 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
     mesh = mesh or build_mesh(cfg.ndim, cfg.mesh_shape)
     validate_divisible(cfg.n, mesh)
     master_print(f"Automatic mesh decomposition: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    # local block dims + shard->device binding, the reference's per-rank
+    # announcements (local nx/ny at mpi+cuda/heat.F90:239-240, rank->GPU at
+    # :69), gated master-only like every other stdout line
+    local = tuple(cfg.n // s for s in mesh.devices.shape)
+    master_print("local block: " + " x ".join(str(v) for v in local))
+    flat = list(np.ndenumerate(mesh.devices))
+    for coords_d, dev in flat[:32]:
+        master_print(f"  mesh {coords_d} -> device {dev.id} "
+                     f"(process {getattr(dev, 'process_index', 0)})")
+    if len(flat) > 32:
+        master_print(f"  ... ({len(flat) - 32} more shards)")
 
-    sharding = NamedSharding(mesh, P(*mesh.axis_names))
-    T, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
-    res = drive(cfg, T, make_advance(cfg, mesh), start_step=start_step, fetch=fetch,
-                 warm_exec=warm_exec)
+    if cfg.parity_order:
+        res = _solve_parity(cfg, T0, mesh, fetch, warm_exec)
+    else:
+        sharding = NamedSharding(mesh, P(*mesh.axis_names))
+        T, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
+        res = drive(cfg, T, make_advance(cfg, mesh), start_step=start_step,
+                    fetch=fetch, warm_exec=warm_exec)
     res.mesh_shape = tuple(mesh.devices.shape)
+    res.mesh = mesh
     return res

@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--local-kernel", choices=["auto", "xla", "pallas"],
                      help="sharded per-shard compute kernel "
                           "(auto = pallas on TPU, xla elsewhere)")
+    run.add_argument("--parity-order", action="store_true",
+                     help="literal update-then-swap step ordering "
+                          "(reference parity, mpi+cuda/heat.F90:206-219)")
     run.add_argument("--heartbeat-every", type=int,
                      help="print 'time_it: i' every k steps (reference prints every step)")
     run.add_argument("--report-sum", action="store_true",
@@ -108,6 +111,8 @@ def _apply_overrides(cfg: HeatConfig, args) -> HeatConfig:
         over["check_numerics"] = True
     if args.soln:
         over["soln"] = True
+    if getattr(args, "parity_order", False):
+        over["parity_order"] = True
     return cfg.with_(**over)
 
 
@@ -121,6 +126,15 @@ def cmd_run(args) -> int:
     if args.variant:
         cfg = variant_config(args.variant, cfg)
     cfg = _apply_overrides(cfg, args)
+
+    if cfg.backend == "sharded":
+        # join the multi-process world before any backend/device use — the
+        # first act of the reference's distributed variants (mpi_init +
+        # rank->GPU binding, fortran/mpi+cuda/heat.F90:60-70). Single-host
+        # runs: a cheap no-op.
+        from .parallel.dist import init_distributed
+
+        init_distributed()
 
     axes = coords(cfg)
     if args.write_int:
@@ -137,16 +151,28 @@ def cmd_run(args) -> int:
         master_print(f"Sum of Temperature: {res.gsum:.10g}")
 
     if cfg.soln:
-        from .io import write_soln, write_soln_blocks
+        from .io import write_soln, write_soln_blocks, write_soln_sharded
 
-        if res.mesh_shape and any(s > 1 for s in res.mesh_shape):
-            # per-shard files, reference per-rank contract
-            files = write_soln_blocks(Path(args.out).parent or ".", axes,
-                                      res.T, res.mesh_shape)
-            master_print(f"wrote {len(files)} per-shard files "
-                         f"({files[0].name} .. {files[-1].name})")
-        write_soln(args.out, axes, res.T)
-        master_print(f"wrote {args.out}")
+        outdir = Path(args.out).parent or "."
+        if res.T is None:
+            # multi-host: the global field spans other processes — every
+            # process writes its own addressable shards, the reference's
+            # per-rank soln#####.dat contract (mpi+cuda/heat.F90:277-288)
+            if res.T_dev is not None and res.mesh is not None:
+                files = write_soln_sharded(outdir, axes, res.T_dev, res.mesh)
+                print(f"[process {_process_index()}] wrote "
+                      f"{len(files)} shard files "
+                      f"({files[0].name} .. {files[-1].name})")
+            else:
+                master_print("solution dump skipped: field was not fetched")
+        else:
+            if res.mesh_shape and any(s > 1 for s in res.mesh_shape):
+                # per-shard files, reference per-rank contract
+                files = write_soln_blocks(outdir, axes, res.T, res.mesh_shape)
+                master_print(f"wrote {len(files)} per-shard files "
+                             f"({files[0].name} .. {files[-1].name})")
+            write_soln(args.out, axes, res.T)
+            master_print(f"wrote {args.out}")
 
     if args.json:
         master_print(json.dumps({
@@ -158,6 +184,12 @@ def cmd_run(args) -> int:
             "gsum": res.gsum,
         }))
     return 0
+
+
+def _process_index() -> int:
+    import jax
+
+    return jax.process_index()
 
 
 def cmd_viz(args) -> int:

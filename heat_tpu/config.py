@@ -66,9 +66,10 @@ class HeatConfig:
     check_numerics: bool = False  # per-chunk NaN/Inf detection (debug mode)
     fuse_steps: int = 0         # pallas temporal blocking: FTCS steps fused
                                 # per kernel pass (0 = auto, 1 = off)
-    parity_order: bool = False  # reference's update-then-swap step ordering
-                                # (mpi+cuda/heat.F90:209-218); equivalent for
-                                # shipped ICs, kept for bit-parity experiments
+    parity_order: bool = False  # literal update-then-swap step ordering
+                                # (mpi+cuda/heat.F90:209-218): sharded-only
+                                # bit-parity mode carrying the ghost ring as
+                                # state; see backends/sharded.py
 
     def __post_init__(self):
         if self.n < 3:

@@ -24,6 +24,28 @@ from ..runtime.logging import get_logger
 _log = get_logger("heat_tpu.dist")
 
 
+def _pod_env() -> bool:
+    """Whether the environment looks like a multi-worker TPU pod — checked
+    WITHOUT backend initialization (unlike jax.default_backend()). A
+    single-hostname TPU_WORKER_HOSTNAMES is a one-worker job (the tunneled
+    single-chip platform sets 'localhost'): nothing to join."""
+    if os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
+
+
+def _already_joined() -> bool:
+    """Whether jax.distributed.initialize already ran — checked WITHOUT
+    touching the XLA backend (jax.process_count() would initialize it, and
+    initialize() raises once backends exist)."""
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -35,15 +57,24 @@ def init_distributed(
     args it auto-discovers from the runtime environment; elsewhere pass the
     coordinator address and process ids (or set JAX_COORDINATOR_ADDRESS /
     JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+
+    MUST run before anything initializes the XLA backend (it is the first
+    act of ``cmd_run`` for the sharded backend, as ``mpi_init`` is the
+    first act of ``program heat``) — so the no-op decision below reads only
+    environment state, never ``jax.process_count()``/``jax.devices()``.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    if _already_joined():
+        return
     explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if explicit is None and jax.default_backend() != "tpu":
+    if explicit is None and not _pod_env():
         _log.info("single-process run (no coordinator configured)")
         return
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
     jax.distributed.initialize(
-        coordinator_address=coordinator_address,
+        coordinator_address=explicit,  # None on a pod: runtime auto-discovers
         num_processes=num_processes,
         process_id=process_id,
     )

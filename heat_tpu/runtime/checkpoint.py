@@ -45,6 +45,75 @@ def save(cfg: HeatConfig, T: np.ndarray, step: int) -> Path:
     return path
 
 
+_SHARD_FMT = "heat_shards_step{step:08d}.proc{proc:04d}.npz"
+
+
+def save_shards(cfg: HeatConfig, T_dev, step: int) -> Path:
+    """Multi-host checkpoint: each process persists only its addressable
+    shards (with their global offsets), one file per process — the analog of
+    the reference's per-rank ``soln#####.dat`` contract
+    (fortran/mpi+cuda/heat.F90:277-288) applied to snapshots. A shared
+    filesystem (the usual pod setup) makes the union a full checkpoint."""
+    import jax
+
+    d = Path(cfg.checkpoint_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / _SHARD_FMT.format(step=step, proc=jax.process_index())
+    payload = {"step": np.asarray(step),
+               "fingerprint": np.asarray(config_fingerprint(cfg))}
+    for i, shard in enumerate(T_dev.addressable_shards):
+        starts = [s.start or 0 for s in shard.index]
+        payload[f"shard{i}_data"] = np.asarray(shard.data)
+        payload[f"shard{i}_start"] = np.asarray(starts, np.int64)
+    tmp = d / (path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **payload)
+    tmp.rename(path)
+    return path
+
+
+def latest_shards(cfg: HeatConfig, max_step: Optional[int] = None) -> Optional[int]:
+    """Newest step for which this process has a shard checkpoint."""
+    import jax
+
+    d = Path(cfg.checkpoint_dir)
+    if not d.is_dir():
+        return None
+    suffix = f".proc{jax.process_index():04d}.npz"
+    steps = sorted(
+        int(p.name[len("heat_shards_step"):len("heat_shards_step") + 8])
+        for p in d.glob("heat_shards_step*.npz") if p.name.endswith(suffix)
+    )
+    if max_step is not None:
+        steps = [s for s in steps if s <= max_step]
+    return steps[-1] if steps else None
+
+
+def load_shards(cfg: HeatConfig, step: int):
+    """Read this process's shard file back: (blocks, step) where blocks is a
+    list of (start_offsets, ndarray). Feed into
+    ``jax.make_array_from_single_device_arrays`` (see
+    backends.common.resolve_initial_field) to rebuild the global array."""
+    import jax
+
+    path = Path(cfg.checkpoint_dir) / _SHARD_FMT.format(
+        step=step, proc=jax.process_index())
+    blocks = []
+    with np.load(path, allow_pickle=False) as z:
+        fp = str(z["fingerprint"])
+        if fp != config_fingerprint(cfg):
+            raise ValueError(
+                f"checkpoint {path} was written for a different physics config "
+                f"(fingerprint {fp} != {config_fingerprint(cfg)})"
+            )
+        i = 0
+        while f"shard{i}_data" in z:
+            blocks.append((tuple(int(s) for s in z[f"shard{i}_start"]),
+                           z[f"shard{i}_data"]))
+            i += 1
+        return blocks, int(z["step"])
+
+
 def latest(cfg: HeatConfig, max_step: Optional[int] = None) -> Optional[Path]:
     """Newest checkpoint, optionally capped at ``max_step`` — resuming a run
     whose ntime is *smaller* than an old checkpoint must not time-travel."""
@@ -55,6 +124,13 @@ def latest(cfg: HeatConfig, max_step: Optional[int] = None) -> Optional[Path]:
     if max_step is not None:
         cks = [c for c in cks if int(c.stem.replace("heat_step", "")) <= max_step]
     return cks[-1] if cks else None
+
+
+def latest_step(cfg: HeatConfig, max_step: Optional[int] = None) -> Optional[int]:
+    """Step index of ``latest()``, parsed here so the filename layout stays
+    this module's private business."""
+    p = latest(cfg, max_step=max_step)
+    return None if p is None else int(p.stem.replace("heat_step", ""))
 
 
 def load(path: Path, cfg: HeatConfig) -> Tuple[np.ndarray, int]:

@@ -3,6 +3,12 @@
 The reference gates every print on ``masterproc`` (rank 0,
 fortran/mpi+cuda/heat.F90:78-79); the JAX equivalent is
 ``jax.process_index() == 0``. Single-process runs always log.
+
+Master-ness is decided LAZILY at emit time, never at import/getLogger time:
+``jax.process_index()`` initializes the XLA backend, and modules that must
+run *before* backend initialization (``parallel.dist`` — the world join
+itself) create loggers at import. Before the backend exists the process is
+treated as master (there is no world yet to be a non-master of).
 """
 
 from __future__ import annotations
@@ -13,6 +19,16 @@ import sys
 
 def _is_master() -> bool:
     try:
+        # the distributed client knows the process id without touching the
+        # XLA backend (set by jax.distributed.initialize)
+        from jax._src.distributed import global_state
+
+        if global_state.client is not None:
+            return global_state.process_id == 0
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return True  # pre-backend: single-process as far as we know
         import jax
 
         return jax.process_index() == 0
@@ -26,6 +42,14 @@ def master_print(*args, **kw) -> None:
         sys.stdout.flush()
 
 
+class _MasterFilter(logging.Filter):
+    """Drop sub-ERROR records on non-master processes (checked per record,
+    so creating the logger costs no backend initialization)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        return record.levelno >= logging.ERROR or _is_master()
+
+
 def get_logger(name: str = "heat_tpu") -> logging.Logger:
     logger = logging.getLogger(name)
     if not logger.handlers:
@@ -33,6 +57,5 @@ def get_logger(name: str = "heat_tpu") -> logging.Logger:
         h.setFormatter(logging.Formatter("[%(name)s] %(levelname)s %(message)s"))
         logger.addHandler(h)
         logger.setLevel(logging.INFO)
-    if not _is_master():
-        logger.setLevel(logging.ERROR)
+        logger.addFilter(_MasterFilter())
     return logger
