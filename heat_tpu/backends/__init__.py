@@ -34,6 +34,10 @@ class SolveResult:
                              # per-shard IO then
     timing: Timing
     gsum: Optional[float] = None   # global temperature sum if report_sum
+    gsum_dtype: Optional[str] = None  # accumulation dtype of gsum ("float64"
+                                   # host path / "float32" on-device without
+                                   # x64) — label so consumers never compare
+                                   # sums across accumulation precisions
     start_step: int = 0            # nonzero when resumed from checkpoint
     mesh_shape: Optional[tuple] = None  # decomposition used (sharded backend)
     T_dev: Any = None              # final field on device (jax.Array)

@@ -117,23 +117,28 @@ def drive(
     # copy is seconds for GiB-scale fields on a tunneled link and the caller
     # only wants timings)
     T_host = to_host(T_dev) if fetch else None
-    gsum = None
+    gsum = gsum_dtype = None
     if cfg.report_sum:
         # The intended-but-commented-out global reduction of the reference
         # (mpi+cuda/heat.F90:266-273), done properly. With the field on host,
         # accumulate in f64 so every backend reports the identical sum
         # regardless of storage dtype; without (fetch=False), reduce on
         # device — a scalar fetch, so still cheap on a tunneled link — in
-        # the widest dtype the platform allows. A multi-host deployment
-        # would psum process-local sums instead.
+        # the widest dtype the platform allows, and LABEL the result
+        # (gsum_dtype) so consumers never compare an f32-accumulated sum
+        # against the f64 host path at 1e9-cell scale. A multi-host
+        # deployment would psum process-local sums instead.
         if T_host is not None:
             gsum = float(np.sum(np.asarray(T_host, np.float64)))
+            gsum_dtype = "float64"
         else:
             acc = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
             gsum = float(np.asarray(jnp.sum(T_dev, dtype=acc)))
+            gsum_dtype = np.dtype(acc).name
     timing = Timing(total_s=time.perf_counter() - t_all0, compile_s=compile_s,
                     solve_s=solve_s, steps=remaining, points=cfg.points)
     return SolveResult(cfg=cfg, T=T_host, timing=timing, gsum=gsum,
+                       gsum_dtype=gsum_dtype,
                        start_step=start_step, T_dev=T_dev)
 
 

@@ -23,16 +23,21 @@ from . import SolveResult, register
 
 
 def _lap_interior(T: np.ndarray) -> np.ndarray:
+    # summation order = the reference expression left-to-right (+1 neighbors
+    # in axis order, then -1 neighbors, then -2*nd*center — fortran/serial/
+    # heat.f90:64-68), so f64 runs bit-match the reference on any field
     nd = T.ndim
     ctr = tuple(slice(1, -1) for _ in range(nd))
-    acc = (-2.0 * nd) * T[ctr]
-    for d in range(nd):
-        up = list(ctr)
-        dn = list(ctr)
-        up[d] = slice(2, None)
-        dn[d] = slice(0, -2)
-        acc = acc + T[tuple(up)] + T[tuple(dn)]
-    return acc
+    shifted = []
+    for off in (slice(2, None), slice(0, -2)):
+        for d in range(nd):
+            sl = list(ctr)
+            sl[d] = off
+            shifted.append(T[tuple(sl)])
+    acc = shifted[0]
+    for s in shifted[1:]:
+        acc = acc + s
+    return acc + (-2.0 * nd) * T[ctr]
 
 
 def step_edges_np(T: np.ndarray, r: float) -> np.ndarray:
@@ -78,4 +83,6 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, **_) -> SolveResult:
     gsum = float(T.sum(dtype=np.float64)) if cfg.report_sum else None
     timing = Timing(total_s=time.perf_counter() - t_all0, solve_s=solve_s,
                     steps=cfg.ntime - start_step, points=cfg.points)
-    return SolveResult(cfg=cfg, T=T, timing=timing, gsum=gsum, start_step=start_step)
+    return SolveResult(cfg=cfg, T=T, timing=timing, gsum=gsum,
+                       gsum_dtype="float64" if gsum is not None else None,
+                       start_step=start_step)

@@ -255,9 +255,11 @@ def _solve_parity(cfg: HeatConfig, T0, mesh, fetch: bool, warm_exec: bool):
     if cfg.report_sum:
         if res.T is not None:
             res.gsum = float(np.sum(np.asarray(res.T, np.float64)))
+            res.gsum_dtype = "float64"
         else:
             acc = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
             res.gsum = float(np.asarray(jnp.sum(res.T_dev, dtype=acc)))
+            res.gsum_dtype = np.dtype(acc).name
     return res
 
 

@@ -58,6 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "or host-staged (NO_AWARE analog)")
     run.add_argument("--mesh", type=_parse_mesh,
                      help="device mesh shape, e.g. 4x2 (sharded backend)")
+    run.add_argument("--virtual-devices", type=int, metavar="N",
+                     help="run on N virtual CPU devices (the reference's "
+                          "single-node 'mpirun -np N' development mode, "
+                          "fortran/mpi+cuda/makefile:1-2; no hardware needed)")
     run.add_argument("--fuse-steps", type=int,
                      help="pallas temporal blocking depth (0=auto, 1=off)")
     run.add_argument("--local-kernel", choices=["auto", "xla", "pallas"],
@@ -127,6 +131,18 @@ def cmd_run(args) -> int:
         cfg = variant_config(args.variant, cfg)
     cfg = _apply_overrides(cfg, args)
 
+    if args.virtual_devices:
+        # must land before the first backend touch; a plain JAX_PLATFORMS
+        # env var is not enough where a site hook pins the TPU platform
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.virtual_devices}")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     if cfg.backend == "sharded":
         # join the multi-process world before any backend/device use — the
         # first act of the reference's distributed variants (mpi_init +
@@ -182,6 +198,7 @@ def cmd_run(args) -> int:
             "per_step_s": res.timing.per_step_s,
             "points_per_s": res.timing.points_per_s,
             "gsum": res.gsum,
+            "gsum_dtype": res.gsum_dtype,
         }))
     return 0
 

@@ -169,7 +169,11 @@ def write_input(cfg: HeatConfig, path: str | Path) -> None:
 VARIANTS = {
     # fortran/serial/heat.f90: hat IC on [0.5,1.5]^2, frozen boundary cells
     "serial": dict(ic="hat", bc="edges", backend="serial", dtype="float64"),
-    # fortran/cuda_kernel/heat.F90:99: hat with y in [0.5,1.0]
+    # fortran/cuda_kernel/heat.F90:99: hat with y in [0.5,1.0].
+    # NOTE: f64 bit-parity implies the XLA step — the hand-written Pallas
+    # kernel has no f64 (no f64 on the TPU VPU), so the pallas backend
+    # transparently falls back. Run with --dtype float32 to exercise the
+    # hand-written kernel itself (contract-tested in tests/test_config.py).
     "cuda_kernel": dict(ic="hat_half", bc="edges", backend="pallas", dtype="float64"),
     "cuda_managed": dict(ic="hat_half", bc="edges", backend="pallas", dtype="float64"),
     # fortran/cuda_cuf/heat.F90:86: same IC family, compiler-generated kernels

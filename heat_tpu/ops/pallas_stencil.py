@@ -140,6 +140,9 @@ def _pallas_2d(T: jax.Array, r: float, ksteps: int,
     """``ksteps`` FTCS steps on an arbitrary 2D array, freezing cells at or
     beyond ``bounds`` (default: the boundary ring — "edges" semantics).
     ksteps must not exceed _KMAX_2D (callers chunk; see _multistep)."""
+    assert ksteps <= _KMAX_2D, (
+        f"ksteps={ksteps} exceeds _KMAX_2D={_KMAX_2D}; chunk via _multistep "
+        f"(unbounded fusion inflates compile time and VMEM)")
     m, n = T.shape
     if bounds is None:
         bounds = jnp.asarray([[0, m - 1, 0, n - 1]], jnp.int32)

@@ -45,19 +45,28 @@ def laplacian_interior(T: jax.Array) -> jax.Array:
 
     Input has shape (m0, ..., m_{d-1}); output (m0-2, ..., m_{d-1}-2) in the
     accumulation dtype: sum(neighbors) - 2*ndim*center.
+
+    Summation order is the reference expression's left-to-right order —
+    all +1 neighbors in axis order, then all -1 neighbors, then the center
+    term (``T(j+1,k) + T(j,k+1) + T(j-1,k) + T(j,k-1) - 4*T(j,k)``,
+    fortran/serial/heat.f90:64-68) — so f64 runs bit-match the reference on
+    ANY field, not just the dyadic-valued shipped ICs where association
+    can't matter.
     """
     nd = T.ndim
     acc_dt = accum_dtype_for(T.dtype)
     Tc = T.astype(acc_dt)
     ctr = tuple(slice(1, -1) for _ in range(nd))
-    acc = (-2.0 * nd) * Tc[ctr]
-    for d in range(nd):
-        up = list(ctr)
-        dn = list(ctr)
-        up[d] = slice(2, None)
-        dn[d] = slice(0, -2)
-        acc = acc + Tc[tuple(up)] + Tc[tuple(dn)]
-    return acc
+    shifted = []
+    for off in (slice(2, None), slice(0, -2)):
+        for d in range(nd):
+            sl = list(ctr)
+            sl[d] = off
+            shifted.append(Tc[tuple(sl)])
+    acc = shifted[0]
+    for s in shifted[1:]:
+        acc = acc + s
+    return acc + (-2.0 * nd) * Tc[ctr]
 
 
 def ftcs_step_edges(T: jax.Array, r) -> jax.Array:

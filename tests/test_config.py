@@ -120,3 +120,29 @@ def test_reference_parity_fixtures():
             (n, sigma, nu, L, ntime, soln), name
         # r == sigma identity holds through the dt derivation chain
         assert abs(cfg.r - cfg.sigma) < 1e-12
+
+
+def test_cuda_kernel_preset_kernel_contract(monkeypatch):
+    """Which kernel actually runs under the cuda_kernel preset is a
+    contract, not an accident: the f64 parity dtype takes the XLA fallback
+    (no f64 on the TPU VPU — pallas_stencil.pallas_available), and the same
+    preset at f32 (--dtype float32) runs the hand-written Pallas kernel."""
+    from heat_tpu.backends import solve
+    from heat_tpu.ops import pallas_stencil
+
+    calls = []
+    real = pallas_stencil._multistep
+
+    def counting(T, r, ksteps, bounds=None):
+        calls.append(ksteps)
+        return real(T, r, ksteps, bounds=bounds)
+
+    monkeypatch.setattr(pallas_stencil, "_multistep", counting)
+
+    cfg = variant_config("cuda_kernel").with_(n=16, ntime=2)
+    assert cfg.dtype == "float64" and cfg.backend == "pallas"
+    solve(cfg)
+    assert calls == [], "f64 parity preset must take the XLA fallback"
+
+    solve(cfg.with_(dtype="float32"))
+    assert calls, "f32 must run the hand-written Pallas kernel"

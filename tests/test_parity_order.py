@@ -1,0 +1,130 @@
+"""parity_order: literal update-then-swap step ordering vs a multi-rank
+transcription of the reference's distributed loop.
+
+The reference's time loop updates every owned cell against the ghosts *as
+they are*, then swaps (fortran/mpi+cuda/heat.F90:206-219). With the shipped
+IC the ghost ring starts filled (the IC assigns the whole padded array,
+:243-251), so update-then-swap and the framework's default
+exchange-then-update produce bit-identical owned cells. With an explicit T0
+(a raw restart: nothing fills the ghosts) the first update reads stale
+ghosts and the two orders genuinely diverge — the case round 1 argued about
+in prose and this file makes executable.
+"""
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig
+from heat_tpu.grid import initial_condition
+
+
+BASE = HeatConfig(n=24, ntime=7, dtype="float64", backend="sharded",
+                  bc="ghost", ic="uniform", parity_order=True)
+
+
+def literal_mpi_update_then_swap(T0, r, nsteps, bc, nranks, seed_from_ic):
+    """Multi-rank transcription of fortran/mpi+cuda/heat.F90:199-223.
+
+    1-D x decomposition over ``nranks`` (ndims=1, :28; nx=n/nblocks :92);
+    each rank owns a padded ``(1-ng:nx+ng, 1-ng:ny+ng)`` block with ng=1
+    (:107). Per step: snapshot (:208), update ALL owned cells reading
+    ghosts (:209-215), then swap() exchanges the owned edge rows into the
+    neighbors' ghost rows, proc_null edges untouched (:145-193).
+    """
+    n = T0.shape[0]
+    nx = n // nranks
+    local = []
+    for rank in range(nranks):
+        G = np.full((nx + 2, n + 2), bc, dtype=T0.dtype)
+        G[1:-1, 1:-1] = T0[rank * nx:(rank + 1) * nx, :]
+        local.append(G)
+    if seed_from_ic:
+        # the IC evaluates at ghost coordinates too (T = 2.0 assigns the
+        # whole padded array, :243): interior-facing ghosts start holding
+        # exactly the neighbor's edge values
+        for rank in range(nranks):
+            if rank > 0:
+                local[rank][0, 1:-1] = T0[rank * nx - 1, :]
+            if rank < nranks - 1:
+                local[rank][-1, 1:-1] = T0[(rank + 1) * nx, :]
+    for _ in range(nsteps):
+        old = [G.copy() for G in local]               # Td_old = Td   :208
+        for rank in range(nranks):
+            G, Gold = local[rank], old[rank]
+            for j in range(1, nx + 1):                # all owned cells :209-215
+                for k in range(1, n + 1):
+                    G[j, k] = Gold[j, k] + r * (
+                        Gold[j + 1, k] + Gold[j, k + 1]
+                        + Gold[j - 1, k] + Gold[j, k - 1] - 4 * Gold[j, k])
+        # call swap()  :218 — collect sends first (lockstep sendrecv), owned
+        # columns only (j=1..ny, :154-158); proc_null edges skipped :174-191
+        sends = [(G[1, 1:-1].copy(), G[-2, 1:-1].copy()) for G in local]
+        for rank in range(nranks):
+            if rank > 0:
+                local[rank][0, 1:-1] = sends[rank - 1][1]
+            if rank < nranks - 1:
+                local[rank][-1, 1:-1] = sends[rank + 1][0]
+    return np.concatenate([G[1:-1, 1:-1] for G in local], axis=0)
+
+
+def test_parity_order_matches_literal_transcription_ic_start():
+    """IC start: parity path == the literal multi-rank loop, bitwise."""
+    cfg = BASE.with_(mesh_shape=(4, 1))
+    T0 = initial_condition(cfg)
+    expect = literal_mpi_update_then_swap(
+        T0, cfg.r, cfg.ntime, cfg.bc_value, 4, seed_from_ic=True)
+    got = solve(cfg)
+    np.testing.assert_array_equal(got.T, expect)
+
+
+def test_parity_order_matches_literal_transcription_explicit_t0():
+    """Explicit-T0 start (raw restart, ghosts unseeded): the literal
+    stale-first-step behavior, bitwise."""
+    cfg = BASE.with_(mesh_shape=(4, 1))
+    rng = np.random.default_rng(7)
+    T0 = rng.uniform(1.0, 2.0, size=(cfg.n, cfg.n))
+    expect = literal_mpi_update_then_swap(
+        T0, cfg.r, cfg.ntime, cfg.bc_value, 4, seed_from_ic=False)
+    got = solve(cfg, T0=T0)
+    np.testing.assert_array_equal(got.T, expect)
+
+
+def test_parity_order_ic_start_bitmatches_default_order():
+    """With shipped ICs the orders are indistinguishable (the equivalence
+    the sharded docstring claims): bit-identical owned cells."""
+    cfg = BASE.with_(mesh_shape=(2, 4))
+    par = solve(cfg)
+    default = solve(cfg.with_(parity_order=False))
+    np.testing.assert_array_equal(par.T, default.T)
+
+
+def test_parity_order_explicit_t0_diverges_from_default_order():
+    """Explicit T0: update-then-swap reads stale ghosts on step 1 — the
+    orders genuinely differ, so the flag is observable, not decorative."""
+    cfg = BASE.with_(mesh_shape=(4, 1), ntime=3)
+    rng = np.random.default_rng(11)
+    T0 = rng.uniform(1.0, 2.0, size=(cfg.n, cfg.n))
+    par = solve(cfg, T0=T0)
+    default = solve(cfg.with_(parity_order=False), T0=T0)
+    assert not np.array_equal(par.T, default.T)
+    # ...and the divergence is exactly at shard-boundary-adjacent cells:
+    # interior rows far from the rank edges agree after 1 step's reach
+    diff = np.abs(par.T - default.T)
+    assert diff[: cfg.n // 4 - 3].max() == 0.0
+
+
+def test_parity_order_2d_mesh_matches_serial_for_ic():
+    """parity_order generalizes the reference's 1-D split to the 2-D mesh;
+    IC-start equivalence means it still matches the serial oracle."""
+    cfg = BASE.with_(mesh_shape=(2, 2), ntime=9)
+    got = solve(cfg)
+    ref = solve(cfg.with_(backend="serial", mesh_shape=None,
+                          parity_order=False))
+    np.testing.assert_array_equal(got.T, ref.T)
+
+
+def test_parity_order_rejects_checkpointing():
+    cfg = BASE.with_(mesh_shape=(2, 2), checkpoint_every=2)
+    with pytest.raises(ValueError, match="parity_order"):
+        solve(cfg)

@@ -79,6 +79,45 @@ def test_sharded_staged_comm_matches_direct():
     np.testing.assert_allclose(staged.T, direct.T, rtol=0, atol=0)
 
 
+def test_staged_comm_host_roundtrip_actually_fires(monkeypatch):
+    """comm='staged' must really route slabs through host memory — numeric
+    equality alone is tautological (the callback is an identity). Count the
+    host callbacks: staged fires them per axis per exchange (send + recv
+    legs, distinct slab shapes per axis); direct fires none. Fails if
+    staged=True silently takes the direct path."""
+    import jax as _jax
+
+    from heat_tpu.parallel import halo
+
+    calls = []
+
+    def counting_stage(x):
+        def cb(a):
+            calls.append(tuple(a.shape))
+            return np.asarray(a)
+
+        return _jax.pure_callback(
+            cb, _jax.ShapeDtypeStruct(x.shape, x.dtype), x,
+            vmap_method="sequential")
+
+    monkeypatch.setattr(halo, "_stage_through_host", counting_stage)
+    cfg = BASE.with_(mesh_shape=(2, 2), bc="ghost", ic="uniform", ntime=4,
+                     fuse_steps=2)
+    solve(cfg.with_(comm="staged"))
+    n_exchanges = 2  # ntime=4 at fuse depth 2
+    # send+recv legs on both sides of both axes: >= 4 stagings per axis per
+    # exchange (per shard on top, but don't over-specify runtime sharding)
+    assert len(calls) >= 4 * 2 * n_exchanges, calls
+    shapes = set(calls)
+    w = 2  # fused halo width
+    assert any(s[0] == w and s[1] > w for s in shapes), shapes  # x-axis slabs
+    assert any(s[1] == w and s[0] > w for s in shapes), shapes  # y-axis slabs
+
+    calls.clear()
+    solve(cfg.with_(comm="direct"))
+    assert calls == [], "direct path must never stage through host"
+
+
 def test_sharded_3d():
     cfg = HeatConfig(n=16, ndim=3, ntime=5, dtype="float64", sigma=0.15,
                      ic="hat", backend="sharded", mesh_shape=(2, 2, 2))
