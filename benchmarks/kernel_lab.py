@@ -1,0 +1,387 @@
+"""Kernel experiments: candidate Pallas stencil designs, measured on the
+real chip. Not part of the framework — a lab bench for pallas_stencil.py
+tuning (results feed _plan_3d / band budgets there).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/kernel_lab.py <exp>
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+VMEM_LIMIT = 110 * 1024 * 1024
+
+
+def _round_up(x, m):
+    return ((x + m - 1) // m) * m
+
+
+# ---------------------------------------------------------------------------
+# candidate: (row, mid)-tiled 3D kernel, 3x3 halo blocks, shrinking slices
+# ---------------------------------------------------------------------------
+
+
+def make_3d_tiled(r, R, M, k, km, shape_pad, ksteps, n_logical):
+    m_pad, mid_pad, n_pad = shape_pad
+    rows = R + 2 * k
+    mids = M + 2 * km
+
+    def kernel(bounds_ref, c00, c01, c02, c10, c11, c12, c20, c21, c22,
+               out_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        store_dt = out_ref.dtype
+        acc_dt = jnp.float32
+        top = jnp.concatenate([c00[:], c01[:], c02[:]], axis=1)
+        mid = jnp.concatenate([c10[:], c11[:], c12[:]], axis=1)
+        bot = jnp.concatenate([c20[:], c21[:], c22[:]], axis=1)
+        band = jnp.concatenate([top, mid, bot], axis=0).astype(acc_dt)
+
+        bshape = (rows, mids, n_pad)
+        grow = i * R - k + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
+        gmid = j * M - km + jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
+        gcol = jax.lax.broadcasted_iota(jnp.int32, bshape, 2)
+        frozen = (
+            (grow <= bounds_ref[0, 0]) | (grow >= bounds_ref[0, 1])
+            | (gmid <= bounds_ref[0, 2]) | (gmid >= bounds_ref[0, 3])
+            | (gcol <= bounds_ref[0, 4]) | (gcol >= bounds_ref[0, 5])
+        )
+        maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
+
+        cur = band
+        for s in range(ksteps):
+            lf = pltpu.roll(cur, 1, 2)
+            rt = pltpu.roll(cur, n_pad - 1, 2)
+            ctr = cur[1:-1, 1:-1, :]
+            lap = (cur[2:, 1:-1, :] + cur[:-2, 1:-1, :]
+                   + cur[1:-1, 2:, :] + cur[1:-1, :-2, :]
+                   + lf[1:-1, 1:-1, :] + rt[1:-1, 1:-1, :]
+                   - 6.0 * ctr)
+            m_s = maskr[s + 1: rows - s - 1, s + 1: mids - s - 1, :]
+            cur = ctr + m_s * lap
+        ro = k - ksteps
+        mo = km - ksteps
+        out_ref[:] = jax.lax.slice(
+            cur, (ro, mo, 0), (ro + R, mo + M, n_pad)).astype(store_dt)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("r", "ksteps", "R", "M", "k", "km",
+                                    "logical"))
+def pallas_3d_tiled(Tp, r, ksteps, R, M, k, km, logical,
+                    bounds=None):
+    m_pad, mid_pad, n_pad = Tp.shape
+    m, mid, n = logical
+    assert m_pad % R == 0 and mid_pad % M == 0
+    assert R % k == 0 and M % km == 0 and ksteps <= min(k, km)
+    if bounds is None:
+        bounds = jnp.asarray([[0, m - 1, 0, mid - 1, 0, n - 1]], jnp.int32)
+    bounds = bounds.reshape(1, 6).astype(jnp.int32)
+    gr, gm = m_pad // R, mid_pad // M
+    rr, rm = R // k, M // km
+    nrb, nmb = m_pad // k, mid_pad // km
+    smem = pl.BlockSpec((1, 6), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
+
+    def bs(shape, imap):
+        return pl.BlockSpec(shape, imap, memory_space=pltpu.VMEM)
+
+    def rclamp(i):
+        return jnp.clip(i, 0, nrb - 1)
+
+    def mclamp(j):
+        return jnp.clip(j, 0, nmb - 1)
+
+    in_specs = [
+        smem,
+        bs((k, km, n_pad), lambda i, j: (rclamp(i * rr - 1), mclamp(j * rm - 1), 0)),
+        bs((k, M, n_pad), lambda i, j: (rclamp(i * rr - 1), j, 0)),
+        bs((k, km, n_pad), lambda i, j: (rclamp(i * rr - 1), mclamp((j + 1) * rm), 0)),
+        bs((R, km, n_pad), lambda i, j: (i, mclamp(j * rm - 1), 0)),
+        bs((R, M, n_pad), lambda i, j: (i, j, 0)),
+        bs((R, km, n_pad), lambda i, j: (i, mclamp((j + 1) * rm), 0)),
+        bs((k, km, n_pad), lambda i, j: (rclamp((i + 1) * rr), mclamp(j * rm - 1), 0)),
+        bs((k, M, n_pad), lambda i, j: (rclamp((i + 1) * rr), j, 0)),
+        bs((k, km, n_pad), lambda i, j: (rclamp((i + 1) * rr), mclamp((j + 1) * rm), 0)),
+    ]
+    out = pl.pallas_call(
+        make_3d_tiled(float(r), R, M, k, km, Tp.shape, ksteps, n),
+        out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
+        grid=(gr, gm),
+        in_specs=in_specs,
+        out_specs=bs((R, M, n_pad), lambda i, j: (i, j, 0)),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT),
+        interpret=jax.default_backend() != "tpu",
+    )(bounds, *([Tp] * 9))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# candidate: (row, col)-tiled 2D kernel for very wide arrays (bf16 32768^2):
+# 3x3 halo blocks, col halo lane-aligned (128), shrinking slices, no rolls
+# ---------------------------------------------------------------------------
+
+
+def make_2d_coltiled(r, R, C, kr, kc, n_pad, ksteps):
+    rows = R + 2 * kr
+    cols = C + 2 * kc
+
+    def kernel(bounds_ref, c00, c01, c02, c10, c11, c12, c20, c21, c22,
+               out_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        store_dt = out_ref.dtype
+        acc_dt = jnp.float32
+        top = jnp.concatenate([c00[:], c01[:], c02[:]], axis=1)
+        mid = jnp.concatenate([c10[:], c11[:], c12[:]], axis=1)
+        bot = jnp.concatenate([c20[:], c21[:], c22[:]], axis=1)
+        band = jnp.concatenate([top, mid, bot], axis=0).astype(acc_dt)
+
+        bshape = (rows, cols)
+        grow = i * R - kr + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
+        gcol = j * C - kc + jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
+        frozen = (
+            (grow <= bounds_ref[0, 0]) | (grow >= bounds_ref[0, 1])
+            | (gcol <= bounds_ref[0, 2]) | (gcol >= bounds_ref[0, 3])
+        )
+        maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
+
+        cur = band
+        for s in range(ksteps):
+            ctr = cur[1:-1, 1:-1]
+            lap = (cur[2:, 1:-1] + cur[:-2, 1:-1]
+                   + cur[1:-1, 2:] + cur[1:-1, :-2] - 4.0 * ctr)
+            m_s = maskr[s + 1: rows - s - 1, s + 1: cols - s - 1]
+            cur = ctr + m_s * lap
+        ro = kr - ksteps
+        co = kc - ksteps
+        out_ref[:] = jax.lax.slice(
+            cur, (ro, co), (ro + R, co + C)).astype(store_dt)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("r", "ksteps", "R", "C", "kr", "kc",
+                                    "logical"))
+def pallas_2d_coltiled(Tp, r, ksteps, R, C, kr, kc, logical, bounds=None):
+    m_pad, n_pad = Tp.shape
+    m, n = logical
+    assert m_pad % R == 0 and n_pad % C == 0
+    assert R % kr == 0 and C % kc == 0 and ksteps <= min(kr, kc)
+    if bounds is None:
+        bounds = jnp.asarray([[0, m - 1, 0, n - 1]], jnp.int32)
+    bounds = bounds.reshape(1, 4).astype(jnp.int32)
+    gr, gc = m_pad // R, n_pad // C
+    rr, rc = R // kr, C // kc
+    nrb, ncb = m_pad // kr, n_pad // kc
+    smem = pl.BlockSpec((1, 4), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
+
+    def bs(shape, imap):
+        return pl.BlockSpec(shape, imap, memory_space=pltpu.VMEM)
+
+    def rcl(i):
+        return jnp.clip(i, 0, nrb - 1)
+
+    def ccl(j):
+        return jnp.clip(j, 0, ncb - 1)
+
+    in_specs = [
+        smem,
+        bs((kr, kc), lambda i, j: (rcl(i * rr - 1), ccl(j * rc - 1))),
+        bs((kr, C), lambda i, j: (rcl(i * rr - 1), j)),
+        bs((kr, kc), lambda i, j: (rcl(i * rr - 1), ccl((j + 1) * rc))),
+        bs((R, kc), lambda i, j: (i, ccl(j * rc - 1))),
+        bs((R, C), lambda i, j: (i, j)),
+        bs((R, kc), lambda i, j: (i, ccl((j + 1) * rc))),
+        bs((kr, kc), lambda i, j: (rcl((i + 1) * rr), ccl(j * rc - 1))),
+        bs((kr, C), lambda i, j: (rcl((i + 1) * rr), j)),
+        bs((kr, kc), lambda i, j: (rcl((i + 1) * rr), ccl((j + 1) * rc))),
+    ]
+    return pl.pallas_call(
+        make_2d_coltiled(float(r), R, C, kr, kc, n_pad, ksteps),
+        out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
+        grid=(gr, gc),
+        in_specs=in_specs,
+        out_specs=bs((R, C), lambda i, j: (i, j)),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT),
+        interpret=jax.default_backend() != "tpu",
+    )(bounds, *([Tp] * 9))
+
+
+def check_2d_coltiled():
+    rng = np.random.default_rng(1)
+    m, n = 100, 500
+    for dt, tol in ((np.float32, 2e-6), (jnp.bfloat16, 3e-2)):
+        T = rng.uniform(1, 2, (m, n)).astype(dt)
+        r = 0.2
+        R, C, kr, kc = 16, 256, 16, 128
+        m_pad = _round_up(m, R)
+        n_pad = _round_up(n, C)
+        Tp = jnp.pad(jnp.asarray(T), ((0, m_pad - m), (0, n_pad - n)))
+        for ks in (1, 5, 16):
+            out = pallas_2d_coltiled(Tp, r=r, ksteps=ks, R=R, C=C, kr=kr,
+                                     kc=kc, logical=(m, n))[:m, :n]
+            ref = ref_steps(jnp.asarray(T), r, ks)
+            err = float(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32)).max())
+            print(f"2d coltiled {np.dtype(dt).name} ksteps={ks}: "
+                  f"max err {err:.2e}")
+            assert err < tol, err
+
+
+def bench_2d(configs, n2=32768, dtype="bfloat16", steps=96):
+    from heat_tpu.runtime.timing import sync
+
+    r = 0.25
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    made = {}
+    for R, C, kr, kc in configs:
+        m_pad = _round_up(n2, R)
+        n_pad = _round_up(n2, C)
+        shape = (m_pad, n_pad)
+        if shape not in made:
+            made[shape] = jax.jit(
+                lambda shape=shape: jax.random.uniform(
+                    jax.random.PRNGKey(0), shape, jnp.float32, 1.0, 2.0
+                ).astype(dt))()
+            sync(made[shape])
+        dev = made[shape]
+        k = min(kr, kc)
+
+        @jax.jit
+        def run(Tp, R=R, C=C, kr=kr, kc=kc, k=k):
+            def body(i, t):
+                return pallas_2d_coltiled(t, r=r, ksteps=k, R=R, C=C,
+                                          kr=kr, kc=kc, logical=(n2, n2))
+            return jax.lax.fori_loop(0, steps // k, body, Tp)
+
+        try:
+            t0 = time.perf_counter()
+            c = run.lower(dev).compile()
+            compile_s = time.perf_counter() - t0
+            sync(c(dev))
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = c(dev)
+                sync(out)
+                best = min(best, time.perf_counter() - t0)
+            nsteps = (steps // k) * k
+            pts = n2 * n2 * nsteps / best
+            roof = 2.048e11 if dtype == "bfloat16" else 1.024e11
+            print(f"R={R:4d} C={C:6d} kr={kr} kc={kc}: {pts:.3e} pts/s "
+                  f"({pts / roof * 100:.0f}% {dtype} roofline)"
+                  f"  [compile {compile_s:.0f}s]", flush=True)
+        except Exception as e:
+            print(f"R={R:4d} C={C:6d} kr={kr} kc={kc}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+
+
+# ---------------------------------------------------------------------------
+# reference semantics for correctness check
+# ---------------------------------------------------------------------------
+
+
+def ref_steps(T, r, ksteps):
+    sys.path.insert(0, ".")
+    from heat_tpu.ops.stencil import ftcs_step_edges
+
+    for _ in range(ksteps):
+        T = ftcs_step_edges(T, r)
+    return T
+
+
+def check_3d():
+    rng = np.random.default_rng(0)
+    m, mid, n = 40, 24, 300
+    T = rng.uniform(1, 2, (m, mid, n)).astype(np.float32)
+    r = 0.15
+    k = km = 4
+    R, M = 8, 8
+    m_pad = _round_up(m, R)
+    mid_pad = _round_up(mid, M)
+    n_pad = _round_up(n, 128)
+    Tp = jnp.pad(jnp.asarray(T), ((0, m_pad - m), (0, mid_pad - mid),
+                                  (0, n_pad - n)))
+    for ks in (1, 3, 4):
+        out = pallas_3d_tiled(Tp, r=r, ksteps=ks, R=R, M=M, k=k, km=km,
+                              logical=(m, mid, n))[:m, :mid, :n]
+        ref = ref_steps(jnp.asarray(T), r, ks)
+        err = float(jnp.abs(out - ref).max())
+        print(f"3d tiled ksteps={ks}: max err {err:.2e}")
+        assert err < 2e-6, err
+
+
+def bench_3d(configs):
+    """On-device data (no 512 MiB tunnel transfers); arrays reused."""
+    from heat_tpu.runtime.timing import sync
+
+    n3 = 512
+    r = 0.15
+    steps = 240
+    made = {}
+    for R, M, k, km in configs:
+        m_pad = _round_up(n3, R)
+        mid_pad = _round_up(n3, M)
+        shape = (m_pad, mid_pad, n3)
+        if shape not in made:
+            made[shape] = jax.jit(
+                lambda shape=shape: jax.random.uniform(
+                    jax.random.PRNGKey(0), shape, jnp.float32, 1.0, 2.0))()
+            sync(made[shape])
+        dev = made[shape]
+
+        @jax.jit
+        def run(Tp, R=R, M=M, k=k, km=km):
+            def body(i, t):
+                return pallas_3d_tiled(t, r=r, ksteps=min(k, km), R=R, M=M,
+                                       k=k, km=km, logical=(n3, n3, n3))
+            return jax.lax.fori_loop(0, steps // min(k, km), body, Tp)
+
+        try:
+            t0 = time.perf_counter()
+            c = run.lower(dev).compile()
+            compile_s = time.perf_counter() - t0
+            sync(c(dev))  # warm
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = c(dev)
+                sync(out)
+                best = min(best, time.perf_counter() - t0)
+            nsteps = (steps // min(k, km)) * min(k, km)
+            pts = n3 ** 3 * nsteps / best
+            print(f"R={R:4d} M={M:4d} k={k} km={km}: "
+                  f"{pts:.3e} pts/s  ({pts / 1.024e11 * 100:.0f}% roofline)"
+                  f"  [compile {compile_s:.0f}s]", flush=True)
+        except Exception as e:
+            print(f"R={R:4d} M={M:4d} k={k} km={km}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    exp = sys.argv[1] if len(sys.argv) > 1 else "check3d"
+    if exp == "check3d":
+        check_3d()
+    elif exp == "bench3d":
+        # configs on argv: R,M,k,km quadruples like 64,64,8,8
+        cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
+        bench_3d(cfgs or [(64, 64, 8, 8)])
+    elif exp == "check2d":
+        check_2d_coltiled()
+    elif exp == "bench2d":
+        cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
+        bench_2d(cfgs or [(256, 4096, 16, 128)])
+    elif exp == "bench2d_f32":
+        cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
+        bench_2d(cfgs or [(256, 4096, 16, 128)], dtype="float32")

@@ -189,65 +189,144 @@ def _pallas_2d(T: jax.Array, r: float, ksteps: int,
 
 
 # --------------------------------------------------------------------------
-# 3D: plane-tiled kernel, arbitrary shapes, temporal blocking within VMEM
+# two-axis tiling (3x3 halo-block scheme): shared planning machinery
+#
+# The thin-band 2D kernel above tiles rows only; its band must span the full
+# row width, so very wide arrays (bf16 32768^2: 128 KiB/row) afford few rows
+# per band and the halo fraction balloons (round-1: 1.5x redundant compute).
+# The 3D kernel has the same disease worse: whole (mid, n) planes as halo.
+# Cure for both: tile a second axis too, fetching a 3x3 neighborhood of
+# blocks (4 corners + 4 edges + center) so halo volume scales with the tile
+# surface. Mini-steps use shrinking slices (the valid region loses one cell
+# per side per step) instead of full-band rotates — on the non-lane axes a
+# shifted slice is an addressing offset, not a data permute.
 # --------------------------------------------------------------------------
 
 
-# rough v5e machine balance for the 3D plan's cost model: effective VPU
-# elementwise rate (backed out of the measured 2D kernel: ~10 ops/pt-step
-# at 1.4e11 pts/s) and HBM bandwidth
-_VPU_OPS_PER_S = 1.4e12
+# v5e machine balance for the plans' cost model: effective vector-op rate
+# backed out of the measured thin-band 2D kernel (4096^2 f32: 1.41e11 pts/s
+# at ~12.4 ops/pt-step) and HBM bandwidth
+_VPU_OPS_PER_S = 1.75e12
 _HBM_BYTES_PER_S = 819e9
+# VMEM feasibility for the 3x3 scheme: double-buffered in/out blocks in the
+# storage dtype + the assembled band and its mini-step temporaries in the
+# accumulation dtype must fit under the Mosaic limit with headroom
+_VMEM_FIT_BYTES = 88 * 1024 * 1024
 
 
-def _plan_3d(shape, dtype, ksteps: int):
-    """Choose (padded_shape, tile, kchunk) for the plane-tiled 3D kernel.
+def _fits_vmem(band_cells: int, tile_cells: int, item: int) -> bool:
+    pipeline = 2 * (band_cells + tile_cells) * item
+    working = 3 * band_cells * 4  # band + ~2 live temps, accumulation dtype
+    return pipeline + working <= _VMEM_FIT_BYTES
 
-    The halo here is whole (mid, n) planes, so — unlike 2D, where the halo
-    slab is a thin strip — deeper fusion shrinks HBM traffic but inflates
-    the redundantly-computed band fraction (tile+2k)/tile. Pick the
-    (tile, k) minimizing max(compute, bandwidth) per point-step under the
-    band budget."""
+
+def _grid_specs_3x3(shape_blocks, halo_blocks, nblocks, extra_dims):
+    """BlockSpecs for the 3x3 neighborhood fetch over a (gi, gj) grid.
+
+    ``shape_blocks`` = (tile_i, tile_j), ``halo_blocks`` = (k_i, k_j) block
+    sizes; ``nblocks`` = (#halo-granularity blocks per axis) for clamping;
+    ``extra_dims`` = trailing full-extent dims (3D: the lane axis).
+    """
+    (Ti, Tj), (ki, kj) = shape_blocks, halo_blocks
+    ri, rj = Ti // ki, Tj // kj
+    ni, nj = nblocks
+    ext = tuple(extra_dims)
+    zeros = (0,) * len(ext)
+
+    def icl(i):
+        return jnp.clip(i, 0, ni - 1)
+
+    def jcl(j):
+        return jnp.clip(j, 0, nj - 1)
+
+    def bs(shape, imap):
+        return pl.BlockSpec(shape + ext, imap, memory_space=pltpu.VMEM)
+
+    return [
+        bs((ki, kj), lambda i, j: (icl(i * ri - 1), jcl(j * rj - 1)) + zeros),
+        bs((ki, Tj), lambda i, j: (icl(i * ri - 1), j) + zeros),
+        bs((ki, kj), lambda i, j: (icl(i * ri - 1), jcl((j + 1) * rj)) + zeros),
+        bs((Ti, kj), lambda i, j: (i, jcl(j * rj - 1)) + zeros),
+        bs((Ti, Tj), lambda i, j: (i, j) + zeros),
+        bs((Ti, kj), lambda i, j: (i, jcl((j + 1) * rj)) + zeros),
+        bs((ki, kj), lambda i, j: (icl((i + 1) * ri), jcl(j * rj - 1)) + zeros),
+        bs((ki, Tj), lambda i, j: (icl((i + 1) * ri), j) + zeros),
+        bs((ki, kj), lambda i, j: (icl((i + 1) * ri), jcl((j + 1) * rj)) + zeros),
+    ], bs((Ti, Tj), lambda i, j: (i, j) + zeros)
+
+
+def _assemble_band(refs, acc_dt):
+    """Concatenate the 3x3 fetched blocks into one band, rows x mids."""
+    rows = [jnp.concatenate([refs[3 * g][:], refs[3 * g + 1][:],
+                             refs[3 * g + 2][:]], axis=1) for g in range(3)]
+    return jnp.concatenate(rows, axis=0).astype(acc_dt)
+
+
+# --------------------------------------------------------------------------
+# 3D: (row, mid)-tiled kernel, lane axis full-extent
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _plan_3d(shape, dtype_str, ksteps: int):
+    """Choose ((m_pad, mid_pad, n_pad), R, M, kchunk) for the tiled 3D
+    kernel: minimize max(compute, bandwidth) per point-step. Ops/pt-step ~
+    13 x band/tile area ratio (2 lane rotates + 2 sublane-shifted reads +
+    ~9 arithmetic; row-axis neighbor reads are addressing offsets)."""
     m, mid, n = shape
+    sub = _sublane(dtype_str)
     n_pad = _round_up(max(n, 128), 128)
-    mid_pad = _round_up(max(mid, _sublane(dtype)), _sublane(dtype))
-    plane = mid_pad * n_pad * 4  # band is held in the accumulation dtype
-    budget_planes = max(3, _BAND_BUDGET_BYTES // plane)
-    item = jnp.dtype(dtype).itemsize
+    item = jnp.dtype(dtype_str).itemsize
     best = None
     for k in range(1, min(max(ksteps, 1), 8) + 1):
-        cap = budget_planes - 2 * k
-        if cap < k:
-            continue
-        # don't tile far past the array itself (padding is wasted work)
-        cap = min(cap, _round_up(max(m, k), k))
-        tile = (cap // k) * k
-        compute = 11.0 * (tile + 2 * k) / tile / _VPU_OPS_PER_S
-        bw = (2.0 * tile + 2 * k) / (tile * k) * item / _HBM_BYTES_PER_S
-        key = (max(compute, bw), -k)
-        if best is None or key < best[0]:
-            best = (key, tile, k)
-    _, tile, kchunk = best
-    m_pad = _round_up(max(m, tile), tile)
-    return (m_pad, mid_pad, n_pad), tile, kchunk
+        km = _round_up(k, sub)
+        for R in (8, 16, 32, 48, 64, 96, 128):
+            if R % k:
+                R = _round_up(R, k)
+            R = min(R, _round_up(max(m, k), k))
+            for M in (sub, 32, 64, 96, 128, 192):
+                M = _round_up(M, km)
+                M = min(M, _round_up(max(mid, km), km))
+                band = (R + 2 * k) * (M + 2 * km)
+                tile = R * M
+                if not _fits_vmem(band * n_pad, tile * n_pad, item):
+                    continue
+                compute = 13.0 * band / tile / _VPU_OPS_PER_S
+                bw = (band + tile) * item / (tile * k) / _HBM_BYTES_PER_S
+                key = (max(compute, bw), band)
+                if best is None or key < best[0]:
+                    best = (key, R, M, k)
+    if best is None:
+        # lane extent so large no (R, M, k) band fits VMEM: no kernel plan —
+        # pallas_available() reports False and callers take the XLA path
+        return None
+    _, R, M, k = best
+    m_pad = _round_up(max(m, R), R)
+    mid_pad = _round_up(max(mid, M), M)
+    return (m_pad, mid_pad, n_pad), R, M, k
 
 
-def _make_kernel_3d(r: float, tile: int, kpad: int, shape_pad, ksteps: int):
-    """Kernel body; ``bounds_ref`` is SMEM (1,6) i32
-    [row_lo, row_hi, mid_lo, mid_hi, col_lo, col_hi] (see 2D)."""
-    _, mid_p, n_p = shape_pad
-    rows = tile + 2 * kpad
+def _make_kernel_3d(r: float, R: int, M: int, k: int, km: int, n_pad: int,
+                    ksteps: int):
+    """(row, mid)-tiled 3D body; ``bounds_ref`` is SMEM (1,6) i32
+    [row_lo, row_hi, mid_lo, mid_hi, col_lo, col_hi] (see 2D). Mini-steps
+    shrink the valid region by one cell per side (rows/mids); lane
+    neighbors are wrap rotates whose band-edge garbage is confined the
+    same way as the thin-band kernel's."""
+    rows = R + 2 * k
+    mids = M + 2 * km
 
-    def kernel(bounds_ref, prev_ref, cur_ref, next_ref, out_ref):
+    def kernel(bounds_ref, *refs):
         i = pl.program_id(0)
+        j = pl.program_id(1)
+        out_ref = refs[-1]
         store_dt = out_ref.dtype
         acc_dt = accum_dtype_for(store_dt)
-        band = jnp.concatenate(
-            [prev_ref[:], cur_ref[:], next_ref[:]], axis=0
-        ).astype(acc_dt)
-        bshape = (rows, mid_p, n_p)
-        grow = i * tile - kpad + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
-        gmid = jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
+        band = _assemble_band(refs[:9], acc_dt)
+
+        bshape = (rows, mids, n_pad)
+        grow = i * R - k + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
+        gmid = j * M - km + jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
         gcol = jax.lax.broadcasted_iota(jnp.int32, bshape, 2)
         frozen = (
             (grow <= bounds_ref[0, 0]) | (grow >= bounds_ref[0, 1])
@@ -256,64 +335,191 @@ def _make_kernel_3d(r: float, tile: int, kpad: int, shape_pad, ksteps: int):
         )
         maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
 
-        for _ in range(ksteps):  # static unroll
-            up = pltpu.roll(band, 1, 0)
-            dn = pltpu.roll(band, rows - 1, 0)
-            fw = pltpu.roll(band, 1, 1)
-            bk = pltpu.roll(band, mid_p - 1, 1)
-            lf = pltpu.roll(band, 1, 2)
-            rt = pltpu.roll(band, n_p - 1, 2)
-            band = band + maskr * (up + dn + fw + bk + lf + rt - 6.0 * band)
-        out_ref[:] = band[kpad : kpad + tile].astype(store_dt)
+        cur = band
+        for s in range(ksteps):  # static unroll, shrinking shapes
+            lf = pltpu.roll(cur, 1, 2)
+            rt = pltpu.roll(cur, n_pad - 1, 2)
+            ctr = cur[1:-1, 1:-1, :]
+            lap = (cur[2:, 1:-1, :] + cur[:-2, 1:-1, :]
+                   + cur[1:-1, 2:, :] + cur[1:-1, :-2, :]
+                   + lf[1:-1, 1:-1, :] + rt[1:-1, 1:-1, :] - 6.0 * ctr)
+            m_s = maskr[s + 1: rows - s - 1, s + 1: mids - s - 1, :]
+            cur = ctr + m_s * lap
+        out_ref[:] = jax.lax.slice(
+            cur, (k - ksteps, km - ksteps, 0),
+            (k - ksteps + R, km - ksteps + M, n_pad)).astype(store_dt)
 
     return kernel
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("r", "ksteps", "kpad", "logical_shape"))
-def _pallas_3d_aligned(Tp: jax.Array, r: float, ksteps: int, kpad: int,
+                   static_argnames=("r", "ksteps", "kplan", "logical_shape"))
+def _pallas_3d_aligned(Tp: jax.Array, r: float, ksteps: int, kplan: int,
                        logical_shape, bounds: jax.Array | None = None) -> jax.Array:
     """``ksteps`` FTCS steps on an already tile-aligned 3D array whose
-    logical (unpadded) extents are ``logical_shape``. ``kpad`` is the plan's
-    halo depth (fixed block geometry across chunks); a remainder pass may
-    run ksteps < kpad. Callers chunk — see _multistep."""
-    (m_pad, mid_pad, n_pad), tile, kplan = _plan_3d(logical_shape, Tp.dtype, kpad)
-    assert Tp.shape == (m_pad, mid_pad, n_pad)
-    assert kplan == kpad and ksteps <= kpad and tile % kpad == 0
+    logical (unpadded) extents are ``logical_shape``. ``kplan`` fixes the
+    block geometry across chunks; a remainder pass may run ksteps < kplan.
+    Callers chunk — see _multistep."""
+    (m_pad, mid_pad, n_pad), R, M, kp = _plan_3d(
+        logical_shape, str(Tp.dtype), kplan)
+    assert Tp.shape == (m_pad, mid_pad, n_pad), (Tp.shape, m_pad, mid_pad, n_pad)
+    assert kp == kplan and ksteps <= kplan
+    sub = _sublane(Tp.dtype)
+    km = _round_up(kplan, sub)
     m, mid, n = logical_shape
     if bounds is None:
         bounds = jnp.asarray([[0, m - 1, 0, mid - 1, 0, n - 1]], jnp.int32)
     bounds = bounds.reshape(1, 6).astype(jnp.int32)
-    grid = (m_pad // tile,)
-    ratio = tile // kpad
-    nhblk = m_pad // kpad
-    smem = pl.BlockSpec((1, 6), lambda i: (0, 0), memory_space=pltpu.SMEM)
-    halo = lambda imap: pl.BlockSpec((kpad, mid_pad, n_pad), imap,
-                                     memory_space=pltpu.VMEM)
-    main = lambda imap: pl.BlockSpec((tile, mid_pad, n_pad), imap,
-                                     memory_space=pltpu.VMEM)
+    grid = (m_pad // R, mid_pad // M)
+    smem = pl.BlockSpec((1, 6), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
+    in_specs, out_spec = _grid_specs_3x3(
+        (R, M), (kplan, km), (m_pad // kplan, mid_pad // km), (n_pad,))
+    band = (R + 2 * kplan) * (M + 2 * km)
     return pl.pallas_call(
-        _make_kernel_3d(float(r), tile, kpad, Tp.shape, ksteps),
+        _make_kernel_3d(float(r), R, M, kplan, km, n_pad, ksteps),
         out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
         grid=grid,
-        in_specs=[
-            smem,
-            halo(lambda i: (jnp.maximum(i * ratio - 1, 0), 0, 0)),
-            main(lambda i: (i, 0, 0)),
-            halo(lambda i: (jnp.minimum((i + 1) * ratio, nhblk - 1), 0, 0)),
-        ],
-        out_specs=main(lambda i: (i, 0, 0)),
+        in_specs=[smem] + in_specs,
+        out_specs=out_spec,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_VMEM_LIMIT_BYTES,
         ),
         cost_estimate=pl.CostEstimate(
-            flops=11 * (tile + 2 * kpad) * grid[0] * mid_pad * n_pad * ksteps,
-            bytes_accessed=(2 * m_pad + 2 * kpad * grid[0]) * mid_pad * n_pad
+            flops=13 * band * n_pad * grid[0] * grid[1] * ksteps,
+            bytes_accessed=(band + R * M) * n_pad * grid[0] * grid[1]
             * Tp.dtype.itemsize,
             transcendentals=0,
         ),
         interpret=_interpret(),
-    )(bounds, Tp, Tp, Tp)
+    )(bounds, *([Tp] * 9))
+
+
+# --------------------------------------------------------------------------
+# 2D wide arrays: (row, col)-tiled kernel
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _plan_2d(shape, dtype_str, ksteps: int):
+    """Choose the 2D kernel: ('thin', kchunk) — the row-banded kernel above
+    (best when full rows are cheap) — or ('coltiled', R, C, kr, kc, kchunk)
+    when the array is wide enough that full-width bands would starve the
+    tile of rows (bf16 32768^2: 1.5x redundant compute in round 1)."""
+    m, n = shape
+    item = jnp.dtype(dtype_str).itemsize
+    sub = _sublane(dtype_str)
+    n_pad = _round_up(max(n, 128), 128)
+
+    def cost_thin(k):
+        kpad = _halo_2d(k, dtype_str)
+        tile = _tile_2d(n_pad, kpad)
+        compute = 11.0 * (tile + 2 * kpad) / tile / _VPU_OPS_PER_S
+        bw = (2.0 * tile + 2 * kpad) * item / (tile * k) / _HBM_BYTES_PER_S
+        return max(compute, bw)
+
+    k_thin = min(max(ksteps, 1), _KMAX_2D)
+    best_col = None
+    for k in (8, 16, 32):
+        if k > max(ksteps, 1):
+            continue
+        kr = _round_up(k, sub)
+        kc = 128
+        for C in (2048, 4096, 8192):
+            if C >= n_pad:  # col-tiling a narrow array is pure overhead
+                continue
+            for R in (128, 256, 512, 1024):
+                R = _round_up(R, kr)
+                R = min(R, _round_up(max(m, kr), kr))
+                band = (R + 2 * kr) * (C + 2 * kc)
+                tile = R * C
+                if not _fits_vmem(band, tile, item):
+                    continue
+                compute = 11.0 * band / tile / _VPU_OPS_PER_S
+                bw = (band + tile) * item / (tile * k) / _HBM_BYTES_PER_S
+                key = (max(compute, bw), band)
+                if best_col is None or key < best_col[0]:
+                    best_col = (key, R, C, kr, kc, k)
+    # the thin-band kernel is the measured-proven default; switch only for
+    # a clear (>10%) modeled win
+    if best_col is not None and best_col[0][0] < 0.9 * cost_thin(k_thin):
+        _, R, C, kr, kc, k = best_col
+        return ("coltiled", R, C, kr, kc, k)
+    return ("thin", k_thin)
+
+
+def _make_kernel_2d_coltiled(r: float, R: int, C: int, kr: int, kc: int,
+                             ksteps: int):
+    """(row, col)-tiled 2D body: both neighbor axes come from halo blocks,
+    so mini-steps are pure shrinking slices — no wrap rotates at all."""
+    rows = R + 2 * kr
+    cols = C + 2 * kc
+
+    def kernel(bounds_ref, *refs):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        out_ref = refs[-1]
+        store_dt = out_ref.dtype
+        acc_dt = accum_dtype_for(store_dt)
+        band = _assemble_band(refs[:9], acc_dt)
+
+        bshape = (rows, cols)
+        grow = i * R - kr + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
+        gcol = j * C - kc + jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
+        frozen = (
+            (grow <= bounds_ref[0, 0]) | (grow >= bounds_ref[0, 1])
+            | (gcol <= bounds_ref[0, 2]) | (gcol >= bounds_ref[0, 3])
+        )
+        maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
+
+        cur = band
+        for s in range(ksteps):  # static unroll, shrinking shapes
+            ctr = cur[1:-1, 1:-1]
+            lap = (cur[2:, 1:-1] + cur[:-2, 1:-1]
+                   + cur[1:-1, 2:] + cur[1:-1, :-2] - 4.0 * ctr)
+            m_s = maskr[s + 1: rows - s - 1, s + 1: cols - s - 1]
+            cur = ctr + m_s * lap
+        out_ref[:] = jax.lax.slice(
+            cur, (kr - ksteps, kc - ksteps),
+            (kr - ksteps + R, kc - ksteps + C)).astype(store_dt)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("r", "ksteps", "R", "C", "kr", "kc",
+                                    "logical_shape"))
+def _pallas_2d_coltiled(Tp: jax.Array, r: float, ksteps: int, R: int, C: int,
+                        kr: int, kc: int, logical_shape,
+                        bounds: jax.Array | None = None) -> jax.Array:
+    m_pad, n_pad = Tp.shape
+    m, n = logical_shape
+    assert m_pad % R == 0 and n_pad % C == 0
+    assert R % kr == 0 and C % kc == 0 and ksteps <= min(kr, kc)
+    if bounds is None:
+        bounds = jnp.asarray([[0, m - 1, 0, n - 1]], jnp.int32)
+    bounds = bounds.reshape(1, 4).astype(jnp.int32)
+    grid = (m_pad // R, n_pad // C)
+    smem = pl.BlockSpec((1, 4), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
+    in_specs, out_spec = _grid_specs_3x3(
+        (R, C), (kr, kc), (m_pad // kr, n_pad // kc), ())
+    band = (R + 2 * kr) * (C + 2 * kc)
+    return pl.pallas_call(
+        _make_kernel_2d_coltiled(float(r), R, C, kr, kc, ksteps),
+        out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
+        grid=grid,
+        in_specs=[smem] + in_specs,
+        out_specs=out_spec,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=11 * band * grid[0] * grid[1] * ksteps,
+            bytes_accessed=(band + R * C) * grid[0] * grid[1]
+            * Tp.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=_interpret(),
+    )(bounds, *([Tp] * 9))
 
 
 # --------------------------------------------------------------------------
@@ -323,32 +529,56 @@ def _pallas_3d_aligned(Tp: jax.Array, r: float, ksteps: int, kpad: int,
 
 def pallas_available(shape, dtype) -> bool:
     """Arbitrary 2D/3D shapes are supported via internal alignment padding;
-    only f64 (no TPU VPU support) falls back to XLA."""
+    f64 (no TPU VPU support) falls back to XLA, as do 3D shapes whose lane
+    extent is so large no tiled band fits VMEM (no plan exists)."""
     shape = tuple(shape)
     if jnp.dtype(dtype) == jnp.float64:
         return False
-    return len(shape) in (2, 3)
+    if len(shape) == 3:
+        return _plan_3d(shape, str(jnp.dtype(dtype)), 8) is not None
+    return len(shape) == 2
 
 
 def _multistep(T: jax.Array, r: float, ksteps: int,
                bounds: jax.Array | None = None) -> jax.Array:
     """Dispatch ksteps fused frozen-boundary steps, chunking fusion down to
     what each kernel's dependency-cone bound affords."""
+    logical = tuple(T.shape)
     if T.ndim == 2:
+        plan = _plan_2d(logical, str(T.dtype), ksteps)
+        if plan[0] == "thin":
+            done = 0
+            while done < ksteps:
+                k = min(_KMAX_2D, ksteps - done)
+                T = _pallas_2d(T, r=float(r), ksteps=k, bounds=bounds)
+                done += k
+            return T
+        _, R, C, kr, kc, kchunk = plan
+        aligned = (_round_up(max(logical[0], R), R),
+                   _round_up(max(logical[1], C), C))
+        if aligned != logical:
+            T = jnp.pad(T, [(0, p - s) for p, s in zip(aligned, logical)])
         done = 0
         while done < ksteps:
-            k = min(_KMAX_2D, ksteps - done)
-            T = _pallas_2d(T, r=float(r), ksteps=k, bounds=bounds)
+            k = min(kchunk, ksteps - done)
+            T = _pallas_2d_coltiled(T, r=float(r), ksteps=k, R=R, C=C,
+                                    kr=kr, kc=kc, logical_shape=logical,
+                                    bounds=bounds)
             done += k
+        if aligned != logical:
+            T = T[: logical[0], : logical[1]]
         return T
-    logical = tuple(T.shape)
-    aligned, _, kchunk = _plan_3d(logical, T.dtype, ksteps)
+    plan = _plan_3d(logical, str(T.dtype), ksteps)
+    assert plan is not None, (
+        f"no 3D kernel plan for {logical} {T.dtype} (gate on "
+        f"pallas_available before calling)")
+    aligned, _, _, kchunk = plan
     if aligned != logical:
         T = jnp.pad(T, [(0, p - s) for p, s in zip(aligned, logical)])
     done = 0
     while done < ksteps:
         k = min(kchunk, ksteps - done)
-        T = _pallas_3d_aligned(T, r=float(r), ksteps=k, kpad=kchunk,
+        T = _pallas_3d_aligned(T, r=float(r), ksteps=k, kplan=kchunk,
                                logical_shape=logical, bounds=bounds)
         done += k
     if aligned != logical:

@@ -1,0 +1,122 @@
+"""The two-axis-tiled Pallas kernels (3x3 halo-block scheme) and their
+plans: correctness vs the XLA stencil in interpret mode, and plan behavior
+(wide arrays switch to col-tiling; narrow arrays keep the thin band)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat_tpu.ops import pallas_stencil as ps
+from heat_tpu.ops.stencil import ftcs_step_edges
+
+
+def _ref(T, r, ksteps):
+    T = jnp.asarray(T)
+    for _ in range(ksteps):
+        T = ftcs_step_edges(T, r)
+    return np.asarray(T)
+
+
+def _pad_to(T, mults):
+    pads = [(0, ps._round_up(s, m) - s) for s, m in zip(T.shape, mults)]
+    return jnp.pad(jnp.asarray(T), pads)
+
+
+@pytest.mark.parametrize("ksteps", [1, 3, 8])
+def test_2d_coltiled_matches_xla(ksteps):
+    rng = np.random.default_rng(3)
+    m, n = 100, 500
+    T = rng.uniform(1, 2, (m, n)).astype(np.float32)
+    R, C, kr, kc = 16, 256, 8, 128
+    Tp = _pad_to(T, (R, C))
+    out = ps._pallas_2d_coltiled(Tp, r=0.2, ksteps=ksteps, R=R, C=C, kr=kr,
+                                 kc=kc, logical_shape=(m, n))[:m, :n]
+    np.testing.assert_allclose(np.asarray(out), _ref(T, 0.2, ksteps),
+                               rtol=0, atol=2e-6)
+
+
+def test_2d_coltiled_bf16():
+    rng = np.random.default_rng(4)
+    m, n = 64, 300
+    T = rng.uniform(1, 2, (m, n)).astype(jnp.bfloat16)
+    R, C, kr, kc = 16, 128, 16, 128
+    Tp = _pad_to(T, (R, C))
+    out = ps._pallas_2d_coltiled(Tp, r=0.25, ksteps=5, R=R, C=C, kr=kr,
+                                 kc=kc, logical_shape=(m, n))[:m, :n]
+    ref = _ref(jnp.asarray(T), 0.25, 5)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), rtol=0, atol=3e-2)
+
+
+@pytest.mark.parametrize("ksteps", [1, 4])
+def test_3d_tiled_matches_xla(ksteps):
+    rng = np.random.default_rng(5)
+    shape = (40, 24, 260)
+    T = rng.uniform(1, 2, shape).astype(np.float32)
+    out = np.asarray(ps._multistep(jnp.asarray(T), 0.15, ksteps))
+    np.testing.assert_allclose(out, _ref(T, 0.15, ksteps), rtol=0, atol=2e-6)
+
+
+def test_3d_tiled_bounded_contract():
+    """Bounded variant with a discard margin: interior matches the
+    unbounded global run (the sharded backend's invariant)."""
+    rng = np.random.default_rng(6)
+    n = 32
+    w = 3
+    T = rng.uniform(1, 2, (n, n, n)).astype(np.float32)
+    # global run, ghost-style: all cells update against a frozen pad ring
+    Tpad = np.pad(T, w, constant_values=1.0)
+    bounds = jnp.asarray([w - 1, n + w, w - 1, n + w, w - 1, n + w],
+                         jnp.int32)
+    out = ps.ftcs_multistep_bounded_pallas(jnp.asarray(Tpad), 0.15, w,
+                                           bounds)
+    # serial oracle: w ghost-BC steps
+    from heat_tpu.backends.serial_np import step_ghost_np
+
+    ref = T.copy()
+    for _ in range(w):
+        ref = step_ghost_np(ref, np.float32(0.15), np.float32(1.0))
+    got = np.asarray(out)[w:-w, w:-w, w:-w]
+    np.testing.assert_allclose(got, ref, rtol=0, atol=2e-6)
+
+
+def test_plan_2d_wide_switches_to_coltiled():
+    kind, *rest = ps._plan_2d((32768, 32768), "bfloat16", 16)
+    assert kind == "coltiled"
+    R, C, kr, kc, k = rest
+    assert C < 32768 and C % kc == 0 and R % kr == 0 and k <= min(kr, kc)
+    # f32 at the same width should also prefer col tiles
+    assert ps._plan_2d((32768, 32768), "float32", 16)[0] == "coltiled"
+
+
+def test_plan_2d_narrow_keeps_thin_band():
+    assert ps._plan_2d((4096, 4096), "float32", 16)[0] == "thin"
+    assert ps._plan_2d((1024, 1024), "float32", 16)[0] == "thin"
+
+
+def test_plan_3d_geometry_valid():
+    (m_pad, mid_pad, n_pad), R, M, k = ps._plan_3d((512, 512, 512),
+                                                   "float32", 8)
+    assert m_pad % R == 0 and mid_pad % M == 0 and n_pad % 128 == 0
+    assert R % k == 0 and M % ps._round_up(k, 8) == 0
+    # the band must be comfortably smaller than the old whole-plane scheme's
+    # worst case: halo fraction under 2x
+    band = (R + 2 * k) * (M + 2 * ps._round_up(k, 8))
+    assert band / (R * M) < 2.0
+
+
+def test_plan_3d_huge_lane_extent_falls_back_to_xla():
+    """A lane extent too wide for any VMEM band: no plan, and
+    pallas_available reports False so callers take the XLA step."""
+    from heat_tpu.ops.pallas_stencil import pallas_available
+
+    assert ps._plan_3d((256, 256, 32768), "float32", 8) is None
+    assert not pallas_available((256, 256, 32768), jnp.float32)
+    assert pallas_available((512, 512, 512), jnp.float32)
+
+
+def test_plan_3d_small_shapes():
+    (m_pad, mid_pad, n_pad), R, M, k = ps._plan_3d((16, 16, 16),
+                                                   "float32", 2)
+    assert m_pad % R == 0 and mid_pad % M == 0
+    assert k <= 2
