@@ -1,0 +1,114 @@
+"""A REAL two-process distributed run on CPU: live jax.distributed world,
+cross-process ppermute halo exchange, per-process shard dumps.
+
+The faked-seam tests (test_multihost.py) cover every multi-host branch; this
+one proves the branches compose over an actual multi-process world — the
+closest single-machine analog of the reference's ``mpirun -np 2`` launch
+(fortran/mpi+cuda/makefile:1-2): two OS processes, a coordination service,
+collectives over sockets, each process writing only its own shards.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig
+from heat_tpu.io import read_dat
+
+_WORKER = r"""
+import os, sys, json
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+from heat_tpu.cli import main
+rc = main(["run", "--backend", "sharded", "--dtype", "float64",
+           "--mesh", "2x2", "--report-sum", "--json"])
+sys.exit(rc)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_pair(tmp_cwd):
+    env_base = {
+        **os.environ,
+        "PYTHONPATH": str(Path(__file__).resolve().parent.parent)
+        + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{_free_port()}",
+        "JAX_NUM_PROCESSES": "2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER],
+            cwd=tmp_cwd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env={**env_base, "JAX_PROCESS_ID": str(i)},
+        )
+        for i in range(2)
+    ]
+    outs = []
+    hung = False
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            hung = True
+            p.kill()
+            out, err = p.communicate()  # reap + salvage diagnostics
+        outs.append((p.returncode, out, err))
+    return hung, outs
+
+
+def test_two_process_sharded_run(tmp_cwd):
+    n, steps = 32, 6
+    (tmp_cwd / "input.dat").write_text(f"{n} 0.25 0.05 2.0 {steps} 1\n")
+    # _free_port is probe-then-release (racy under parallel CI): one retry
+    # with a fresh port before declaring failure
+    for attempt in range(2):
+        hung, outs = _launch_pair(tmp_cwd)
+        if not hung and all(rc == 0 for rc, _, _ in outs):
+            break
+        if attempt == 1:
+            detail = "\n---\n".join(
+                f"worker rc={rc}\nstdout:\n{out}\nstderr:\n{err[-2000:]}"
+                for rc, out, err in outs)
+            pytest.fail(("two-process run hung\n" if hung else
+                         "two-process run failed\n") + detail)
+
+    # every process wrote only its own shards; together: the full mesh
+    shard_files = sorted(tmp_cwd.glob("soln0*.dat"))
+    assert len(shard_files) == 4, shard_files
+
+    # reassemble the 2x2 shard files into the global field
+    ref = solve(HeatConfig(n=n, ntime=steps, dtype="float64",
+                           backend="serial"))
+    half = n // 2
+    for idx, f in enumerate(shard_files):
+        ci, cj = idx // 2, idx % 2
+        _, blk = read_dat(f)
+        np.testing.assert_allclose(
+            blk, ref.T[ci * half:(ci + 1) * half, cj * half:(cj + 1) * half],
+            rtol=0, atol=1e-12)
+
+    # stdout contract: only process 0 speaks, and the json line parses
+    out0 = outs[0][1]
+    out1 = outs[1][1]
+    assert "simulation completed!!!!" in out0
+    assert "simulation completed!!!!" not in out1  # master-gated
+    jline = [l for l in out0.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(jline)
+    assert rec["backend"] == "sharded" and rec["gsum"] is not None
