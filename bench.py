@@ -60,8 +60,11 @@ def _env_int(name: str, default: int) -> int:
 # tunnel), then ~1 s/rep of actual compute — 900 s is a hang detector, not
 # a tight budget
 ATTEMPT_TIMEOUT_S = _env_int("HEAT_BENCH_TIMEOUT_S", 900)
-ATTEMPTS = _env_int("HEAT_BENCH_ATTEMPTS", 4)
-BACKOFF_S = (15, 45, 90)
+ATTEMPTS = _env_int("HEAT_BENCH_ATTEMPTS", 5)
+# round-2 observation: tunnel outages can run an hour+ (backend init hangs
+# at interpreter start) — back off far enough that the last attempts land
+# after a mid-length outage clears
+BACKOFF_S = (30, 90, 240, 600)
 # failure signatures worth retrying (transient tunnel/backend states); any
 # other worker crash is deterministic — fail fast with the error line.
 # (Timeouts always retry; this list is only consulted for nonzero exits.)

@@ -126,6 +126,164 @@ def pallas_3d_tiled(Tp, r, ksteps, R, M, k, km, logical,
 
 
 # ---------------------------------------------------------------------------
+# candidate: thin-band 2D kernel variants — A/B against the shipped one
+#   shrink: row neighbors via shrinking slices (sublane-shifted reads)
+#           instead of sublane rolls; lanes still rolled
+#   bf16native: band stays in storage dtype; operands upcast at the adds
+#               (VERDICT r1: do store-dtype rolls beat upcast-then-roll?)
+# ---------------------------------------------------------------------------
+
+
+def make_thin2d_variant(r, tile, kpad, n_pad, ksteps, variant):
+    rows = tile + 2 * kpad
+
+    def kernel(bounds_ref, prev_ref, cur_ref, next_ref, out_ref):
+        i = pl.program_id(0)
+        store_dt = out_ref.dtype
+        acc_dt = jnp.float32
+        band0 = jnp.concatenate(
+            [prev_ref[:], cur_ref[:], next_ref[:]], axis=0)
+        grow = i * tile - kpad + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, n_pad), 0)
+        gcol = jax.lax.broadcasted_iota(jnp.int32, (rows, n_pad), 1)
+        frozen = (
+            (grow <= bounds_ref[0, 0]) | (grow >= bounds_ref[0, 1])
+            | (gcol <= bounds_ref[0, 2]) | (gcol >= bounds_ref[0, 3])
+        )
+
+        if variant == "shrink":
+            maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
+            cur = band0.astype(acc_dt)
+            for s in range(ksteps):
+                lf = pltpu.roll(cur, 1, 1)
+                rt = pltpu.roll(cur, n_pad - 1, 1)
+                ctr = cur[1:-1, :]
+                lap = (cur[2:, :] + cur[:-2, :]
+                       + lf[1:-1, :] + rt[1:-1, :] - 4.0 * ctr)
+                cur = ctr + maskr[s + 1: rows - s - 1, :] * lap
+            out_ref[:] = jax.lax.slice(
+                cur, (kpad - ksteps, 0),
+                (kpad - ksteps + tile, n_pad)).astype(store_dt)
+        elif variant == "bf16native":
+            maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
+            band = band0  # stays in storage dtype; adds upcast operands
+            for _ in range(ksteps):
+                up = pltpu.roll(band, 1, 0).astype(acc_dt)
+                dn = pltpu.roll(band, rows - 1, 0).astype(acc_dt)
+                lf = pltpu.roll(band, 1, 1).astype(acc_dt)
+                rt = pltpu.roll(band, n_pad - 1, 1).astype(acc_dt)
+                c = band.astype(acc_dt)
+                band = (c + maskr * (up + dn + lf + rt - 4.0 * c)
+                        ).astype(store_dt)
+            out_ref[:] = band[kpad: kpad + tile]
+        else:
+            raise ValueError(variant)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("r", "ksteps", "tile", "kpad", "variant",
+                                    "logical"))
+def pallas_thin2d_variant(Tp, r, ksteps, tile, kpad, variant, logical):
+    m_pad, n_pad = Tp.shape
+    m, n = logical
+    assert m_pad % tile == 0 and tile % kpad == 0 and ksteps <= kpad
+    bounds = jnp.asarray([[0, m - 1, 0, n - 1]], jnp.int32)
+    ratio = tile // kpad
+    nhblk = m_pad // kpad
+    smem = pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    halo = lambda imap: pl.BlockSpec((kpad, n_pad), imap,
+                                     memory_space=pltpu.VMEM)
+    main = lambda imap: pl.BlockSpec((tile, n_pad), imap,
+                                     memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        make_thin2d_variant(float(r), tile, kpad, n_pad, ksteps, variant),
+        out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
+        grid=(m_pad // tile,),
+        in_specs=[
+            smem,
+            halo(lambda i: (jnp.maximum(i * ratio - 1, 0), 0)),
+            main(lambda i: (i, 0)),
+            halo(lambda i: (jnp.minimum((i + 1) * ratio, nhblk - 1), 0)),
+        ],
+        out_specs=main(lambda i: (i, 0)),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT),
+        interpret=jax.default_backend() != "tpu",
+    )(bounds, Tp, Tp, Tp)
+
+
+def check_thin2d_variants():
+    rng = np.random.default_rng(2)
+    m, n = 96, 260
+    for variant, dt, tol in (("shrink", np.float32, 2e-6),
+                             ("bf16native", jnp.bfloat16, 5e-2)):
+        T = rng.uniform(1, 2, (m, n)).astype(dt)
+        tile, kpad = 32, 16
+        m_pad = _round_up(m, tile)
+        n_pad = _round_up(n, 128)
+        Tp = jnp.pad(jnp.asarray(T), ((0, m_pad - m), (0, n_pad - n)))
+        for ks in (1, 6):
+            out = pallas_thin2d_variant(Tp, r=0.2, ksteps=ks, tile=tile,
+                                        kpad=kpad, variant=variant,
+                                        logical=(m, n))[:m, :n]
+            ref = ref_steps(jnp.asarray(T), 0.2, ks)
+            err = float(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32)).max())
+            print(f"thin2d {variant} ksteps={ks}: max err {err:.2e}")
+            assert err < tol, err
+
+
+def bench_thin2d_variants(n2, dtype, configs, steps=64):
+    from heat_tpu.runtime.timing import sync
+
+    r = 0.25
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    made = {}
+    for variant, tile, kpad in configs:
+        k = kpad
+        m_pad = _round_up(n2, tile)
+        n_pad = _round_up(n2, 128)
+        shape = (m_pad, n_pad)
+        if shape not in made:
+            made[shape] = jax.jit(
+                lambda shape=shape: jax.random.uniform(
+                    jax.random.PRNGKey(0), shape, jnp.float32, 1.0, 2.0
+                ).astype(dt))()
+            sync(made[shape])
+        dev = made[shape]
+
+        @jax.jit
+        def run(Tp, variant=variant, tile=tile, kpad=kpad, k=k):
+            def body(i, t):
+                return pallas_thin2d_variant(t, r=r, ksteps=k, tile=tile,
+                                             kpad=kpad, variant=variant,
+                                             logical=(n2, n2))
+            return jax.lax.fori_loop(0, steps // k, body, Tp)
+
+        try:
+            t0 = time.perf_counter()
+            c = run.lower(dev).compile()
+            compile_s = time.perf_counter() - t0
+            sync(c(dev))
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = c(dev)
+                sync(out)
+                best = min(best, time.perf_counter() - t0)
+            nsteps = (steps // k) * k
+            pts = n2 * n2 * nsteps / best
+            roof = 2.048e11 if dtype == "bfloat16" else 1.024e11
+            print(f"{variant:10s} tile={tile:4d} kpad={kpad}: {pts:.3e} "
+                  f"pts/s ({pts / roof * 100:.0f}% {dtype} roofline)"
+                  f"  [compile {compile_s:.0f}s]", flush=True)
+        except Exception as e:
+            print(f"{variant:10s} tile={tile:4d} kpad={kpad}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+
+
+# ---------------------------------------------------------------------------
 # candidate: (row, col)-tiled 2D kernel for very wide arrays (bf16 32768^2):
 # 3x3 halo blocks, col halo lane-aligned (128), shrinking slices, no rolls
 # ---------------------------------------------------------------------------
@@ -385,3 +543,12 @@ if __name__ == "__main__":
     elif exp == "bench2d_f32":
         cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
         bench_2d(cfgs or [(256, 4096, 16, 128)], dtype="float32")
+    elif exp == "checkthin":
+        check_thin2d_variants()
+    elif exp == "benchthin":
+        # args: n dtype then variant,tile,kpad triples
+        n2 = int(sys.argv[2])
+        dtype = sys.argv[3]
+        cfgs = [(a.split(",")[0], int(a.split(",")[1]), int(a.split(",")[2]))
+                for a in sys.argv[4:]]
+        bench_thin2d_variants(n2, dtype, cfgs)

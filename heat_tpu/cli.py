@@ -94,6 +94,18 @@ def build_parser() -> argparse.ArgumentParser:
     viz.add_argument("--save", default="sol.png")
 
     info = sub.add_parser("info", help="show devices / native-lib status")  # noqa: F841
+
+    launch = sub.add_parser(
+        "launch",
+        help="run N distributed processes on this machine (the reference's "
+             "'mpirun -np N' — fortran/mpi+cuda/makefile:1-2). On a real "
+             "pod the scheduler starts one process per host instead; this "
+             "is the single-node development launcher.")
+    launch.add_argument("-n", "--processes", type=int, default=2)
+    launch.add_argument("--devices-per-process", type=int, default=1,
+                        help="virtual CPU devices contributed per process")
+    launch.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="heat-tpu arguments, e.g.: run --backend sharded")
     return p
 
 
@@ -209,6 +221,110 @@ def _process_index() -> int:
     return jax.process_index()
 
 
+def cmd_launch(args) -> int:
+    """Spawn N local worker processes joined into one jax.distributed world.
+
+    World plumbing == the reference's mpirun contract: every worker runs the
+    same program (SPMD), rank from JAX_PROCESS_ID, world size from
+    JAX_NUM_PROCESSES, rendezvous at the coordinator (≙ MPI_Init,
+    fortran/mpi+cuda/heat.F90:60-62). Worker 0's output streams through
+    (master-gated prints, like the reference's masterproc writes); all
+    workers' files land in the current directory (per-shard soln dumps).
+    """
+    import os
+    import socket
+    import subprocess
+    import sys as _sys
+
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("launch: missing worker arguments (e.g. "
+              "`heat-tpu launch -n 2 run --backend sharded`)",
+              file=sys.stderr)
+        return 2
+    if cmd[0] == "run":
+        # force the CPU platform in-process (a JAX_PLATFORMS env var is
+        # overridden where a site hook pins a TPU plugin) and size each
+        # worker's device contribution
+        cmd = cmd + ["--virtual-devices", str(args.devices_per_process)]
+    import time as _time
+
+    deadline_s = int(os.environ.get("HEAT_TPU_LAUNCH_TIMEOUT_S", "3600"))
+
+    def spawn_world():
+        # probe-then-release port allocation is racy (another process can
+        # grab it before the coordinator binds); the quick-failure retry
+        # below absorbs exactly that class of loss
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = {
+            **os.environ,
+            # workers must import the same heat_tpu the launcher runs, even
+            # when it is only on the launcher's sys.path (not installed)
+            "PYTHONPATH": str(Path(__file__).resolve().parent.parent)
+            + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices_per_process}",
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": str(args.processes),
+        }
+        # worker 0's stdout streams (master-gated prints); every worker's
+        # stderr interleaves, like mpirun, so rank>0 failures keep their
+        # tracebacks
+        return [
+            subprocess.Popen(
+                [_sys.executable, "-m", "heat_tpu", *cmd],
+                env={**env, "JAX_PROCESS_ID": str(i)},
+                stdout=None if i == 0 else subprocess.DEVNULL,
+            )
+            for i in range(args.processes)
+        ]
+
+    def run_world(procs):
+        """Wait all workers; on first failure or deadline, stop the rest
+        (a dead peer leaves survivors blocked in collective rendezvous)."""
+        t0 = _time.monotonic()
+        live = dict(enumerate(procs))
+        rc = 0
+        while live:
+            for i, p in sorted(live.items()):
+                if p.poll() is not None:
+                    del live[i]
+                    if p.returncode != 0:
+                        print(f"launch: worker {i} exited "
+                              f"rc={p.returncode}", file=sys.stderr)
+                        rc = rc or p.returncode
+            if rc or _time.monotonic() - t0 > deadline_s:
+                if not rc:
+                    print(f"launch: deadline {deadline_s}s exceeded",
+                          file=sys.stderr)
+                    rc = 124
+                for p in live.values():
+                    p.terminate()
+                for p in live.values():
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                break
+            _time.sleep(0.05)
+        return rc, _time.monotonic() - t0
+
+    rc, elapsed = run_world(spawn_world())
+    if rc and elapsed < 30:
+        # startup-class failure (port race, env): one clean retry on a
+        # fresh port; mid-run failures (past 30s) don't rerun the job
+        print("launch: startup failure, retrying once on a fresh port",
+              file=sys.stderr)
+        rc, _ = run_world(spawn_world())
+    return rc
+
+
 def cmd_viz(args) -> int:
     from .viz import render_dat
 
@@ -231,7 +347,8 @@ def cmd_info(_args) -> int:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    return {"run": cmd_run, "viz": cmd_viz, "info": cmd_info}[args.command](args)
+    return {"run": cmd_run, "viz": cmd_viz, "info": cmd_info,
+            "launch": cmd_launch}[args.command](args)
 
 
 if __name__ == "__main__":

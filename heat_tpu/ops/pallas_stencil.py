@@ -419,7 +419,7 @@ def _plan_2d(shape, dtype_str, ksteps: int):
 
     k_thin = min(max(ksteps, 1), _KMAX_2D)
     best_col = None
-    for k in (8, 16, 32):
+    for k in (4, 8, 16, 32):
         if k > max(ksteps, 1):
             continue
         kr = _round_up(k, sub)

@@ -112,3 +112,33 @@ def test_two_process_sharded_run(tmp_cwd):
     jline = [l for l in out0.splitlines() if l.startswith("{")][-1]
     rec = json.loads(jline)
     assert rec["backend"] == "sharded" and rec["gsum"] is not None
+
+
+def test_cli_launch_subcommand(tmp_cwd):
+    """`heat-tpu launch -n 2 run ...` — the mpirun-analog single-node
+    launcher: spawns a real 2-process world through the CLI itself."""
+    from heat_tpu.cli import main
+
+    n, steps = 16, 3
+    (tmp_cwd / "input.dat").write_text(f"{n} 0.25 0.05 2.0 {steps} 1\n")
+    rc = main(["launch", "-n", "2", "--devices-per-process", "2",
+               "run", "--backend", "sharded", "--dtype", "float64",
+               "--mesh", "2x2"])
+    assert rc == 0
+    shard_files = sorted(tmp_cwd.glob("soln0*.dat"))
+    assert len(shard_files) == 4
+    ref = solve(HeatConfig(n=n, ntime=steps, dtype="float64",
+                           backend="serial"))
+    half = n // 2
+    for idx, f in enumerate(shard_files):
+        ci, cj = idx // 2, idx % 2
+        _, blk = read_dat(f)
+        np.testing.assert_allclose(
+            blk, ref.T[ci * half:(ci + 1) * half,
+                       cj * half:(cj + 1) * half], rtol=0, atol=1e-12)
+
+
+def test_cli_launch_requires_worker_args(capsys):
+    from heat_tpu.cli import main
+
+    assert main(["launch", "-n", "2"]) == 2
