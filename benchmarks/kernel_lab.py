@@ -446,6 +446,68 @@ def bench_2d(configs, n2=32768, dtype="bfloat16", steps=96):
 
 
 # ---------------------------------------------------------------------------
+# the SHIPPED kernels, as dispatched by the framework's plans
+# ---------------------------------------------------------------------------
+
+
+def bench_framework(cases):
+    """Measure heat_tpu's own multistep entry points (plan-dispatched).
+
+    cases: list of (label, shape_tuple, dtype_str, ksteps, steps).
+    """
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+    from heat_tpu.ops.pallas_stencil import (
+        _plan_2d, _plan_3d, ftcs_multistep_edges_pallas)
+    from heat_tpu.runtime.timing import sync
+
+    r = 0.2
+    for label, shape, dtype, ksteps, steps in cases:
+        dt = jnp.dtype(dtype)
+        dev = jax.jit(
+            lambda shape=shape, dt=dt: jax.random.uniform(
+                jax.random.PRNGKey(0), shape, jnp.float32, 1.0, 2.0
+            ).astype(dt))()
+        sync(dev)
+        plan = (_plan_2d(shape, dtype, ksteps) if len(shape) == 2
+                else _plan_3d(shape, dtype, ksteps))
+
+        @jax.jit
+        def run(T, ksteps=ksteps):
+            def body(i, t):
+                return ftcs_multistep_edges_pallas(t, r, ksteps)
+            return jax.lax.fori_loop(0, steps // ksteps, body, T)
+
+        try:
+            t0 = time.perf_counter()
+            c = run.lower(dev).compile()
+            compile_s = time.perf_counter() - t0
+            sync(c(dev))
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = c(dev)
+                sync(out)
+                best = min(best, time.perf_counter() - t0)
+            nsteps = (steps // ksteps) * ksteps
+            pts = float(np.prod(shape)) * nsteps / best
+            roof = 819e9 / (2 * dt.itemsize)
+            print(f"{label:28s} plan={plan}: {pts:.3e} pts/s "
+                  f"({pts / roof * 100:.0f}% roofline) [compile "
+                  f"{compile_s:.0f}s]", flush=True)
+        except Exception as e:
+            print(f"{label:28s} plan={plan}: FAILED {type(e).__name__}: "
+                  f"{str(e)[:200]}", flush=True)
+
+
+FRAMEWORK_CASES = {
+    "2d4096": ("2d 4096^2 f32", (4096, 4096), "float32", 16, 256),
+    "2d32k_bf16": ("2d 32768^2 bf16", (32768, 32768), "bfloat16", 16, 64),
+    "2d32k_f32": ("2d 32768^2 f32", (32768, 32768), "float32", 16, 48),
+    "3d512": ("3d 512^3 f32", (512, 512, 512), "float32", 8, 160),
+}
+
+
+# ---------------------------------------------------------------------------
 # reference semantics for correctness check
 # ---------------------------------------------------------------------------
 
@@ -552,3 +614,6 @@ if __name__ == "__main__":
         cfgs = [(a.split(",")[0], int(a.split(",")[1]), int(a.split(",")[2]))
                 for a in sys.argv[4:]]
         bench_thin2d_variants(n2, dtype, cfgs)
+    elif exp == "framework":
+        keys = sys.argv[2:] or list(FRAMEWORK_CASES)
+        bench_framework([FRAMEWORK_CASES[k] for k in keys])

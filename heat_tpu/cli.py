@@ -95,6 +95,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     info = sub.add_parser("info", help="show devices / native-lib status")  # noqa: F841
 
+    plan = sub.add_parser(
+        "plan", help="explain what the framework would run for a config: "
+                     "kernel choice, tile/halo geometry, mesh, halo traffic")
+    plan.add_argument("--input", default="input.dat")
+    plan.add_argument("--variant", choices=sorted(VARIANTS))
+    plan.add_argument("--backend", choices=["serial", "xla", "pallas", "sharded"])
+    plan.add_argument("--dtype", choices=["float64", "float32", "bfloat16"])
+    plan.add_argument("--ndim", type=int, choices=[2, 3])
+    plan.add_argument("--mesh", type=_parse_mesh)
+    plan.add_argument("--fuse-steps", type=int)
+    plan.add_argument("--ic"), plan.add_argument("--bc")  # accepted, unused
+
     launch = sub.add_parser(
         "launch",
         help="run N distributed processes on this machine (the reference's "
@@ -219,6 +231,83 @@ def _process_index() -> int:
     import jax
 
     return jax.process_index()
+
+
+def cmd_plan(args) -> int:
+    """Dry explanation of the execution plan — no device is touched.
+
+    The observability counterpart of the reference's decomposition
+    announcements (mpi+cuda/heat.F90:90,239-240), extended to the kernel
+    planner: which stencil kernel the pallas dispatch would pick and its
+    tile/halo geometry, or the sharded backend's mesh/halo economics.
+    """
+    import numpy as np
+
+    path = Path(args.input)
+    if not path.exists():
+        print(f"error: {path} not found", file=sys.stderr)
+        return 2
+    cfg = parse_input(path)
+    if args.variant:
+        cfg = variant_config(args.variant, cfg)
+    over = {k: getattr(args, k) for k in ("backend", "dtype", "ndim",
+                                          "fuse_steps")
+            if getattr(args, k, None) is not None}
+    if args.mesh is not None:
+        over["mesh_shape"] = args.mesh
+    cfg = cfg.with_(**over)
+
+    print(f"config: n={cfg.n}^{cfg.ndim} dtype={cfg.dtype} "
+          f"ntime={cfg.ntime} backend={cfg.backend}")
+    item = {"float64": 8, "float32": 4, "bfloat16": 2}[cfg.dtype]
+
+    # one mesh/fuse-width derivation, validated like the run path would
+    mesh_shape = w = None
+    if cfg.backend == "sharded":
+        from .backends.sharded import fuse_depth_sharded
+        from .parallel.mesh import auto_mesh_shape
+
+        mesh_shape = cfg.mesh_shape
+        assumed = ""
+        if mesh_shape is None:
+            mesh_shape = auto_mesh_shape(8, cfg.ndim)
+            assumed = " (auto; assuming 8 devices)"
+        if len(mesh_shape) != cfg.ndim:
+            print(f"error: mesh {mesh_shape} must have {cfg.ndim} dims",
+                  file=sys.stderr)
+            return 2
+        for s in mesh_shape:
+            if cfg.n % s != 0:
+                print(f"error: grid {cfg.n} does not divide evenly over "
+                      f"mesh axis of size {s} (run would reject this too)",
+                      file=sys.stderr)
+                return 2
+        w = fuse_depth_sharded(cfg, mesh_shape)
+        local = tuple(cfg.n // s for s in mesh_shape)
+        print(f"mesh: {mesh_shape}{assumed}, "
+              f"local block {'x'.join(map(str, local))}")
+
+    if cfg.backend in ("pallas", "sharded"):
+        from .ops.pallas_stencil import plan_summary
+
+        if cfg.backend == "sharded":
+            # the kernel runs per shard, on the halo-padded local block,
+            # fused exactly w steps per pass
+            shape = tuple(l + 2 * w for l in local)
+            ksteps = w
+        else:
+            from .backends.pallas import fuse_depth
+
+            shape, ksteps = cfg.shape, fuse_depth(cfg)
+        print("kernel: " + plan_summary(shape, cfg.dtype, ksteps))
+
+    if cfg.backend == "sharded":
+        slab_cells = 2 * w * sum(
+            int(np.prod(local)) // l for l in local)
+        print(f"halo: width {w} every {w} steps -> "
+              f"{slab_cells * item / 2**10:.1f} KiB sent/shard/exchange "
+              f"({slab_cells * item / w / 2**10:.2f} KiB/step amortized)")
+    return 0
 
 
 def cmd_launch(args) -> int:
@@ -348,7 +437,7 @@ def cmd_info(_args) -> int:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     return {"run": cmd_run, "viz": cmd_viz, "info": cmd_info,
-            "launch": cmd_launch}[args.command](args)
+            "launch": cmd_launch, "plan": cmd_plan}[args.command](args)
 
 
 if __name__ == "__main__":

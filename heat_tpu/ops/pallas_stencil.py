@@ -293,7 +293,9 @@ def _plan_3d(shape, dtype_str, ksteps: int):
                     continue
                 compute = 13.0 * band / tile / _VPU_OPS_PER_S
                 bw = (band + tile) * item / (tile * k) / _HBM_BYTES_PER_S
-                key = (max(compute, bw), band)
+                # ties (same band, same dominant cost) break toward deeper
+                # fusion: fewer passes, fewer chunk boundaries
+                key = (max(compute, bw), band, -k)
                 if best is None or key < best[0]:
                     best = (key, R, M, k)
     if best is None:
@@ -436,7 +438,7 @@ def _plan_2d(shape, dtype_str, ksteps: int):
                     continue
                 compute = 11.0 * band / tile / _VPU_OPS_PER_S
                 bw = (band + tile) * item / (tile * k) / _HBM_BYTES_PER_S
-                key = (max(compute, bw), band)
+                key = (max(compute, bw), band, -k)
                 if best_col is None or key < best_col[0]:
                     best_col = (key, R, C, kr, kc, k)
     # the thin-band kernel is the measured-proven default; switch only for
@@ -520,6 +522,38 @@ def _pallas_2d_coltiled(Tp: jax.Array, r: float, ksteps: int, R: int, C: int,
         ),
         interpret=_interpret(),
     )(bounds, *([Tp] * 9))
+
+
+def plan_summary(shape, dtype_str: str, ksteps: int) -> str:
+    """One-line human description of the kernel plan for ``shape`` — the
+    geometry derived by the SAME rules the kernels use (keep this next to
+    the planners; CLI/`plan` must not re-derive it)."""
+    shape = tuple(shape)
+    if not pallas_available(shape, jnp.dtype(dtype_str)):
+        return ("XLA fused stencil (no Pallas plan for this shape/dtype — "
+                "f64 or oversized lane extent)")
+    if len(shape) == 2:
+        p = _plan_2d(shape, dtype_str, ksteps)
+        if p[0] == "thin":
+            k = p[1]
+            kpad = _halo_2d(k, dtype_str)
+            n_pad = _round_up(max(shape[1], 128), 128)
+            tile = _tile_2d(n_pad, kpad)
+            return (f"thin-band 2D (rows banded, full-width); tile {tile} "
+                    f"rows, halo {kpad}, fuse {k}, band "
+                    f"{(tile + 2 * kpad) * n_pad * 4 / 2**20:.1f} MiB, "
+                    f"halo-compute overhead {(tile + 2 * kpad) / tile:.2f}x")
+        _, R, C, kr, kc, k = p
+        band = (R + 2 * kr) * (C + 2 * kc)
+        return (f"col-tiled 2D 3x3-halo; tile {R}x{C}, halo {kr}x{kc}, "
+                f"fuse {k}, band {band * 4 / 2**20:.1f} MiB, halo-compute "
+                f"overhead {band / (R * C):.2f}x")
+    (_, _, n_pad), R, M, k = _plan_3d(shape, dtype_str, min(ksteps, 8))
+    km = _round_up(k, _sublane(dtype_str))
+    band = (R + 2 * k) * (M + 2 * km)
+    return (f"(row,mid)-tiled 3D 3x3-halo; tile {R}x{M}x{n_pad}, fuse {k}, "
+            f"band {band * n_pad * 4 / 2**20:.1f} MiB, halo-compute "
+            f"overhead {band / (R * M):.2f}x")
 
 
 # --------------------------------------------------------------------------

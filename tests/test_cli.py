@@ -94,3 +94,28 @@ def test_info(capsys):
 def test_bad_mesh_arg():
     with pytest.raises(SystemExit):
         main(["run", "--mesh", "fourbytwo"])
+
+
+def test_cli_plan_subcommand(tmp_cwd, capsys):
+    """`heat-tpu plan` explains the execution plan without touching devices:
+    kernel choice + geometry, mesh/halo economics, run-path validation."""
+    from heat_tpu.cli import main
+
+    (tmp_cwd / "input.dat").write_text("4096 0.25 0.05 2.0 100 0\n")
+    assert main(["plan", "--backend", "pallas", "--dtype", "float32"]) == 0
+    out = capsys.readouterr().out
+    assert "thin-band 2D" in out and "fuse 16" in out
+
+    assert main(["plan", "--backend", "sharded", "--dtype", "float32",
+                 "--mesh", "4x4"]) == 0
+    out = capsys.readouterr().out
+    assert "local block 1024x1024" in out and "halo: width 8" in out
+
+    # f64 -> XLA fallback is reported honestly
+    assert main(["plan", "--variant", "cuda_kernel"]) == 0
+    assert "XLA fused stencil" in capsys.readouterr().out
+
+    # run-path validation applies: bad mesh rank / divisibility
+    assert main(["plan", "--backend", "sharded", "--ndim", "3",
+                 "--mesh", "4x2"]) == 2
+    assert main(["plan", "--backend", "sharded", "--mesh", "3x3"]) == 2
