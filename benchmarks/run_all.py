@@ -112,7 +112,9 @@ def main():
             print(f"{name:40s} FAILED: {type(e).__name__}: {e}")
             rows.append({"name": name, "error": f"{type(e).__name__}: {e}"})
 
-    out = Path(__file__).parent / "results.json"
+    # smoke mode must never clobber chip-measured numbers
+    out = Path(__file__).parent / (
+        "results_smoke.json" if args.smoke else "results.json")
     out.write_text(json.dumps({"ts": time.time(), "rows": rows}, indent=2))
     print(f"wrote {out}")
 

@@ -105,7 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
     plan.add_argument("--ndim", type=int, choices=[2, 3])
     plan.add_argument("--mesh", type=_parse_mesh)
     plan.add_argument("--fuse-steps", type=int)
-    plan.add_argument("--ic"), plan.add_argument("--bc")  # accepted, unused
+    plan.add_argument("--local-kernel", choices=["auto", "xla", "pallas"])
+    plan.add_argument("--ic", choices=["hat", "hat_half", "hat_small",
+                                       "uniform", "zero"])
+    plan.add_argument("--bc", choices=["edges", "ghost"])
+    plan.add_argument("--comm", choices=["direct", "staged"])
 
     launch = sub.add_parser(
         "launch",
@@ -122,6 +126,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _apply_overrides(cfg: HeatConfig, args) -> HeatConfig:
+    """Fold CLI flags into the config. getattr-safe throughout so any
+    subcommand exposing a subset of run's flags (``plan``) reuses this
+    instead of hand-rolling a drifting copy."""
     over = {}
     for field in ("backend", "dtype", "ic", "bc", "ndim", "comm", "fuse_steps",
                   "local_kernel", "heartbeat_every", "checkpoint_every",
@@ -129,18 +136,13 @@ def _apply_overrides(cfg: HeatConfig, args) -> HeatConfig:
         v = getattr(args, field, None)
         if v is not None:
             over[field] = v
-    if args.bc_value is not None:
+    if getattr(args, "bc_value", None) is not None:
         over["bc_value"] = args.bc_value
-    if args.mesh is not None:
+    if getattr(args, "mesh", None) is not None:
         over["mesh_shape"] = args.mesh
-    if args.report_sum:
-        over["report_sum"] = True
-    if args.check_numerics:
-        over["check_numerics"] = True
-    if args.soln:
-        over["soln"] = True
-    if getattr(args, "parity_order", False):
-        over["parity_order"] = True
+    for flag in ("report_sum", "check_numerics", "soln", "parity_order"):
+        if getattr(args, flag, False):
+            over[flag] = True
     return cfg.with_(**over)
 
 
@@ -250,12 +252,7 @@ def cmd_plan(args) -> int:
     cfg = parse_input(path)
     if args.variant:
         cfg = variant_config(args.variant, cfg)
-    over = {k: getattr(args, k) for k in ("backend", "dtype", "ndim",
-                                          "fuse_steps")
-            if getattr(args, k, None) is not None}
-    if args.mesh is not None:
-        over["mesh_shape"] = args.mesh
-    cfg = cfg.with_(**over)
+    cfg = _apply_overrides(cfg, args)
 
     print(f"config: n={cfg.n}^{cfg.ndim} dtype={cfg.dtype} "
           f"ntime={cfg.ntime} backend={cfg.backend}")
@@ -288,18 +285,36 @@ def cmd_plan(args) -> int:
               f"local block {'x'.join(map(str, local))}")
 
     if cfg.backend in ("pallas", "sharded"):
-        from .ops.pallas_stencil import plan_summary
+        from .ops.pallas_stencil import pallas_available, plan_summary
+        from .utils import jnp_dtype
 
+        # mirror the run path's kernel gate exactly: the sharded backend
+        # gates on the GLOBAL shape + local_kernel (sharded.py
+        # make_local_multistep); geometry then describes the shape the
+        # kernel actually sees (the halo-padded local block; ghost BC on
+        # the pallas backend pads the global field by one)
+        gate_ok = pallas_available(cfg.shape, jnp_dtype(cfg.dtype))
         if cfg.backend == "sharded":
-            # the kernel runs per shard, on the halo-padded local block,
-            # fused exactly w steps per pass
-            shape = tuple(l + 2 * w for l in local)
-            ksteps = w
+            if cfg.local_kernel == "xla" or not gate_ok:
+                print("kernel: XLA mini-step path (local_kernel="
+                      f"{cfg.local_kernel}, pallas gate "
+                      f"{'ok' if gate_ok else 'unavailable'})")
+            else:
+                shape = tuple(l + 2 * w for l in local)
+                print("kernel (on TPU; auto falls back to XLA elsewhere): "
+                      + plan_summary(shape, cfg.dtype, w))
         else:
             from .backends.pallas import fuse_depth
 
-            shape, ksteps = cfg.shape, fuse_depth(cfg)
-        print("kernel: " + plan_summary(shape, cfg.dtype, ksteps))
+            shape = cfg.shape
+            if cfg.bc == "ghost" and gate_ok:
+                shape = tuple(s + 2 for s in shape)  # frozen ghost ring
+            if not gate_ok:
+                print("kernel: XLA fused stencil (no Pallas plan for this "
+                      "shape/dtype — f64 or oversized lane extent)")
+            else:
+                print("kernel: " + plan_summary(shape, cfg.dtype,
+                                                fuse_depth(cfg)))
 
     if cfg.backend == "sharded":
         slab_cells = 2 * w * sum(
