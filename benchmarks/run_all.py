@@ -3,9 +3,10 @@
 Run on the real chip: ``python benchmarks/run_all.py``
 Smoke mode (CPU, shrunken sizes): ``python benchmarks/run_all.py --smoke``
 
-Writes ``benchmarks/results.json`` and prints one line per config with
-points/s and the fraction of the HBM roofline (BASELINE.md's analytic
-bound: bytes/point/step = 2*itemsize, v5e ~819 GB/s).
+Writes ``benchmarks/results.json`` (``results_smoke.json`` in smoke mode,
+so smoke never clobbers chip-measured numbers) and prints one line per
+config with points/s and the fraction of the HBM roofline (BASELINE.md's
+analytic bound: bytes/point/step = 2*itemsize, v5e ~819 GB/s).
 """
 
 from __future__ import annotations

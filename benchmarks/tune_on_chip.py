@@ -1,0 +1,80 @@
+"""One-process chip-tuning session: run everything that needs the real TPU,
+in priority order, flushing results as they land (the tunnel can die at any
+moment — earlier stages must not be lost to a later hang).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/tune_on_chip.py [stages...]
+Stages default to: framework lab3d lab2d thin
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    stages = sys.argv[1:] or ["framework", "lab3d", "lab2d", "thin"]
+    t_start = time.time()
+
+    import jax
+
+    t0 = time.time()
+    print(f"devices: {jax.devices()} (init {time.time() - t0:.0f}s)",
+          flush=True)
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    import kernel_lab as lab
+
+    def stage(name, fn):
+        if name not in stages:
+            return
+        print(f"=== stage {name} (t+{time.time() - t_start:.0f}s)",
+              flush=True)
+        try:
+            fn()
+        except Exception as e:
+            print(f"stage {name} FAILED: {type(e).__name__}: "
+                  f"{str(e)[:300]}", flush=True)
+
+    # 1. the shipped kernels at the BASELINE shapes (what results.json needs)
+    stage("framework", lambda: lab.bench_framework([
+        lab.FRAMEWORK_CASES["2d4096"],
+        lab.FRAMEWORK_CASES["3d512"],
+        lab.FRAMEWORK_CASES["2d32k_bf16"],
+        lab.FRAMEWORK_CASES["2d32k_f32"],
+    ]))
+
+    # 2. 3D geometry sweep around the plan's pick (48x96 k2) + deeper fusion
+    stage("lab3d", lambda: lab.bench_3d([
+        (48, 96, 2, 8),
+        (64, 64, 4, 8),
+        (64, 64, 8, 8),
+        (32, 128, 4, 8),
+        (96, 48, 4, 8),
+    ]))
+
+    # 3. col-tiled 2D sweep at the bf16 flagship shape
+    stage("lab2d", lambda: lab.bench_2d([
+        (1024, 4096, 16, 128),
+        (512, 8192, 16, 128),
+        (256, 4096, 16, 128),
+        (1024, 2048, 16, 128),
+        (512, 4096, 32, 128),
+    ]))
+
+    # 4. thin-band variant A/B (shrink rows / bf16-native rolls) at 16384^2
+    stage("thin", lambda: lab.bench_thin2d_variants(16384, "bfloat16", [
+        ("shrink", 64, 16),
+        ("bf16native", 64, 16),
+        ("shrink", 128, 16),
+        ("bf16native", 128, 16),
+    ]))
+
+    print(f"tuning session done in {time.time() - t_start:.0f}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

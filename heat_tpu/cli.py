@@ -295,6 +295,12 @@ def cmd_plan(args) -> int:
         # the pallas backend pads the global field by one)
         gate_ok = pallas_available(cfg.shape, jnp_dtype(cfg.dtype))
         if cfg.backend == "sharded":
+            if cfg.local_kernel == "pallas" and not gate_ok:
+                # the run path rejects this outright (make_local_multistep)
+                print(f"error: local_kernel='pallas' does not support "
+                      f"dtype={cfg.dtype!r} (run would reject this too)",
+                      file=sys.stderr)
+                return 2
             if cfg.local_kernel == "xla" or not gate_ok:
                 print("kernel: XLA mini-step path (local_kernel="
                       f"{cfg.local_kernel}, pallas gate "
@@ -309,12 +315,10 @@ def cmd_plan(args) -> int:
             shape = cfg.shape
             if cfg.bc == "ghost" and gate_ok:
                 shape = tuple(s + 2 for s in shape)  # frozen ghost ring
-            if not gate_ok:
-                print("kernel: XLA fused stencil (no Pallas plan for this "
-                      "shape/dtype — f64 or oversized lane extent)")
-            else:
-                print("kernel: " + plan_summary(shape, cfg.dtype,
-                                                fuse_depth(cfg)))
+            # plan_summary reports the XLA fallback itself when no kernel
+            # plan exists for the shape/dtype
+            print("kernel: " + plan_summary(shape, cfg.dtype,
+                                            fuse_depth(cfg)))
 
     if cfg.backend == "sharded":
         slab_cells = 2 * w * sum(
