@@ -20,8 +20,10 @@ from ..config import HeatConfig
 from ..ops.pallas_stencil import (
     ftcs_multistep_edges_pallas,
     ftcs_multistep_ghost_pallas,
+    ftcs_multistep_periodic_pallas,
     ftcs_step_edges_pallas,
     ftcs_step_ghost_pallas,
+    ftcs_step_periodic_pallas,
 )
 from ..ops.stencil import run_steps
 from . import SolveResult, register
@@ -49,6 +51,9 @@ def make_advance(cfg: HeatConfig):
     if cfg.bc == "edges":
         step = lambda t: ftcs_step_edges_pallas(t, r)
         multi = lambda t, k: ftcs_multistep_edges_pallas(t, r, k)
+    elif cfg.bc == "periodic":
+        step = lambda t: ftcs_step_periodic_pallas(t, r)
+        multi = lambda t, k: ftcs_multistep_periodic_pallas(t, r, k)
     else:
         step = lambda t: ftcs_step_ghost_pallas(t, r, bc_value)
         multi = lambda t, k: ftcs_multistep_ghost_pallas(t, r, bc_value, k)

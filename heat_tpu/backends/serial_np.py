@@ -55,6 +55,14 @@ def step_ghost_np(T: np.ndarray, r: float, bc_value: float) -> np.ndarray:
     return T + r * _lap_interior(padded)
 
 
+def step_periodic_np(T: np.ndarray, r: float) -> np.ndarray:
+    """Torus step: wrap-pad supplies the opposite-edge neighbors — the
+    ``pbc=.true.`` topology the reference's cartesian communicator carries
+    but never enables (fortran/mpi+cuda/heat.F90:76,97)."""
+    padded = np.pad(T, 1, mode="wrap")
+    return T + r * _lap_interior(padded)
+
+
 @register("serial")
 def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, **_) -> SolveResult:
     from .common import load_or_init
@@ -71,6 +79,8 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, **_) -> SolveResult:
             master_print(" time_it:", i)  # fortran/serial/heat.f90:62
         if cfg.bc == "edges":
             T = step_edges_np(T, r)
+        elif cfg.bc == "periodic":
+            T = step_periodic_np(T, r)
         else:
             T = step_ghost_np(T, r, dt(cfg.bc_value))
         if cfg.check_numerics:

@@ -24,6 +24,10 @@ BC semantics:
 - ``edges`` (serial parity): ditto, then cells on the global boundary ring
   are frozen back — the decomposed run matches the serial oracle bit-for-bit
   in f64.
+- ``periodic``: the ppermute ring closes (last shard exchanges with first)
+  and nothing is pinned — the ``pbc=.true.`` cartesian topology the
+  reference's communicator is built for but never enables
+  (fortran/mpi+cuda/heat.F90:76,97).
 """
 
 from __future__ import annotations
@@ -42,7 +46,8 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from ..config import HeatConfig
-from ..ops.pallas_stencil import ftcs_multistep_bounded_pallas, pallas_available
+from ..ops.pallas_stencil import (_NO_FREEZE, ftcs_multistep_bounded_pallas,
+                                  pallas_available)
 from ..ops.stencil import accum_dtype_for, laplacian_interior
 from ..parallel.halo import halo_exchange, halo_pad
 from ..parallel.mesh import build_mesh, validate_divisible
@@ -64,6 +69,7 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
     r = cfg.r
     bc_value = cfg.bc_value
     staged = cfg.comm == "staged"
+    periodic = cfg.bc == "periodic"
     n = cfg.n
 
     kernel_ok = pallas_available((cfg.n,) * cfg.ndim, jnp_dtype(cfg.dtype))
@@ -84,11 +90,14 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
         # dependency-cone invariant as the XLA path below.
         padded0 = halo_exchange(
             halo_pad(local, bc_value, w), axis_names, axis_sizes, bc_value,
-            staged=staged, width=w,
+            staged=staged, width=w, periodic=periodic,
         )
         edges = 1 if cfg.bc == "edges" else 0
         bounds = []
         for d, name in enumerate(axis_names):
+            if periodic:  # torus: nothing frozen anywhere
+                bounds.extend([jnp.int32(-_NO_FREEZE), jnp.int32(_NO_FREEZE)])
+                continue
             coord = jax.lax.axis_index(name)
             M = local.shape[d] + 2 * w
             bounds.append(jnp.where(coord == 0, w - 1 + edges, -1))
@@ -106,32 +115,38 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
         rr = jnp.asarray(r, acc_dt)
         padded0 = halo_exchange(
             halo_pad(local, bc_value, w), axis_names, axis_sizes, bc_value,
-            staged=staged, width=w,
+            staged=staged, width=w, periodic=periodic,
         )
-        # global index of every padded cell; exterior (< 0 or >= n) cells are
-        # true Dirichlet ghosts
-        gidx = []
-        for d, name in enumerate(axis_names):
-            coord = jax.lax.axis_index(name)
-            base = coord * local.shape[d] - w
-            gidx.append(base + jax.lax.broadcasted_iota(
-                jnp.int32, padded0.shape, d))
-        exterior = functools.reduce(
-            jnp.logical_or, [(g < 0) | (g > n - 1) for g in gidx])
-        if cfg.bc == "edges":
-            boundary = functools.reduce(
-                jnp.logical_or, [(g == 0) | (g == n - 1) for g in gidx])
-            pinned = exterior | boundary
+        if periodic:
+            pinned = None  # torus: no Dirichlet ghosts, no frozen ring
         else:
-            pinned = exterior
+            # global index of every padded cell; exterior (< 0 or >= n)
+            # cells are true Dirichlet ghosts
+            gidx = []
+            for d, name in enumerate(axis_names):
+                coord = jax.lax.axis_index(name)
+                base = coord * local.shape[d] - w
+                gidx.append(base + jax.lax.broadcasted_iota(
+                    jnp.int32, padded0.shape, d))
+            exterior = functools.reduce(
+                jnp.logical_or, [(g < 0) | (g > n - 1) for g in gidx])
+            if cfg.bc == "edges":
+                boundary = functools.reduce(
+                    jnp.logical_or, [(g == 0) | (g == n - 1) for g in gidx])
+                pinned = exterior | boundary
+            else:
+                pinned = exterior
 
         def mini_step(padded):
             # clamp-pad so the outermost ring has *some* neighbor value; its
             # update is garbage but sits beyond every layer any valid cell
-            # reads afterwards
+            # reads afterwards (periodic included: ghost layer L is valid
+            # for the first w-L mini-steps, exactly when it is read)
             clamped = jnp.pad(padded, 1, mode="edge")
             new = (padded.astype(acc_dt)
                    + rr * laplacian_interior(clamped)).astype(padded.dtype)
+            if pinned is None:
+                return new
             # exterior ghosts stay Dirichlet; edges-BC boundary ring stays
             # at its (never-changing) initial value
             return jnp.where(pinned, padded0, new)
@@ -174,6 +189,7 @@ def make_parity_machinery(cfg: HeatConfig, mesh):
     r = cfg.r
     bc_value = cfg.bc_value
     staged = cfg.comm == "staged"
+    periodic = cfg.bc == "periodic"
     n = cfg.n
     spec = P(*axis_names)
     smap = functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
@@ -207,14 +223,15 @@ def make_parity_machinery(cfg: HeatConfig, mesh):
                         new.astype(padded.dtype))
         # ghost update AFTER the stencil — the literal :218 ``call swap()``
         return halo_exchange(new, axis_names, axis_sizes, bc_value,
-                             staged=staged, width=1)
+                             staged=staged, width=1, periodic=periodic)
 
     def seed(T_owned: jax.Array, from_ic: bool) -> jax.Array:
         def body(local):
             padded = halo_pad(local, bc_value, 1)
             if from_ic:
                 padded = halo_exchange(padded, axis_names, axis_sizes,
-                                       bc_value, staged=staged, width=1)
+                                       bc_value, staged=staged, width=1,
+                                       periodic=periodic)
             return padded
 
         return jax.jit(smap(body))(T_owned)

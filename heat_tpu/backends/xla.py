@@ -17,7 +17,8 @@ import jax
 import numpy as np
 
 from ..config import HeatConfig
-from ..ops.stencil import ftcs_step_edges, ftcs_step_ghost, run_steps
+from ..ops.stencil import (ftcs_step_edges, ftcs_step_ghost,
+                           ftcs_step_periodic, run_steps)
 from . import SolveResult, register
 from .common import drive, resolve_initial_field
 
@@ -29,6 +30,8 @@ def make_advance(cfg: HeatConfig):
 
     if cfg.bc == "edges":
         step = lambda t: ftcs_step_edges(t, r)
+    elif cfg.bc == "periodic":
+        step = lambda t: ftcs_step_periodic(t, r)
     else:
         step = lambda t: ftcs_step_ghost(t, r, bc_value)
 

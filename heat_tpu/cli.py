@@ -50,7 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--backend", choices=["serial", "xla", "pallas", "sharded"])
     run.add_argument("--dtype", choices=["float64", "float32", "bfloat16"])
     run.add_argument("--ic", choices=["hat", "hat_half", "hat_small", "uniform", "zero"])
-    run.add_argument("--bc", choices=["edges", "ghost"])
+    run.add_argument("--bc", choices=["edges", "ghost", "periodic"])
     run.add_argument("--bc-value", type=float)
     run.add_argument("--ndim", type=int, choices=[2, 3])
     run.add_argument("--comm", choices=["direct", "staged"],
@@ -108,7 +108,7 @@ def build_parser() -> argparse.ArgumentParser:
     plan.add_argument("--local-kernel", choices=["auto", "xla", "pallas"])
     plan.add_argument("--ic", choices=["hat", "hat_half", "hat_small",
                                        "uniform", "zero"])
-    plan.add_argument("--bc", choices=["edges", "ghost"])
+    plan.add_argument("--bc", choices=["edges", "ghost", "periodic"])
     plan.add_argument("--comm", choices=["direct", "staged"])
 
     launch = sub.add_parser(
@@ -256,6 +256,11 @@ def cmd_plan(args) -> int:
 
     print(f"config: n={cfg.n}^{cfg.ndim} dtype={cfg.dtype} "
           f"ntime={cfg.ntime} backend={cfg.backend}")
+    if cfg.bc == "periodic":
+        # the pbc=.true. topology (mpi_cart_create periods,
+        # mpi+cuda/heat.F90:76,97): closed ppermute ring, nothing pinned
+        print("topology: periodic (torus) — bc_value unused, "
+              "total heat conserved exactly")
     item = {"float64": 8, "float32": 4, "bfloat16": 2}[cfg.dtype]
 
     # one mesh/fuse-width derivation, validated like the run path would
@@ -315,6 +320,13 @@ def cmd_plan(args) -> int:
             shape = cfg.shape
             if cfg.bc == "ghost" and gate_ok:
                 shape = tuple(s + 2 for s in shape)  # frozen ghost ring
+            elif cfg.bc == "periodic" and gate_ok:
+                from .ops.pallas_stencil import periodic_pad_width
+
+                # wrap-ghost ring of the chunked fuse width — the kernel's
+                # own derivation (ftcs_multistep_periodic_pallas)
+                w_ring = periodic_pad_width(shape, fuse_depth(cfg))
+                shape = tuple(s + 2 * w_ring for s in shape)
             # plan_summary reports the XLA fallback itself when no kernel
             # plan exists for the shape/dtype
             print("kernel: " + plan_summary(shape, cfg.dtype,

@@ -23,7 +23,7 @@ from typing import Optional, Tuple
 
 _DTYPES = ("float64", "float32", "bfloat16")
 _BACKENDS = ("serial", "xla", "pallas", "sharded")
-_BCS = ("edges", "ghost")
+_BCS = ("edges", "ghost", "periodic")
 _ICS = ("hat", "hat_half", "hat_small", "uniform", "zero")
 _COMMS = ("direct", "staged")
 _LOCAL_KERNELS = ("auto", "xla", "pallas")
@@ -52,7 +52,10 @@ class HeatConfig:
     ic: str = "hat"             # initial condition preset (see grid.py)
     bc: str = "edges"           # "edges": frozen boundary cells (serial semantics)
                                 # "ghost": Dirichlet-by-ghost ring (MPI semantics)
-    bc_value: float = 1.0       # boundary temperature
+                                # "periodic": torus topology — the pbc=.true.
+                                # the reference's mpi_cart_create is built for
+                                # but never enables (mpi+cuda/heat.F90:76,97)
+    bc_value: float = 1.0       # boundary temperature (unused for periodic)
     comm: str = "direct"        # halo exchange: direct ICI ppermute vs host-staged
     local_kernel: str = "auto"  # sharded per-shard compute: auto (pallas on
                                 # TPU, xla elsewhere), or forced

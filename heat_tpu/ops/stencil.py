@@ -11,13 +11,19 @@ Math (fortran/serial/heat.f90:64-68):
     T[j,k] = T_old[j,k] + r * (T_old[j+1,k] + T_old[j,k+1]
                                + T_old[j-1,k] + T_old[j,k-1] - 4*T_old[j,k])
 
-Two boundary semantics exist in the reference and both are kept:
+Three boundary semantics are kept:
 
 - ``edges``: only interior cells 2..n-1 update; the outermost cell ring is
   frozen (serial + single-GPU variants, fortran/serial/heat.f90:64).
 - ``ghost``: ALL owned cells update, reading a ghost ring fixed at
   ``bc_value`` at the global domain edge (MPI variants,
   fortran/mpi+cuda/heat.F90:209-215 with IC at :243-251).
+- ``periodic``: ALL cells update with wrap-around neighbors — the topology
+  the reference's cartesian communicator is built to carry but never
+  enables (``pbc = .false.`` fed to ``mpi_cart_create`` periods,
+  fortran/mpi+cuda/heat.F90:76,97). With no boundary there is no boundary
+  flux: total heat is conserved exactly (the invariant behind the
+  reference's commented-out global-sum reduction, :266-273).
 
 bfloat16 runs compute in float32 and round the result back (the "bf16
 stencil + fp32 accumulate" benchmark mode; the reference's precedent is the
@@ -99,6 +105,33 @@ def ftcs_step_ghost(T: jax.Array, r, bc_value) -> jax.Array:
     padded = pad_with_ghosts(T, bc_value)
     acc_dt = accum_dtype_for(T.dtype)
     out = T.astype(acc_dt) + jnp.asarray(r, acc_dt) * laplacian_interior(padded)
+    return out.astype(T.dtype)
+
+
+def laplacian_periodic(T: jax.Array) -> jax.Array:
+    """Discrete Laplacian numerator with wrap-around neighbors, full array.
+
+    Same left-to-right summation order as ``laplacian_interior`` (+1
+    neighbors in axis order, then -1 neighbors, then the center term) so
+    periodic f64 runs bit-match the roll-free oracle transcription.
+    """
+    nd = T.ndim
+    acc_dt = accum_dtype_for(T.dtype)
+    Tc = T.astype(acc_dt)
+    shifted = []
+    for shift in (-1, 1):  # roll -1 brings index j+1 to j (the +1 neighbor)
+        for d in range(nd):
+            shifted.append(jnp.roll(Tc, shift, axis=d))
+    acc = shifted[0]
+    for s in shifted[1:]:
+        acc = acc + s
+    return acc + (-2.0 * nd) * Tc
+
+
+def ftcs_step_periodic(T: jax.Array, r) -> jax.Array:
+    """One FTCS step on the torus: every cell updates, neighbors wrap."""
+    acc_dt = accum_dtype_for(T.dtype)
+    out = T.astype(acc_dt) + jnp.asarray(r, acc_dt) * laplacian_periodic(T)
     return out.astype(T.dtype)
 
 

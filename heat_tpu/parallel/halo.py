@@ -9,7 +9,10 @@ TPU-native replacement for the reference's swap machinery
 - ``mpi_proc_null`` -> ppermute's missing-edge zeros, overwritten with the
   Dirichlet ``bc_value`` at global domain edges (non-periodic, matching
   ``pbc=.false.``, fortran/mpi+cuda/heat.F90:76 and the unpack guards
-  :174-191)
+  :174-191). ``periodic=True`` enables the topology the reference's
+  communicator is built to carry but never switches on (the ``pbc``
+  periods argument of ``mpi_cart_create``, :97): the ppermute ring closes
+  (last shard exchanges with the first) and no ghost is pinned.
 - CUDA-aware vs NO_AWARE staged duality (:162-172) -> ``staged=True`` routes
   every halo slab through a host round-trip (``jax.pure_callback``), the
   honest analog of the D2H / sendrecv-on-host / H2D path; the default sends
@@ -38,13 +41,17 @@ def _stage_through_host(x: jax.Array) -> jax.Array:
     )
 
 
-def _shift_from_prev(slab, axis_name: str, size: int):
+def _shift_from_prev(slab, axis_name: str, size: int, periodic: bool = False):
     """Each shard receives the slab of its left/previous neighbor."""
-    return lax.ppermute(slab, axis_name, [(i, i + 1) for i in range(size - 1)])
+    pairs = [(i, (i + 1) % size) for i in range(size)] if periodic else [
+        (i, i + 1) for i in range(size - 1)]
+    return lax.ppermute(slab, axis_name, pairs)
 
 
-def _shift_from_next(slab, axis_name: str, size: int):
-    return lax.ppermute(slab, axis_name, [(i + 1, i) for i in range(size - 1)])
+def _shift_from_next(slab, axis_name: str, size: int, periodic: bool = False):
+    pairs = [((i + 1) % size, i) for i in range(size)] if periodic else [
+        (i + 1, i) for i in range(size - 1)]
+    return lax.ppermute(slab, axis_name, pairs)
 
 
 def halo_exchange(
@@ -54,6 +61,7 @@ def halo_exchange(
     bc_value,
     staged: bool = False,
     width: int = 1,
+    periodic: bool = False,
 ) -> jax.Array:
     """Refresh a ``width``-cell ghost ring of a padded local shard.
 
@@ -89,15 +97,16 @@ def halo_exchange(
         if staged:
             send_lo = _stage_through_host(send_lo)
             send_hi = _stage_through_host(send_hi)
-        from_prev = _shift_from_prev(send_hi, name, size)
-        from_next = _shift_from_next(send_lo, name, size)
+        from_prev = _shift_from_prev(send_hi, name, size, periodic)
+        from_next = _shift_from_next(send_lo, name, size, periodic)
         if staged:
             from_prev = _stage_through_host(from_prev)
             from_next = _stage_through_host(from_next)
-        # Global-edge shards got zeros (no ppermute pair, == mpi_proc_null):
-        # pin their ghosts to the boundary temperature instead.
-        from_prev = jnp.where(idx == 0, bc, from_prev)
-        from_next = jnp.where(idx == size - 1, bc, from_next)
+        if not periodic:
+            # Global-edge shards got zeros (no ppermute pair, ==
+            # mpi_proc_null): pin their ghosts to the boundary temperature.
+            from_prev = jnp.where(idx == 0, bc, from_prev)
+            from_next = jnp.where(idx == size - 1, bc, from_next)
 
         out = out.at[slab(slice(0, w))].set(from_prev)
         out = out.at[slab(slice(-w, None))].set(from_next)

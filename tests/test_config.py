@@ -78,7 +78,7 @@ def test_validation():
     with pytest.raises(ValueError):
         HeatConfig(backend="mpi")
     with pytest.raises(ValueError):
-        HeatConfig(bc="periodic")
+        HeatConfig(bc="reflecting")
     with pytest.raises(ValueError):
         HeatConfig(ndim=4)
     # sigma sanity applies in every dimension, not just 2D

@@ -1,0 +1,152 @@
+"""Periodic ("pbc") topology: the reference's cartesian communicator is
+built to carry periodic boundaries but hardcodes them off (``pbc = .false.``
+fed to ``mpi_cart_create`` periods, fortran/mpi+cuda/heat.F90:76,97). This
+framework enables the topology as ``bc="periodic"``: wrap-around neighbors
+everywhere, closed ppermute ring in the sharded backend, nothing pinned.
+
+With no boundary there is no boundary flux, so the global temperature sum —
+the invariant behind the reference's commented-out MPI_Reduce debug check
+(:266-273) — is conserved EXACTLY, which these tests assert.
+"""
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig
+
+BASE = HeatConfig(n=32, ntime=12, dtype="float64", bc="periodic", ic="hat")
+
+
+def oracle_periodic(T, r, steps):
+    """Literal modular-index transcription of the FTCS update on the torus
+    (independent of every framework path: explicit gather, reference
+    summation order)."""
+    T = np.array(T, np.float64)
+    n_ax = T.shape
+    nd = T.ndim
+    idx = np.indices(n_ax)
+    for _ in range(steps):
+        old = T.copy()
+        acc = None
+        for off in (1, -1):
+            for d in range(nd):
+                sl = list(idx)
+                sl[d] = (idx[d] + off) % n_ax[d]
+                v = old[tuple(sl)]
+                acc = v if acc is None else acc + v
+        T = old + r * (acc + (-2.0 * nd) * old)
+    return T
+
+
+def test_serial_periodic_matches_literal_oracle():
+    cfg = BASE.with_(backend="serial")
+    got = solve(cfg)
+    ref = oracle_periodic(solve(cfg.with_(ntime=0)).T, cfg.r, cfg.ntime)
+    np.testing.assert_allclose(got.T, ref, rtol=0, atol=0)
+
+
+def test_xla_periodic_matches_serial_bitwise():
+    expect = solve(BASE.with_(backend="serial"))
+    got = solve(BASE.with_(backend="xla"))
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=0)
+
+
+def test_periodic_conserves_total_heat_exactly():
+    """No boundary -> no flux: the sum invariant the reference's dead
+    MPI_Reduce check was reaching for, exact on the torus."""
+    for backend in ("serial", "xla"):
+        cfg = BASE.with_(backend=backend, report_sum=True)
+        before = float(np.sum(solve(cfg.with_(ntime=0)).T, dtype=np.float64))
+        after = solve(cfg).gsum
+        assert after == pytest.approx(before, rel=1e-13)
+
+
+def test_periodic_translation_invariance():
+    """Rolling the IC rolls the solution — only true on the torus."""
+    cfg = BASE.with_(backend="xla")
+    base = solve(cfg)
+    T0 = solve(cfg.with_(ntime=0)).T
+    rolled = solve(cfg, T0=np.roll(T0, (5, -7), axis=(0, 1)))
+    np.testing.assert_allclose(
+        rolled.T, np.roll(base.T, (5, -7), axis=(0, 1)), rtol=0, atol=0)
+
+
+def test_pallas_periodic_matches_serial():
+    """Fused wrap-ghost Pallas multistep (interpret mode on CPU) vs the
+    sequential oracle, single-step and fused."""
+    for fuse in (1, 4):
+        cfg = BASE.with_(backend="pallas", dtype="float32", fuse_steps=fuse)
+        expect = solve(cfg.with_(backend="serial", dtype="float32"))
+        got = solve(cfg)
+        np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=2e-6)
+
+
+def test_pallas_periodic_3d():
+    cfg = HeatConfig(n=16, ndim=3, ntime=4, dtype="float32", sigma=1 / 6,
+                     bc="periodic", ic="hat", backend="pallas", fuse_steps=2)
+    expect = solve(cfg.with_(backend="serial"))
+    got = solve(cfg)
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=2e-6)
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4)])
+def test_sharded_periodic_matches_serial(mesh_shape):
+    """The closed ppermute ring: decomposed torus == undecomposed torus,
+    bit-for-bit in f64 (same summands, same order)."""
+    cfg = BASE.with_(backend="sharded", mesh_shape=mesh_shape)
+    expect = solve(cfg.with_(backend="serial", mesh_shape=None))
+    got = solve(cfg)
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=0)
+
+
+def test_sharded_periodic_fused_and_staged():
+    cfg = BASE.with_(backend="sharded", mesh_shape=(2, 4), ntime=11)
+    per_step = solve(cfg.with_(fuse_steps=1))
+    fused = solve(cfg.with_(fuse_steps=4))
+    np.testing.assert_allclose(fused.T, per_step.T, rtol=0, atol=0)
+    staged = solve(cfg.with_(fuse_steps=4, comm="staged"))
+    np.testing.assert_allclose(staged.T, per_step.T, rtol=0, atol=0)
+
+
+def test_sharded_periodic_pallas_local_kernel():
+    cfg = BASE.with_(backend="sharded", mesh_shape=(2, 4), dtype="float32",
+                     local_kernel="pallas", fuse_steps=3)
+    expect = solve(cfg.with_(backend="serial", mesh_shape=None,
+                             local_kernel="auto"))
+    got = solve(cfg)
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=2e-6)
+
+
+def test_sharded_periodic_3d():
+    cfg = HeatConfig(n=16, ndim=3, ntime=5, dtype="float64", sigma=0.15,
+                     bc="periodic", ic="hat", backend="sharded",
+                     mesh_shape=(2, 2, 2))
+    expect = solve(cfg.with_(backend="serial", mesh_shape=None))
+    got = solve(cfg)
+    np.testing.assert_allclose(got.T, expect.T, rtol=0, atol=1e-14)
+
+
+def test_parity_order_periodic_ic_start_matches_default():
+    """Literal update-then-swap on the torus: IC starts seed ghosts with one
+    exchange, so the orders coincide (same equivalence as the Dirichlet
+    case, tests/test_parity_order.py)."""
+    cfg = BASE.with_(backend="sharded", mesh_shape=(2, 4), ntime=7)
+    default = solve(cfg)
+    parity = solve(cfg.with_(parity_order=True))
+    np.testing.assert_allclose(parity.T, default.T, rtol=0, atol=0)
+
+
+def test_cli_periodic(tmp_cwd, capsys):
+    from heat_tpu.cli import main
+
+    (tmp_cwd / "input.dat").write_text("24 0.25 0.05 2.0 5 0\n")
+    assert main(["run", "--backend", "xla", "--bc", "periodic",
+                 "--report-sum"]) == 0
+    out = capsys.readouterr().out
+    assert "simulation completed!!!!" in out
+
+    assert main(["plan", "--backend", "sharded", "--bc", "periodic",
+                 "--dtype", "float32"]) == 0
+    out = capsys.readouterr().out
+    assert "periodic (torus)" in out
