@@ -17,11 +17,42 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# persistent compile cache: kernel sweeps re-run the same programs across
+# lab sessions; compiles here run tens of seconds to minutes
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
 VMEM_LIMIT = 110 * 1024 * 1024
 
 
 def _round_up(x, m):
     return ((x + m - 1) // m) * m
+
+
+def measure_rate(c, dev, points_times_steps, repeats=2):
+    """(pts/s corrected, pts/s raw): the tunneled platform carries ~0.15 s
+    fixed dispatch+sync overhead per measurement; timing one call (T1) vs
+    two queued back-to-back calls (T2) cancels it via T2-T1 — no extra
+    compiles. Raw (single-call) rate is reported alongside for context."""
+    import time as _t
+
+    from heat_tpu.runtime.timing import sync
+
+    sync(c(dev))  # warm
+    best1 = best2 = float("inf")
+    for _ in range(repeats):
+        t0 = _t.perf_counter()
+        out = c(dev)
+        sync(out)
+        best1 = min(best1, _t.perf_counter() - t0)
+        t0 = _t.perf_counter()
+        out = c(c(dev))
+        sync(out)
+        best2 = min(best2, _t.perf_counter() - t0)
+    raw = points_times_steps / best1
+    if best2 <= best1:  # overhead-dominated / noisy: correction is invalid
+        return raw, raw
+    return points_times_steps / (best2 - best1), raw
 
 
 # ---------------------------------------------------------------------------
@@ -265,18 +296,12 @@ def bench_thin2d_variants(n2, dtype, configs, steps=64):
             t0 = time.perf_counter()
             c = run.lower(dev).compile()
             compile_s = time.perf_counter() - t0
-            sync(c(dev))
-            best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                out = c(dev)
-                sync(out)
-                best = min(best, time.perf_counter() - t0)
             nsteps = (steps // k) * k
-            pts = n2 * n2 * nsteps / best
+            pts, pts_raw = measure_rate(c, dev, n2 * n2 * nsteps)
             roof = 2.048e11 if dtype == "bfloat16" else 1.024e11
             print(f"{variant:10s} tile={tile:4d} kpad={kpad}: {pts:.3e} "
-                  f"pts/s ({pts / roof * 100:.0f}% {dtype} roofline)"
+                  f"pts/s ({pts / roof * 100:.0f}% {dtype} roofline; raw "
+                  f"{pts_raw / roof * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
             print(f"{variant:10s} tile={tile:4d} kpad={kpad}: FAILED "
@@ -376,6 +401,159 @@ def pallas_2d_coltiled(Tp, r, ksteps, R, C, kr, kc, logical, bounds=None):
     )(bounds, *([Tp] * 9))
 
 
+def make_2d_coltiled_rolled(r, R, C, kr, kc, ksteps):
+    """Col-tiled band, but mini-steps are full-band wrap rotates with a
+    masked multiplicative update (the thin kernel's scheme on a 2-axis
+    tile): every op is lane/sublane-aligned — no shrinking slices, which
+    Mosaic compiles pathologically at deep unrolls on misaligned offsets."""
+    rows = R + 2 * kr
+    cols = C + 2 * kc
+
+    def kernel(bounds_ref, c00, c01, c02, c10, c11, c12, c20, c21, c22,
+               out_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        store_dt = out_ref.dtype
+        acc_dt = jnp.float32
+        top = jnp.concatenate([c00[:], c01[:], c02[:]], axis=1)
+        mid = jnp.concatenate([c10[:], c11[:], c12[:]], axis=1)
+        bot = jnp.concatenate([c20[:], c21[:], c22[:]], axis=1)
+        band = jnp.concatenate([top, mid, bot], axis=0).astype(acc_dt)
+
+        bshape = (rows, cols)
+        grow = i * R - kr + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
+        gcol = j * C - kc + jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
+        frozen = (
+            (grow <= bounds_ref[0, 0]) | (grow >= bounds_ref[0, 1])
+            | (gcol <= bounds_ref[0, 2]) | (gcol >= bounds_ref[0, 3])
+        )
+        maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
+
+        for _ in range(ksteps):  # wrap corruption travels 1 cell/step,
+            up = pltpu.roll(band, 1, 0)      # confined to the kr/kc margins
+            dn = pltpu.roll(band, rows - 1, 0)
+            lf = pltpu.roll(band, 1, 1)
+            rt = pltpu.roll(band, cols - 1, 1)
+            band = band + maskr * (up + dn + lf + rt - 4.0 * band)
+        out_ref[:] = band[kr: kr + R, kc: kc + C].astype(store_dt)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("r", "ksteps", "R", "C", "kr", "kc",
+                                    "logical"))
+def pallas_2d_coltiled_rolled(Tp, r, ksteps, R, C, kr, kc, logical,
+                              bounds=None):
+    m_pad, n_pad = Tp.shape
+    m, n = logical
+    assert m_pad % R == 0 and n_pad % C == 0
+    assert R % kr == 0 and C % kc == 0 and ksteps <= min(kr, kc)
+    if bounds is None:
+        bounds = jnp.asarray([[0, m - 1, 0, n - 1]], jnp.int32)
+    bounds = bounds.reshape(1, 4).astype(jnp.int32)
+    gr, gc = m_pad // R, n_pad // C
+    rr, rc = R // kr, C // kc
+    nrb, ncb = m_pad // kr, n_pad // kc
+    smem = pl.BlockSpec((1, 4), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
+
+    def bs(shape, imap):
+        return pl.BlockSpec(shape, imap, memory_space=pltpu.VMEM)
+
+    def rcl(i):
+        return jnp.clip(i, 0, nrb - 1)
+
+    def ccl(j):
+        return jnp.clip(j, 0, ncb - 1)
+
+    in_specs = [
+        smem,
+        bs((kr, kc), lambda i, j: (rcl(i * rr - 1), ccl(j * rc - 1))),
+        bs((kr, C), lambda i, j: (rcl(i * rr - 1), j)),
+        bs((kr, kc), lambda i, j: (rcl(i * rr - 1), ccl((j + 1) * rc))),
+        bs((R, kc), lambda i, j: (i, ccl(j * rc - 1))),
+        bs((R, C), lambda i, j: (i, j)),
+        bs((R, kc), lambda i, j: (i, ccl((j + 1) * rc))),
+        bs((kr, kc), lambda i, j: (rcl((i + 1) * rr), ccl(j * rc - 1))),
+        bs((kr, C), lambda i, j: (rcl((i + 1) * rr), j)),
+        bs((kr, kc), lambda i, j: (rcl((i + 1) * rr), ccl((j + 1) * rc))),
+    ]
+    return pl.pallas_call(
+        make_2d_coltiled_rolled(float(r), R, C, kr, kc, ksteps),
+        out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
+        grid=(gr, gc),
+        in_specs=in_specs,
+        out_specs=bs((R, C), lambda i, j: (i, j)),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT),
+        interpret=jax.default_backend() != "tpu",
+    )(bounds, *([Tp] * 9))
+
+
+def check_2d_coltiled_rolled():
+    rng = np.random.default_rng(3)
+    m, n = 100, 500
+    for dt, tol in ((np.float32, 2e-6), (jnp.bfloat16, 3e-2)):
+        T = rng.uniform(1, 2, (m, n)).astype(dt)
+        r = 0.2
+        R, C, kr, kc = 16, 256, 16, 128
+        m_pad = _round_up(m, R)
+        n_pad = _round_up(n, C)
+        Tp = jnp.pad(jnp.asarray(T), ((0, m_pad - m), (0, n_pad - n)))
+        for ks in (1, 5, 16):
+            out = pallas_2d_coltiled_rolled(
+                Tp, r=r, ksteps=ks, R=R, C=C, kr=kr, kc=kc,
+                logical=(m, n))[:m, :n]
+            ref = ref_steps(jnp.asarray(T), r, ks)
+            err = float(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32)).max())
+            print(f"2d coltiled-rolled {np.dtype(dt).name} ksteps={ks}: "
+                  f"max err {err:.2e}")
+            assert err < tol, err
+
+
+def bench_2d_rolled(configs, n2=32768, dtype="bfloat16", steps=96):
+    from heat_tpu.runtime.timing import sync
+
+    r = 0.25
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    made = {}
+    for R, C, kr, kc in configs:
+        m_pad = _round_up(n2, R)
+        n_pad = _round_up(n2, C)
+        shape = (m_pad, n_pad)
+        if shape not in made:
+            made[shape] = jax.jit(
+                lambda shape=shape: jax.random.uniform(
+                    jax.random.PRNGKey(0), shape, jnp.float32, 1.0, 2.0
+                ).astype(dt))()
+            sync(made[shape])
+        dev = made[shape]
+        k = min(kr, kc)
+
+        @jax.jit
+        def run(Tp, R=R, C=C, kr=kr, kc=kc, k=k):
+            def body(i, t):
+                return pallas_2d_coltiled_rolled(
+                    t, r=r, ksteps=k, R=R, C=C, kr=kr, kc=kc,
+                    logical=(n2, n2))
+            return jax.lax.fori_loop(0, steps // k, body, Tp)
+
+        try:
+            t0 = time.perf_counter()
+            c = run.lower(dev).compile()
+            compile_s = time.perf_counter() - t0
+            nsteps = (steps // k) * k
+            pts, pts_raw = measure_rate(c, dev, n2 * n2 * nsteps)
+            roof = 2.048e11 if dtype == "bfloat16" else 1.024e11
+            print(f"rolled R={R:4d} C={C:6d} kr={kr} kc={kc}: {pts:.3e} "
+                  f"pts/s ({pts / roof * 100:.0f}% {dtype} roofline; raw "
+                  f"{pts_raw / roof * 100:.0f}%)"
+                  f"  [compile {compile_s:.0f}s]", flush=True)
+        except Exception as e:
+            print(f"rolled R={R:4d} C={C:6d} kr={kr} kc={kc}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+
+
 def check_2d_coltiled():
     rng = np.random.default_rng(1)
     m, n = 100, 500
@@ -427,18 +605,12 @@ def bench_2d(configs, n2=32768, dtype="bfloat16", steps=96):
             t0 = time.perf_counter()
             c = run.lower(dev).compile()
             compile_s = time.perf_counter() - t0
-            sync(c(dev))
-            best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                out = c(dev)
-                sync(out)
-                best = min(best, time.perf_counter() - t0)
             nsteps = (steps // k) * k
-            pts = n2 * n2 * nsteps / best
+            pts, pts_raw = measure_rate(c, dev, n2 * n2 * nsteps)
             roof = 2.048e11 if dtype == "bfloat16" else 1.024e11
             print(f"R={R:4d} C={C:6d} kr={kr} kc={kc}: {pts:.3e} pts/s "
-                  f"({pts / roof * 100:.0f}% {dtype} roofline)"
+                  f"({pts / roof * 100:.0f}% {dtype} roofline; raw "
+                  f"{pts_raw / roof * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
             print(f"R={R:4d} C={C:6d} kr={kr} kc={kc}: FAILED "
@@ -460,8 +632,15 @@ def bench_framework(cases):
         _plan_2d, _plan_3d, ftcs_multistep_edges_pallas)
     from heat_tpu.runtime.timing import sync
 
+    import gc
+
     r = 0.2
     for label, shape, dtype, ksteps, steps in cases:
+        # the previous case's GiB-scale buffers must be gone before this
+        # case allocates (a failed case's traceback pins its frame — and
+        # with it `dev` — until the next exception, so collect explicitly)
+        dev = None
+        gc.collect()
         dt = jnp.dtype(dtype)
         dev = jax.jit(
             lambda shape=shape, dt=dt: jax.random.uniform(
@@ -481,18 +660,13 @@ def bench_framework(cases):
             t0 = time.perf_counter()
             c = run.lower(dev).compile()
             compile_s = time.perf_counter() - t0
-            sync(c(dev))
-            best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                out = c(dev)
-                sync(out)
-                best = min(best, time.perf_counter() - t0)
             nsteps = (steps // ksteps) * ksteps
-            pts = float(np.prod(shape)) * nsteps / best
+            pts, pts_raw = measure_rate(c, dev,
+                                        float(np.prod(shape)) * nsteps)
             roof = 819e9 / (2 * dt.itemsize)
             print(f"{label:28s} plan={plan}: {pts:.3e} pts/s "
-                  f"({pts / roof * 100:.0f}% roofline) [compile "
+                  f"({pts / roof * 100:.0f}% roofline; raw single-call "
+                  f"{pts_raw:.3e} = {pts_raw / roof * 100:.0f}%) [compile "
                   f"{compile_s:.0f}s]", flush=True)
         except Exception as e:
             print(f"{label:28s} plan={plan}: FAILED {type(e).__name__}: "
@@ -500,10 +674,10 @@ def bench_framework(cases):
 
 
 FRAMEWORK_CASES = {
-    "2d4096": ("2d 4096^2 f32", (4096, 4096), "float32", 16, 256),
-    "2d32k_bf16": ("2d 32768^2 bf16", (32768, 32768), "bfloat16", 16, 64),
-    "2d32k_f32": ("2d 32768^2 f32", (32768, 32768), "float32", 16, 48),
-    "3d512": ("3d 512^3 f32", (512, 512, 512), "float32", 8, 160),
+    "2d4096": ("2d 4096^2 f32", (4096, 4096), "float32", 16, 2048),
+    "2d32k_bf16": ("2d 32768^2 bf16", (32768, 32768), "bfloat16", 16, 96),
+    "2d32k_f32": ("2d 32768^2 f32", (32768, 32768), "float32", 16, 96),
+    "3d512": ("3d 512^3 f32", (512, 512, 512), "float32", 8, 480),
 }
 
 
@@ -572,17 +746,11 @@ def bench_3d(configs):
             t0 = time.perf_counter()
             c = run.lower(dev).compile()
             compile_s = time.perf_counter() - t0
-            sync(c(dev))  # warm
-            best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                out = c(dev)
-                sync(out)
-                best = min(best, time.perf_counter() - t0)
             nsteps = (steps // min(k, km)) * min(k, km)
-            pts = n3 ** 3 * nsteps / best
+            pts, pts_raw = measure_rate(c, dev, n3 ** 3 * nsteps)
             print(f"R={R:4d} M={M:4d} k={k} km={km}: "
-                  f"{pts:.3e} pts/s  ({pts / 1.024e11 * 100:.0f}% roofline)"
+                  f"{pts:.3e} pts/s  ({pts / 1.024e11 * 100:.0f}% roofline; "
+                  f"raw {pts_raw / 1.024e11 * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
             print(f"R={R:4d} M={M:4d} k={k} km={km}: FAILED "
@@ -605,6 +773,14 @@ if __name__ == "__main__":
     elif exp == "bench2d_f32":
         cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
         bench_2d(cfgs or [(256, 4096, 16, 128)], dtype="float32")
+    elif exp == "check2d_rolled":
+        check_2d_coltiled_rolled()
+    elif exp == "bench2d_rolled":
+        cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
+        bench_2d_rolled(cfgs or [(256, 4096, 16, 128)])
+    elif exp == "bench2d_rolled_f32":
+        cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
+        bench_2d_rolled(cfgs or [(256, 4096, 16, 128)], dtype="float32")
     elif exp == "checkthin":
         check_thin2d_variants()
     elif exp == "benchthin":

@@ -28,6 +28,8 @@ def main():
     sys.path.insert(0, str(Path(__file__).resolve().parent))
     import kernel_lab as lab
 
+    import gc
+
     def stage(name, fn):
         if name not in stages:
             return
@@ -38,6 +40,9 @@ def main():
         except Exception as e:
             print(f"stage {name} FAILED: {type(e).__name__}: "
                   f"{str(e)[:300]}", flush=True)
+        # drop the stage's device buffers (a failed stage's traceback pins
+        # frames holding GiB-scale arrays — the next stage OOMs otherwise)
+        gc.collect()
 
     # 1. the shipped kernels at the BASELINE shapes (what results.json needs)
     stage("framework", lambda: lab.bench_framework([

@@ -205,10 +205,17 @@ def _pallas_2d(T: jax.Array, r: float, ksteps: int,
 
 
 # v5e machine balance for the plans' cost model: effective vector-op rate
-# backed out of the measured thin-band 2D kernel (4096^2 f32: 1.41e11 pts/s
-# at ~12.4 ops/pt-step) and HBM bandwidth
-_VPU_OPS_PER_S = 1.75e12
+# backed out of overhead-corrected on-chip measurements (dispatch+sync over
+# the tunnel costs ~0.15 s/measurement; two-point timing cancels it):
+# rolled col-tiled bf16 32768^2 at 512x4096 tile = 1.89e11 pts/s x ~12.4
+# ops/pt-step ~= 2.3e12; thin-band 4096^2 f32 ~= 2.0e12. Use the midpoint.
+_VPU_OPS_PER_S = 2.2e12
 _HBM_BYTES_PER_S = 819e9
+# col-tiled bands above ~10 MiB (accumulation dtype) send Mosaic compiles
+# from ~1 min (256-row tiles) to 5 min (512 rows, measured 92% roofline)
+# to >12 min (1024 rows) — cap the search there; the modeled gain past it
+# is <4% while compile time doubles
+_COLTILED_BAND_CAP_BYTES = 10 * 1024 * 1024
 # VMEM feasibility for the 3x3 scheme: double-buffered in/out blocks in the
 # storage dtype + the assembled band and its mini-step temporaries in the
 # accumulation dtype must fit under the Mosaic limit with headroom
@@ -437,6 +444,8 @@ def _plan_2d(shape, dtype_str, ksteps: int):
                 tile = R * C
                 if not _fits_vmem(band, tile, item):
                     continue
+                if band * 4 > _COLTILED_BAND_CAP_BYTES:  # compile sanity
+                    continue
                 compute = 11.0 * band / tile / _VPU_OPS_PER_S
                 bw = (band + tile) * item / (tile * k) / _HBM_BYTES_PER_S
                 key = (max(compute, bw), band, -k)
@@ -452,8 +461,14 @@ def _plan_2d(shape, dtype_str, ksteps: int):
 
 def _make_kernel_2d_coltiled(r: float, R: int, C: int, kr: int, kc: int,
                              ksteps: int):
-    """(row, col)-tiled 2D body: both neighbor axes come from halo blocks,
-    so mini-steps are pure shrinking slices — no wrap rotates at all."""
+    """(row, col)-tiled 2D body: the thin kernel's full-band wrap rotates +
+    masked multiplicative update, on a two-axis tile. Every op is
+    lane/sublane-aligned. (A shrinking-slices body — neighbor reads as
+    addressing offsets — was measured to send Mosaic into multi-minute
+    compiles at deep unrolls: sublane/lane-misaligned slice offsets force
+    per-step relayouts. Wrap-rotate band-edge corruption travels one cell
+    per mini-step and stays inside the kr/kc margins, the same invariant as
+    the thin kernel's.)"""
     rows = R + 2 * kr
     cols = C + 2 * kc
 
@@ -474,16 +489,13 @@ def _make_kernel_2d_coltiled(r: float, R: int, C: int, kr: int, kc: int,
         )
         maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
 
-        cur = band
-        for s in range(ksteps):  # static unroll, shrinking shapes
-            ctr = cur[1:-1, 1:-1]
-            lap = (cur[2:, 1:-1] + cur[:-2, 1:-1]
-                   + cur[1:-1, 2:] + cur[1:-1, :-2] - 4.0 * ctr)
-            m_s = maskr[s + 1: rows - s - 1, s + 1: cols - s - 1]
-            cur = ctr + m_s * lap
-        out_ref[:] = jax.lax.slice(
-            cur, (kr - ksteps, kc - ksteps),
-            (kr - ksteps + R, kc - ksteps + C)).astype(store_dt)
+        for _ in range(ksteps):  # static unroll
+            up = pltpu.roll(band, 1, 0)
+            dn = pltpu.roll(band, rows - 1, 0)
+            lf = pltpu.roll(band, 1, 1)
+            rt = pltpu.roll(band, cols - 1, 1)
+            band = band + maskr * (up + dn + lf + rt - 4.0 * band)
+        out_ref[:] = band[kr: kr + R, kc: kc + C].astype(store_dt)
 
     return kernel
 
