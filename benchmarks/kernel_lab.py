@@ -30,29 +30,16 @@ def _round_up(x, m):
 
 
 def measure_rate(c, dev, points_times_steps, repeats=2):
-    """(pts/s corrected, pts/s raw): the tunneled platform carries ~0.15 s
-    fixed dispatch+sync overhead per measurement; timing one call (T1) vs
-    two queued back-to-back calls (T2) cancels it via T2-T1 — no extra
-    compiles. Raw (single-call) rate is reported alongside for context."""
-    import time as _t
+    """(pts/s corrected, pts/s raw) — the framework's shared two-point
+    overhead-cancelling protocol (one measurement definition for the lab
+    benches AND the headline bench.py; see runtime/timing.py)."""
+    import pathlib
+    import sys as _sys
 
-    from heat_tpu.runtime.timing import sync
+    _sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from heat_tpu.runtime.timing import two_point_rate
 
-    sync(c(dev))  # warm
-    best1 = best2 = float("inf")
-    for _ in range(repeats):
-        t0 = _t.perf_counter()
-        out = c(dev)
-        sync(out)
-        best1 = min(best1, _t.perf_counter() - t0)
-        t0 = _t.perf_counter()
-        out = c(c(dev))
-        sync(out)
-        best2 = min(best2, _t.perf_counter() - t0)
-    raw = points_times_steps / best1
-    if best2 <= best1:  # overhead-dominated / noisy: correction is invalid
-        return raw, raw
-    return points_times_steps / (best2 - best1), raw
+    return two_point_rate(c, dev, points_times_steps, repeats)
 
 
 # ---------------------------------------------------------------------------
@@ -650,7 +637,9 @@ def bench_framework(cases):
         plan = (_plan_2d(shape, dtype, ksteps) if len(shape) == 2
                 else _plan_3d(shape, dtype, ksteps))
 
-        @jax.jit
+        # donated carry: the measurement holds one in+out buffer pair —
+        # without it the 32768^2 f32 case (4 GiB/buffer) exhausts HBM
+        @functools.partial(jax.jit, donate_argnums=0)
         def run(T, ksteps=ksteps):
             def body(i, t):
                 return ftcs_multistep_edges_pallas(t, r, ksteps)

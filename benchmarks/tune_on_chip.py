@@ -30,9 +30,7 @@ def main():
 
     import gc
 
-    def stage(name, fn):
-        if name not in stages:
-            return
+    def run_stage(name, fn):
         print(f"=== stage {name} (t+{time.time() - t_start:.0f}s)",
               flush=True)
         try:
@@ -44,21 +42,28 @@ def main():
         # frames holding GiB-scale arrays — the next stage OOMs otherwise)
         gc.collect()
 
-    # 1. the shipped kernels at the BASELINE shapes (what results.json needs)
-    stage("framework", lambda: lab.bench_framework([
-        lab.FRAMEWORK_CASES["2d4096"],
-        lab.FRAMEWORK_CASES["3d512"],
-        lab.FRAMEWORK_CASES["2d32k_bf16"],
-        lab.FRAMEWORK_CASES["2d32k_f32"],
-    ]))
+    def stage(name, fn):
+        if name in stages:
+            run_stage(name, fn)
 
-    # 2. 3D geometry sweep around the plan's pick (48x96 k2) + deeper fusion
+    # 1. the shipped kernels at the BASELINE shapes (what results.json
+    # needs); "framework:2d4096,3d512" filters to named cases (multiple
+    # framework:<cases> args concatenate)
+    fw_filter = [c for s in stages if s.startswith("framework:")
+                 for c in s.split(":", 1)[1].split(",")]
+    fw_cases = fw_filter or ["2d4096", "3d512", "2d32k_bf16", "2d32k_f32"]
+    if fw_filter or "framework" in stages:
+        run_stage("framework", lambda: lab.bench_framework(
+            [lab.FRAMEWORK_CASES[k] for k in fw_cases]))
+
+    # 2. 3D geometry sweep around the additive-model plan's pick
+    # (64x64 k=8, measured 112% of the one-pass roofline)
     stage("lab3d", lambda: lab.bench_3d([
-        (48, 96, 2, 8),
-        (64, 64, 4, 8),
         (64, 64, 8, 8),
-        (32, 128, 4, 8),
-        (96, 48, 4, 8),
+        (64, 128, 8, 8),
+        (32, 64, 8, 8),
+        (64, 64, 4, 8),
+        (48, 96, 2, 8),
     ]))
 
     # 3. col-tiled 2D sweep at the bf16 flagship shape

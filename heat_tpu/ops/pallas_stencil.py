@@ -59,8 +59,11 @@ from .stencil import (accum_dtype_for, ftcs_step_edges, ftcs_step_ghost,
 
 # VMEM ceiling passed to Mosaic; band sizing below stays well under it so
 # the unrolled mini-step chain's live temporaries fit alongside the
-# double-buffered pipeline.
-_VMEM_LIMIT_BYTES = 100 * 1024 * 1024
+# double-buffered pipeline. 110 MiB (of the chip's 128): the 3D plan's
+# 512^3 (64,64,k=8) winner measures 102.05 MiB scoped demand — a 100 MiB
+# ceiling rejects it at compile time (measured; the planner's _fits_vmem
+# estimate runs ~20 MiB below Mosaic's true stack demand).
+_VMEM_LIMIT_BYTES = 110 * 1024 * 1024
 # target in-kernel band footprint (accumulation dtype); measured on v5e:
 # 6 MiB caps 32768^2 bf16 at 69 Gpts/s (16-row tiles, 3x halo-compute
 # overhead), 12 MiB doubles it to 135 Gpts/s (64-row tiles)
@@ -210,6 +213,11 @@ def _pallas_2d(T: jax.Array, r: float, ksteps: int,
 # rolled col-tiled bf16 32768^2 at 512x4096 tile = 1.89e11 pts/s x ~12.4
 # ops/pt-step ~= 2.3e12; thin-band 4096^2 f32 ~= 2.0e12. Use the midpoint.
 _VPU_OPS_PER_S = 2.2e12
+# 3D kernel's effective op rate, fit from the 512^3 sweep with ADDITIVE
+# compute+bandwidth cost (the max() model mispicked k=2 at 68% roofline
+# over k=8 at 112%): measured (R=64,M=64) family k=4/k=8 rates match
+# 13*band/tile / 2.86e12 + (band+tile)*4/(tile*k)/819e9 within 1%
+_OPS_RATE_3D = 2.86e12
 _HBM_BYTES_PER_S = 819e9
 # col-tiled bands above ~10 MiB (accumulation dtype) send Mosaic compiles
 # from ~1 min (256-row tiles) to 5 min (512 rows, measured 92% roofline)
@@ -278,9 +286,12 @@ def _assemble_band(refs, acc_dt):
 @functools.lru_cache(maxsize=None)
 def _plan_3d(shape, dtype_str, ksteps: int):
     """Choose ((m_pad, mid_pad, n_pad), R, M, kchunk) for the tiled 3D
-    kernel: minimize max(compute, bandwidth) per point-step. Ops/pt-step ~
-    13 x band/tile area ratio (2 lane rotates + 2 sublane-shifted reads +
-    ~9 arithmetic; row-axis neighbor reads are addressing offsets)."""
+    kernel: minimize (compute + bandwidth) per LOGICAL point-step —
+    additive, not max(): measured, the two don't overlap enough (see
+    _OPS_RATE_3D) — scaled by the alignment-padding waste factor.
+    Ops/pt-step ~ 13 x band/tile area ratio (2 lane rotates + 2
+    sublane-shifted reads + ~9 arithmetic; row-axis neighbor reads are
+    addressing offsets)."""
     m, mid, n = shape
     sub = _sublane(dtype_str)
     n_pad = _round_up(max(n, 128), 128)
@@ -299,11 +310,16 @@ def _plan_3d(shape, dtype_str, ksteps: int):
                 tile = R * M
                 if not _fits_vmem(band * n_pad, tile * n_pad, item):
                     continue
-                compute = 13.0 * band / tile / _VPU_OPS_PER_S
+                compute = 13.0 * band / tile / _OPS_RATE_3D
                 bw = (band + tile) * item / (tile * k) / _HBM_BYTES_PER_S
-                # ties (same band, same dominant cost) break toward deeper
-                # fusion: fewer passes, fewer chunk boundaries
-                key = (max(compute, bw), band, -k)
+                # cost per LOGICAL point: alignment padding is computed then
+                # discarded (R=70 on a 512-row grid pads 9% dead rows)
+                pad = (_round_up(max(m, R), R) * _round_up(max(mid, M), M)
+                       / max(m * mid, 1))
+                # ADDITIVE cost (measured: compute and HBM streaming do not
+                # overlap enough for max() — see _OPS_RATE_3D note); ties
+                # break toward deeper fusion
+                key = ((compute + bw) * pad, band, -k)
                 if best is None or key < best[0]:
                     best = (key, R, M, k)
     if best is None:

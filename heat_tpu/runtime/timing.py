@@ -45,6 +45,40 @@ def sync(x: Any) -> Any:
     return x
 
 
+def two_point_rate(call, x, work, repeats: int = 2):
+    """(rate_corrected, rate_raw) for ``call`` doing ``work`` units/call.
+
+    The tunneled platform carries a fixed dispatch+sync overhead per
+    measurement (~0.15 s — a harness artifact, not chip time): time one
+    call (T1) and two queued back-to-back calls (T2); the fixed cost
+    cancels in T2-T1 with no extra compiles. The output buffer is recycled
+    as the next input (timing doesn't care about values), so with a
+    donating executable the whole measurement holds one in+out buffer pair
+    — feeding a fresh input per call OOMs at 32768^2 f32 (4 GiB/buffer).
+
+    Noise floor: when T2-T1 < 20% of T1 the measurement is
+    overhead-dominated and per-rep jitter can inflate the corrected rate
+    unboundedly — fall back to the raw single-call rate (conservative).
+    """
+    x = call(x)  # warm; consumes x when the executable donates its input
+    sync(x)
+    best1 = best2 = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        x = call(x)
+        sync(x)
+        best1 = min(best1, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        x = call(call(x))
+        sync(x)
+        best2 = min(best2, time.perf_counter() - t0)
+    raw = work / best1
+    diff = best2 - best1
+    if diff <= 0.2 * best1:
+        return raw, raw
+    return work / diff, raw
+
+
 @dataclasses.dataclass
 class Timing:
     total_s: float = 0.0          # everything: setup + compile + solve + IO
