@@ -41,6 +41,8 @@ def bench_one(name, cfg, repeat=1):
     roofline = HBM_BYTES_PER_S / (2 * itemsize)
     row = {
         "name": name,
+        "measured_ts": time.time(),  # per-row: partial --only re-measures
+                                     # merge into older rows (see main)
         "n": cfg.n, "ndim": cfg.ndim, "steps": best.steps,
         "dtype": cfg.dtype, "backend": cfg.backend,
         "mesh": list(cfg.mesh_shape) if cfg.mesh_shape else None,
@@ -119,6 +121,12 @@ def main():
     # smoke mode must never clobber chip-measured numbers
     out = Path(__file__).parent / (
         "results_smoke.json" if args.smoke else "results.json")
+    if args.only and out.exists():
+        # partial re-measure: merge into the existing rows by name instead
+        # of clobbering the other configs' numbers
+        old = json.loads(out.read_text()).get("rows", [])
+        fresh = {r["name"]: r for r in rows}
+        rows = [fresh.pop(r["name"], r) for r in old] + list(fresh.values())
     out.write_text(json.dumps({"ts": time.time(), "rows": rows}, indent=2))
     print(f"wrote {out}")
 

@@ -282,9 +282,21 @@ def _solve_parity(cfg: HeatConfig, T0, mesh, fetch: bool, warm_exec: bool):
 
 def fuse_depth_sharded(cfg: HeatConfig, axis_sizes) -> int:
     """Halo width per exchange: requested fuse depth capped by the smallest
-    local extent (a shard can't lend deeper halo than it owns)."""
+    local extent (a shard can't lend deeper halo than it owns).
+
+    Auto depth balances the two k-dependent costs per owned point-step:
+    each exchange pays a pad+crop copy of the local block (~2/k full-field
+    passes) against redundant margin work growing as ~2*d*k/L — minimized
+    at k* = sqrt(L/d), clamped to the 2D kernel's fusion cap (_KMAX_2D).
+    Measured on 16384^2 f32 single-chip, 1000-step sweep (k* clamps to
+    32): k=8 -> 94% of the one-pass roofline, k=16 -> 98%, k=32 -> 112%
+    (the official 500-step results.json row records 109.5%)."""
+    from ..ops.pallas_stencil import _KMAX_2D
+
     local_min = min(cfg.n // s for s in axis_sizes)
-    want = cfg.fuse_steps if cfg.fuse_steps else 8
+    want = cfg.fuse_steps
+    if not want:
+        want = max(1, min(_KMAX_2D, round((local_min / cfg.ndim) ** 0.5)))
     return max(1, min(want, local_min))
 
 

@@ -437,11 +437,14 @@ def _plan_2d(shape, dtype_str, ksteps: int):
     n_pad = _round_up(max(n, 128), 128)
 
     def cost_thin(k):
+        # additive compute+bandwidth, like the 3D model (_OPS_RATE_3D
+        # note): measured thin 4096^2 f32 = 6.2e-12 s/pt-step; additive
+        # predicts 6.16e-12 where max() says 5.63e-12
         kpad = _halo_2d(k, dtype_str)
         tile = _tile_2d(n_pad, kpad)
         compute = 11.0 * (tile + 2 * kpad) / tile / _VPU_OPS_PER_S
         bw = (2.0 * tile + 2 * kpad) * item / (tile * k) / _HBM_BYTES_PER_S
-        return max(compute, bw)
+        return compute + bw
 
     k_thin = min(max(ksteps, 1), _KMAX_2D)
     best_col = None
@@ -464,7 +467,7 @@ def _plan_2d(shape, dtype_str, ksteps: int):
                     continue
                 compute = 11.0 * band / tile / _VPU_OPS_PER_S
                 bw = (band + tile) * item / (tile * k) / _HBM_BYTES_PER_S
-                key = (max(compute, bw), band, -k)
+                key = (compute + bw, band, -k)
                 if best_col is None or key < best_col[0]:
                     best_col = (key, R, C, kr, kc, k)
     # the thin-band kernel is the measured-proven default; switch only for

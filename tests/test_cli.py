@@ -109,7 +109,7 @@ def test_cli_plan_subcommand(tmp_cwd, capsys):
     assert main(["plan", "--backend", "sharded", "--dtype", "float32",
                  "--mesh", "4x4"]) == 0
     out = capsys.readouterr().out
-    assert "local block 1024x1024" in out and "halo: width 8" in out
+    assert "local block 1024x1024" in out and "halo: width 23" in out  # k* = round(sqrt(1024/2))
 
     # f64 -> XLA fallback is reported honestly
     assert main(["plan", "--variant", "cuda_kernel"]) == 0

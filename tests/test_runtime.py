@@ -73,3 +73,30 @@ def test_timing_report_lines():
     assert lines[0] == "simulation completed!!!!"
     assert any("Average time per timestep: 0.1" in l for l in lines)
     assert t.points_per_s == pytest.approx(1000.0)
+
+
+def test_two_point_rate_cancels_fixed_overhead(monkeypatch):
+    """The shared measurement protocol (bench.py + kernel lab): with a
+    fixed per-measurement overhead riding on the sync fence and compute
+    time C per call, the corrected rate must be ~work/C (not work/(C+O)),
+    and must fall back to the raw rate when overhead dominates (noise
+    floor) rather than report an inflated figure."""
+    import time as _time
+
+    from heat_tpu.runtime import timing as timing_mod
+
+    monkeypatch.setattr(timing_mod, "sync",
+                        lambda x: (_time.sleep(0.060), x)[1])
+
+    # compute 30 ms/call + 60 ms overhead/measurement:
+    # T1 ~ 90 ms, T2 ~ 120 ms -> corrected ~ work/30ms, raw ~ work/90ms
+    corrected, raw = timing_mod.two_point_rate(
+        lambda x: (_time.sleep(0.030), x)[1], "x", work=1.0, repeats=2)
+    assert raw == pytest.approx(1.0 / 0.090, rel=0.25)
+    assert corrected == pytest.approx(1.0 / 0.030, rel=0.25)
+
+    # overhead-dominated (compute 1 ms vs 60 ms overhead): the noise
+    # floor must return the raw rate unchanged
+    corrected2, raw2 = timing_mod.two_point_rate(
+        lambda x: (_time.sleep(0.001), x)[1], "x", work=1.0, repeats=2)
+    assert corrected2 == raw2

@@ -64,10 +64,13 @@ def test_fused_halo_matches_per_step_exchange(bc, ic):
 def test_fuse_depth_capped_by_local_extent():
     from heat_tpu.backends.sharded import fuse_depth_sharded
 
-    cfg = BASE.with_(fuse_steps=0)          # auto -> want 8
-    assert fuse_depth_sharded(cfg, (8, 1)) == 4   # local 4 rows caps it
-    assert fuse_depth_sharded(cfg, (2, 2)) == 8
+    cfg = BASE.with_(fuse_steps=0)          # auto: k* = sqrt(L/d)
+    assert fuse_depth_sharded(cfg, (8, 1)) == round((4 / 2) ** 0.5)
+    assert fuse_depth_sharded(cfg, (2, 2)) == round((16 / 2) ** 0.5)
     assert fuse_depth_sharded(cfg.with_(fuse_steps=3), (2, 2)) == 3
+    # large local blocks clamp at the kernel fusion cap (measured best)
+    big = cfg.with_(n=16384)
+    assert fuse_depth_sharded(big, (1, 1)) == 32
 
 
 def test_sharded_staged_comm_matches_direct():
