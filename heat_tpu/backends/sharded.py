@@ -83,40 +83,37 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
         and kernel_ok
     )
 
-    def local_multi_pallas(local: jax.Array, w: int) -> jax.Array:
-        # per-shard fast path: one width-w exchange, then w steps fused in
-        # the Pallas kernel. Only global-domain edges freeze (the bounds);
-        # the w-cell discard margin owns all array-edge garbage — the same
-        # dependency-cone invariant as the XLA path below.
+    def padded_multi(padded: jax.Array, wpad: int, ksteps: int) -> jax.Array:
+        """Exchange the width-``wpad`` ghost ring, then run ``ksteps`` <=
+        wpad fused steps; input AND output are the full padded shard (the
+        output's ghost margins are garbage — the next exchange rewrites
+        every margin cell before anything reads them). This is the
+        pad-free core: the padded-carry solve path calls it directly so
+        the per-exchange pad+crop copy of the whole block disappears."""
         padded0 = halo_exchange(
-            halo_pad(local, bc_value, w), axis_names, axis_sizes, bc_value,
-            staged=staged, width=w, periodic=periodic,
+            padded, axis_names, axis_sizes, bc_value,
+            staged=staged, width=wpad, periodic=periodic,
         )
-        edges = 1 if cfg.bc == "edges" else 0
-        bounds = []
-        for d, name in enumerate(axis_names):
-            if periodic:  # torus: nothing frozen anywhere
-                bounds.extend([jnp.int32(-_NO_FREEZE), jnp.int32(_NO_FREEZE)])
-                continue
-            coord = jax.lax.axis_index(name)
-            M = local.shape[d] + 2 * w
-            bounds.append(jnp.where(coord == 0, w - 1 + edges, -1))
-            bounds.append(jnp.where(coord == axis_sizes[d] - 1,
-                                    M - w - edges, M))
-        out = ftcs_multistep_bounded_pallas(
-            padded0, r, w, jnp.stack(bounds).astype(jnp.int32))
-        ctr = tuple(slice(w, -w) for _ in range(out.ndim))
-        return out[ctr]
-
-    def local_multi(local: jax.Array, w: int) -> jax.Array:
         if use_pallas:
-            return local_multi_pallas(local, w)
-        acc_dt = accum_dtype_for(local.dtype)
+            # Only global-domain edges freeze (the bounds); the wpad-cell
+            # discard margin owns all array-edge garbage.
+            edges = 1 if cfg.bc == "edges" else 0
+            bounds = []
+            for d, name in enumerate(axis_names):
+                if periodic:  # torus: nothing frozen anywhere
+                    bounds.extend([jnp.int32(-_NO_FREEZE),
+                                   jnp.int32(_NO_FREEZE)])
+                    continue
+                coord = jax.lax.axis_index(name)
+                M = padded.shape[d]
+                bounds.append(jnp.where(coord == 0, wpad - 1 + edges, -1))
+                bounds.append(jnp.where(coord == axis_sizes[d] - 1,
+                                        M - wpad - edges, M))
+            return ftcs_multistep_bounded_pallas(
+                padded0, r, ksteps, jnp.stack(bounds).astype(jnp.int32))
+
+        acc_dt = accum_dtype_for(padded.dtype)
         rr = jnp.asarray(r, acc_dt)
-        padded0 = halo_exchange(
-            halo_pad(local, bc_value, w), axis_names, axis_sizes, bc_value,
-            staged=staged, width=w, periodic=periodic,
-        )
         if periodic:
             pinned = None  # torus: no Dirichlet ghosts, no frozen ring
         else:
@@ -125,7 +122,7 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
             gidx = []
             for d, name in enumerate(axis_names):
                 coord = jax.lax.axis_index(name)
-                base = coord * local.shape[d] - w
+                base = coord * (padded.shape[d] - 2 * wpad) - wpad
                 gidx.append(base + jax.lax.broadcasted_iota(
                     jnp.int32, padded0.shape, d))
             exterior = functools.reduce(
@@ -137,27 +134,31 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
             else:
                 pinned = exterior
 
-        def mini_step(padded):
+        def mini_step(p):
             # clamp-pad so the outermost ring has *some* neighbor value; its
             # update is garbage but sits beyond every layer any valid cell
             # reads afterwards (periodic included: ghost layer L is valid
-            # for the first w-L mini-steps, exactly when it is read)
-            clamped = jnp.pad(padded, 1, mode="edge")
-            new = (padded.astype(acc_dt)
-                   + rr * laplacian_interior(clamped)).astype(padded.dtype)
+            # for the first wpad-L mini-steps, exactly when it is read)
+            clamped = jnp.pad(p, 1, mode="edge")
+            new = (p.astype(acc_dt)
+                   + rr * laplacian_interior(clamped)).astype(p.dtype)
             if pinned is None:
                 return new
             # exterior ghosts stay Dirichlet; edges-BC boundary ring stays
             # at its (never-changing) initial value
             return jnp.where(pinned, padded0, new)
 
-        padded = padded0
-        for _ in range(w):  # static unroll
-            padded = mini_step(padded)
-        ctr = tuple(slice(w, -w) for _ in range(padded.ndim))
-        return padded[ctr]
+        p = padded0
+        for _ in range(ksteps):  # static unroll
+            p = mini_step(p)
+        return p
 
-    return local_multi
+    def local_multi(local: jax.Array, w: int) -> jax.Array:
+        out = padded_multi(halo_pad(local, bc_value, w), w, w)
+        ctr = tuple(slice(w, -w) for _ in range(out.ndim))
+        return out[ctr]
+
+    return local_multi, padded_multi
 
 
 def make_parity_machinery(cfg: HeatConfig, mesh):
@@ -266,6 +267,12 @@ def _solve_parity(cfg: HeatConfig, T0, mesh, fetch: bool, warm_exec: bool):
     Tp = seed(T_owned, from_ic=T0 is None)
     res = drive(cfg.with_(report_sum=False), Tp, advance,
                 start_step=start_step, fetch=False, warm_exec=warm_exec)
+    return _finalize_carried(cfg, res, crop, fetch)
+
+
+def _finalize_carried(cfg: HeatConfig, res, crop, fetch: bool):
+    """Crop a padded-state result back to the owned field and do the
+    post-solve accounting (fetch, gsum) the padded state deferred."""
     res.cfg = cfg
     res.T_dev = crop(res.T_dev)
     res.T = host_fetch(res.T_dev) if fetch else None
@@ -280,6 +287,17 @@ def _solve_parity(cfg: HeatConfig, T0, mesh, fetch: bool, warm_exec: bool):
     return res
 
 
+def _solve_padded_carry(cfg: HeatConfig, T0, mesh, fetch: bool,
+                        warm_exec: bool):
+    """Default sharded solve: padded-carry state (make_padded_carry_machinery)."""
+    sharding = NamedSharding(mesh, P(*mesh.axis_names))
+    T_owned, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
+    seed, advance, crop = make_padded_carry_machinery(cfg, mesh)
+    res = drive(cfg.with_(report_sum=False), seed(T_owned), advance,
+                start_step=start_step, fetch=False, warm_exec=warm_exec)
+    return _finalize_carried(cfg, res, crop, fetch)
+
+
 def fuse_depth_sharded(cfg: HeatConfig, axis_sizes) -> int:
     """Halo width per exchange: requested fuse depth capped by the smallest
     local extent (a shard can't lend deeper halo than it owns).
@@ -290,7 +308,8 @@ def fuse_depth_sharded(cfg: HeatConfig, axis_sizes) -> int:
     at k* = sqrt(L/d), clamped to the 2D kernel's fusion cap (_KMAX_2D).
     Measured on 16384^2 f32 single-chip, 1000-step sweep (k* clamps to
     32): k=8 -> 94% of the one-pass roofline, k=16 -> 98%, k=32 -> 112%
-    (the official 500-step results.json row records 109.5%)."""
+    (the official 500-step results.json row, on the padded-carry path,
+    records 113.8%)."""
     from ..ops.pallas_stencil import _KMAX_2D
 
     local_min = min(cfg.n // s for s in axis_sizes)
@@ -300,12 +319,12 @@ def fuse_depth_sharded(cfg: HeatConfig, axis_sizes) -> int:
     return max(1, min(want, local_min))
 
 
-def make_advance(cfg: HeatConfig, mesh):
-    axis_names = mesh.axis_names
-    axis_sizes = mesh.devices.shape
-    local_multi = make_local_multistep(cfg, axis_names, axis_sizes)
-    kf = fuse_depth_sharded(cfg, axis_sizes)
-    spec = P(*axis_names)
+def _chunked_advance(mesh, step, kf: int):
+    """Jitted, donated k-step advance: fused blocks of ``kf`` steps + one
+    remainder call, via ``step(local_state, nsteps)`` inside shard_map —
+    the ONE chunking scheme both the owned-state and padded-carry paths
+    use (only the step callable differs)."""
+    spec = P(*mesh.axis_names)
 
     @functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
     def advance(Tg, k: int):
@@ -313,15 +332,59 @@ def make_advance(cfg: HeatConfig, mesh):
             n_fused, rem = divmod(k, kf)
             if n_fused:
                 local = jax.lax.fori_loop(
-                    0, n_fused, lambda i, t: local_multi(t, kf), local)
+                    0, n_fused, lambda i, t: step(t, kf), local)
             if rem:
-                local = local_multi(local, rem)
+                local = step(local, rem)
             return local
 
         return shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec,
                          check_vma=False)(Tg)
 
     return advance
+
+
+def make_advance(cfg: HeatConfig, mesh):
+    local_multi, _ = make_local_multistep(
+        cfg, mesh.axis_names, mesh.devices.shape)
+    kf = fuse_depth_sharded(cfg, mesh.devices.shape)
+    return _chunked_advance(mesh, local_multi, kf)
+
+
+def make_padded_carry_machinery(cfg: HeatConfig, mesh):
+    """(seed, advance, crop) carrying the PADDED field as solve state.
+
+    The classic advance pays a pad+crop copy of every local block per
+    exchange (~2/k full-field HBM passes at fuse depth k). Carrying each
+    shard as owned+2w cells removes both copies: every fused block is
+    exchange-in-place + kernel, ghosts garbage between exchanges but
+    rewritten before any read. Owned-cell values are bit-identical to the
+    classic path (same exchange, same kernel, same bounds). The same
+    padded-state idea the parity machinery uses for w=1 ghosts
+    (make_parity_machinery), here at the communication-avoiding width.
+    """
+    axis_names = mesh.axis_names
+    axis_sizes = mesh.devices.shape
+    _, padded_multi = make_local_multistep(cfg, axis_names, axis_sizes)
+    kf = fuse_depth_sharded(cfg, axis_sizes)
+    bc_value = cfg.bc_value
+    spec = P(*axis_names)
+    smap = functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec, check_vma=False)
+
+    def seed(T_owned: jax.Array) -> jax.Array:
+        return jax.jit(smap(lambda local: halo_pad(local, bc_value, kf)))(
+            T_owned)
+
+    # margins stay width kf across calls; only the step count shrinks on
+    # the remainder chunk
+    advance = _chunked_advance(mesh, lambda p, k: padded_multi(p, kf, k), kf)
+
+    @jax.jit
+    def crop(Tp):
+        return smap(
+            lambda p: p[tuple(slice(kf, -kf) for _ in range(p.ndim))])(Tp)
+
+    return seed, advance, crop
 
 
 @register("sharded")
@@ -344,6 +407,12 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
 
     if cfg.parity_order:
         res = _solve_parity(cfg, T0, mesh, fetch, warm_exec)
+    elif not cfg.checkpoint_every and not cfg.check_numerics and cfg.ntime:
+        # default fast path: padded-carry state (no per-exchange pad+crop
+        # copies). Checkpoint/numerics runs keep the owned-state path —
+        # their mid-run host visits (snapshot dumps, finite checks) need
+        # the owned field, which padded state only yields via a crop.
+        res = _solve_padded_carry(cfg, T0, mesh, fetch, warm_exec)
     else:
         sharding = NamedSharding(mesh, P(*mesh.axis_names))
         T, start_step = resolve_initial_field(cfg, T0, sharding=sharding)

@@ -195,3 +195,24 @@ def test_report_sum_survives_fetch_false():
     nofetch = solve(cfg, fetch=False)
     assert nofetch.gsum is not None
     np.testing.assert_allclose(nofetch.gsum, fetched.gsum, rtol=1e-6)
+
+
+def test_padded_carry_matches_owned_state_path():
+    """The padded-carry fast path (default) and the owned-state path (used
+    under checkpointing/numerics-checking) must agree bit-for-bit — same
+    exchange, same kernel, same bounds; only the pad/crop placement moves."""
+    for bc, ic in (("edges", "hat"), ("ghost", "uniform"),
+                   ("periodic", "hat")):
+        cfg = BASE.with_(mesh_shape=(2, 4), bc=bc, ic=ic, ntime=9)
+        fast = solve(cfg)
+        # check_numerics=True forces the owned-state path (and actually
+        # checks finiteness along the way)
+        classic = solve(cfg.with_(check_numerics=True))
+        np.testing.assert_allclose(fast.T, classic.T, rtol=0, atol=0)
+
+    # the Pallas branch of padded_multi too (interpret mode on CPU; f32)
+    cfg = BASE.with_(mesh_shape=(2, 4), bc="ghost", ic="uniform", ntime=9,
+                     dtype="float32", local_kernel="pallas")
+    fast = solve(cfg)
+    classic = solve(cfg.with_(check_numerics=True))
+    np.testing.assert_allclose(fast.T, classic.T, rtol=0, atol=0)
