@@ -41,10 +41,10 @@ import time
 N = 4096
 STEPS = 8192
 REPEATS = 3
-# ideal one-pass-per-step roofline: 819 GB/s HBM / (2 * 4 B) per point per
-# step f32 (read + write once; the reference's snapshot copy doubles this)
-ROOFLINE_POINTS_PER_S = 1.024e11
-
+# NOTE: the supervisor must know the metric string WITHOUT importing
+# heat_tpu (a broken import must still yield one parseable error line), so
+# this literal intentionally mirrors heat_tpu.benchmark.metric_name(N);
+# measure() asserts they agree.
 METRIC = f"grid_points_per_sec_per_chip_{N}x{N}_f32_pallas"
 
 
@@ -72,41 +72,17 @@ _RETRYABLE = ("Unable to initialize backend", "UNAVAILABLE", "DEADLINE")
 
 
 def measure() -> None:
-    """The actual benchmark (runs in the supervised subprocess)."""
-    import jax
-    import jax.numpy as jnp
+    """The actual benchmark (runs in the supervised subprocess); the
+    measurement itself lives in heat_tpu.benchmark — ONE definition shared
+    with the `heat-tpu bench` CLI subcommand."""
+    from heat_tpu.benchmark import headline_measure
 
-    from heat_tpu.backends.pallas import make_advance
-    from heat_tpu.config import HeatConfig
-    from heat_tpu.grid import initial_condition
-    from heat_tpu.runtime.timing import two_point_rate
-
-    platform = jax.default_backend()  # first device touch; may raise/hang
-
-    cfg = HeatConfig(n=N, ntime=STEPS, dtype="float32", ic="hat",
-                     backend="pallas")
-    T0 = initial_condition(cfg).astype("float32")
-    advance = make_advance(cfg)
-
-    x = jax.device_put(jnp.asarray(T0))
-    compiled = advance.lower(x, STEPS).compile()
-    # shared two-point overhead-cancelling protocol (runtime/timing.py):
-    # the tunneled platform's fixed dispatch+sync cost (~0.15 s — a harness
-    # artifact, not chip time) cancels in T2-T1; noise floor falls back to
-    # the raw single-call rate. advance donates, so the one buffer recycles.
-    pts_per_s, raw_pts_per_s = two_point_rate(
-        compiled, x, N * N * STEPS, repeats=REPEATS)
+    record = headline_measure(n=N, steps=STEPS, repeats=REPEATS)
+    assert record["metric"] == METRIC, (record["metric"], METRIC)
     # flush: the pipe is block-buffered and JAX atexit teardown can hang
     # before interpreter stdio flush — the supervisor's salvage path needs
     # this line physically in the pipe the moment it's produced
-    print(json.dumps({
-        "metric": METRIC,
-        "value": pts_per_s,
-        "unit": "points/s",
-        "vs_baseline": pts_per_s / ROOFLINE_POINTS_PER_S,
-        "raw_single_call": raw_pts_per_s,
-        "platform": platform,
-    }), flush=True)
+    print(json.dumps(record), flush=True)
 
 
 def _parse_result_line(stdout: str):

@@ -104,7 +104,7 @@ def main():
         # 5. bf16 storage + f32 accumulate, 32768^2 (weak-scale flagship,
         #    fortran/input_all.dat: 32768^2 x 25000)
         ("5_bf16_32768sq",
-         HeatConfig(n=512 if s else 32768, ntime=10 if s else 400,
+         HeatConfig(n=512 if s else 32768, ntime=10 if s else 800,
                     dtype="bfloat16", backend="pallas")),
     ]
 

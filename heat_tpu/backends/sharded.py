@@ -372,8 +372,13 @@ def make_padded_carry_machinery(cfg: HeatConfig, mesh):
                              out_specs=spec, check_vma=False)
 
     def seed(T_owned: jax.Array) -> jax.Array:
-        return jax.jit(smap(lambda local: halo_pad(local, bc_value, kf)))(
-            T_owned)
+        # donated: the owned-field buffer (1 GiB at 16384^2 f32) must not
+        # stay pinned for the whole solve alongside the padded state.
+        # (CPU can't donate and warns about it — skip there; the virtual-
+        # device test mesh is the only CPU user.)
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        return jax.jit(smap(lambda local: halo_pad(local, bc_value, kf)),
+                       donate_argnums=donate)(T_owned)
 
     # margins stay width kf across calls; only the step count shrinks on
     # the remainder chunk

@@ -1,0 +1,60 @@
+"""Headline benchmark core: grid-points/sec/chip on the f32 Pallas stencil.
+
+One measurement definition, two front doors: the repo-root ``bench.py``
+(the driver-run artifact — supervised subprocess, retry, one JSON line)
+and the ``heat-tpu bench`` CLI subcommand (inline, interactive). Both
+report the overhead-corrected two-point rate with the raw single-call
+rate alongside (``runtime/timing.py::two_point_rate``).
+
+The shape mirrors the reference's single-GPU benchmark
+(python/cuda/cuda.py:31-33: 4096^2, 10k steps; 8192 steps here has the
+identical steady-state per-step cost), and ``vs_baseline`` is against the
+ideal one-pass-per-step HBM roofline on this chip class (819 GB/s v5e /
+2*itemsize = 1.024e11 points/s f32) — the bound no
+one-kernel-launch-per-step design (the reference's structure) can exceed.
+"""
+
+from __future__ import annotations
+
+N = 4096
+STEPS = 8192
+REPEATS = 3
+ROOFLINE_POINTS_PER_S = 1.024e11
+
+
+def metric_name(n: int = N) -> str:
+    return f"grid_points_per_sec_per_chip_{n}x{n}_f32_pallas"
+
+
+def headline_measure(n: int = N, steps: int = STEPS,
+                     repeats: int = REPEATS) -> dict:
+    """Run the headline measurement on the current default platform and
+    return the result record (the dict ``bench.py`` prints as JSON)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .backends.pallas import make_advance
+    from .config import HeatConfig
+    from .grid import initial_condition
+    from .runtime.timing import two_point_rate
+
+    platform = jax.default_backend()  # first device touch; may raise/hang
+
+    cfg = HeatConfig(n=n, ntime=steps, dtype="float32", ic="hat",
+                     backend="pallas")
+    T0 = initial_condition(cfg).astype("float32")
+    advance = make_advance(cfg)
+
+    x = jax.device_put(jnp.asarray(T0))
+    compiled = advance.lower(x, steps).compile()
+    # advance donates its input, so two_point_rate recycles one buffer pair
+    pts_per_s, raw = two_point_rate(compiled, x, n * n * steps,
+                                    repeats=repeats)
+    return {
+        "metric": metric_name(n),
+        "value": pts_per_s,
+        "unit": "points/s",
+        "vs_baseline": pts_per_s / ROOFLINE_POINTS_PER_S,
+        "raw_single_call": raw,
+        "platform": platform,
+    }

@@ -111,6 +111,19 @@ def build_parser() -> argparse.ArgumentParser:
     plan.add_argument("--bc", choices=["edges", "ghost", "periodic"])
     plan.add_argument("--comm", choices=["direct", "staged"])
 
+    bench = sub.add_parser(
+        "bench",
+        help="headline throughput benchmark (grid-points/sec/chip, f32 "
+             "Pallas stencil) — the reference's python/cuda benchmark "
+             "workflow as one command; prints a human summary + the same "
+             "JSON record as bench.py")
+    bench.add_argument("--n", type=int, default=0,
+                       help="grid side (default 4096 on TPU, 512 elsewhere)")
+    bench.add_argument("--steps", type=int, default=0,
+                       help="timesteps per timed call (default 8192 TPU, "
+                            "256 elsewhere)")
+    bench.add_argument("--repeats", type=int, default=3)
+
     launch = sub.add_parser(
         "launch",
         help="run N distributed processes on this machine (the reference's "
@@ -453,6 +466,31 @@ def cmd_viz(args) -> int:
     return 0
 
 
+def cmd_bench(args) -> int:
+    """Inline headline benchmark (shared core with the repo-root bench.py,
+    heat_tpu/benchmark.py). Defaults shrink off-TPU so the command stays
+    interactive on a laptop/CI host."""
+    import json as _json
+
+    import jax
+
+    from .benchmark import ROOFLINE_POINTS_PER_S, headline_measure
+
+    if args.repeats < 1:
+        print("bench: --repeats must be >= 1", file=sys.stderr)
+        return 2
+    on_tpu = jax.default_backend() == "tpu"
+    n = args.n or (4096 if on_tpu else 512)
+    steps = args.steps or (8192 if on_tpu else 256)
+    rec = headline_measure(n=n, steps=steps, repeats=args.repeats)
+    print(f"{rec['value']:.4g} points/s "
+          f"({100 * rec['value'] / ROOFLINE_POINTS_PER_S:.0f}% of the "
+          f"one-pass v5e HBM roofline; raw single-call "
+          f"{rec['raw_single_call']:.4g}) on {rec['platform']}")
+    print(_json.dumps(rec))
+    return 0
+
+
 def cmd_info(_args) -> int:
     import jax
 
@@ -468,7 +506,8 @@ def cmd_info(_args) -> int:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     return {"run": cmd_run, "viz": cmd_viz, "info": cmd_info,
-            "launch": cmd_launch, "plan": cmd_plan}[args.command](args)
+            "launch": cmd_launch, "plan": cmd_plan,
+            "bench": cmd_bench}[args.command](args)
 
 
 if __name__ == "__main__":

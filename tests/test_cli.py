@@ -119,3 +119,20 @@ def test_cli_plan_subcommand(tmp_cwd, capsys):
     assert main(["plan", "--backend", "sharded", "--ndim", "3",
                  "--mesh", "4x2"]) == 2
     assert main(["plan", "--backend", "sharded", "--mesh", "3x3"]) == 2
+
+
+def test_cli_bench_subcommand(capsys):
+    """`heat-tpu bench` — the headline measurement inline (shared core
+    with bench.py), shrunken off-TPU; the JSON record must parse and
+    carry the bench.py field contract."""
+    import json
+
+    from heat_tpu.cli import main
+
+    assert main(["bench", "--n", "64", "--steps", "8", "--repeats", "1"]) == 0
+    out = capsys.readouterr().out
+    rec = json.loads([l for l in out.splitlines() if l.startswith("{")][-1])
+    assert rec["metric"] == "grid_points_per_sec_per_chip_64x64_f32_pallas"
+    assert rec["value"] > 0 and rec["raw_single_call"] > 0
+    assert set(rec) >= {"metric", "value", "unit", "vs_baseline",
+                        "raw_single_call", "platform"}
