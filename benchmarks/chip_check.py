@@ -1,0 +1,104 @@
+"""On-chip numeric certification: the REAL Mosaic-compiled kernels vs the
+numpy oracle.
+
+CI validates the Pallas kernels in interpret mode (a simulation of the
+kernel semantics); the compiled Mosaic artifact the chip actually runs is
+only exercised by benchmarks, which never check values. This harness
+closes that gap: on the attached TPU it runs every backend x BC x dtype x
+rank combination the kernels ship, at real (but small) sizes, and diffs
+the result against the serial numpy oracle with dtype-appropriate
+tolerances — the reference's cross-variant `soln.dat`-vs-serial check
+(SURVEY.md SS4), executed on hardware.
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/chip_check.py
+Writes benchmarks/chip_check.json (skipped off-TPU: certifying the CPU
+path would re-test what CI already covers).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def cases():
+    from heat_tpu.config import HeatConfig
+
+    # 2D: every bc on both device backends, both dtypes, fused and not;
+    # sizes chosen to cross tile boundaries (n=200 is not lane-aligned)
+    for backend in ("xla", "pallas"):
+        for bc in ("edges", "ghost", "periodic"):
+            for dtype, tol in (("float32", 5e-6), ("bfloat16", 5e-2)):
+                for fuse in (0, 1):  # 0 = auto (deep fusion), 1 = unfused
+                    yield (f"2d-{backend}-{bc}-{dtype}-fuse{fuse}",
+                           HeatConfig(n=200, ntime=24, dtype=dtype,
+                                      backend=backend, bc=bc, ic="hat",
+                                      fuse_steps=fuse),
+                           tol)
+    # 3D: the (row,mid)-tiled kernel, both dtypes
+    for dtype, tol in (("float32", 5e-6), ("bfloat16", 5e-2)):
+        yield (f"3d-pallas-edges-{dtype}",
+               HeatConfig(n=48, ndim=3, ntime=10, dtype=dtype, sigma=0.15,
+                          backend="pallas", bc="edges", ic="hat"),
+               tol)
+    # sharded on the one real chip (1x1 mesh): the padded-carry path +
+    # bounded kernel + halo machinery, all three BCs
+    from heat_tpu.config import HeatConfig as HC
+
+    for bc in ("edges", "ghost", "periodic"):
+        yield (f"2d-sharded-{bc}-float32",
+               HC(n=256, ntime=20, dtype="float32", backend="sharded",
+                  bc=bc, ic="hat"),
+               5e-6)
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    if jax.default_backend() != "tpu":
+        print("chip_check: no TPU attached; CI already covers the "
+              "interpret/CPU paths — nothing to certify")
+        return 0
+
+    from heat_tpu.backends import solve
+
+    rows = []
+    failed = 0
+    for name, cfg, tol in cases():
+        # oracle in f32 (bf16 storage still accumulates in f32; comparing
+        # against an f32 oracle bounds the storage rounding via tol)
+        oracle_cfg = cfg.with_(backend="serial", fuse_steps=0,
+                               dtype="float32")
+        ref = solve(oracle_cfg).T
+        try:
+            got = solve(cfg, warm_exec=False).T
+            err = float(np.max(np.abs(
+                np.asarray(got, np.float32) - np.asarray(ref, np.float32))))
+            ok = bool(err < tol)
+        except Exception as e:  # noqa: BLE001 - record, keep certifying
+            err, ok = float("nan"), False
+            print(f"{name:40s} ERROR {type(e).__name__}: {str(e)[:120]}",
+                  flush=True)
+        else:
+            print(f"{name:40s} max|err| {err:.2e}  "
+                  f"{'OK' if ok else f'FAIL (tol {tol:g})'}", flush=True)
+        failed += not ok
+        rows.append({"name": name, "max_abs_err": err, "tol": tol,
+                     "ok": ok})
+
+    out = Path(__file__).parent / "chip_check.json"
+    out.write_text(json.dumps(
+        {"ts": time.time(), "platform": "tpu",
+         "passed": len(rows) - failed, "failed": failed, "rows": rows},
+        indent=2))
+    print(f"chip_check: {len(rows) - failed}/{len(rows)} passed; wrote {out}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -293,7 +293,9 @@ def _solve_padded_carry(cfg: HeatConfig, T0, mesh, fetch: bool,
     sharding = NamedSharding(mesh, P(*mesh.axis_names))
     T_owned, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
     seed, advance, crop = make_padded_carry_machinery(cfg, mesh)
-    res = drive(cfg.with_(report_sum=False), seed(T_owned), advance,
+    Tp = seed(T_owned)
+    del T_owned  # unpin the owned-field device buffer for the solve
+    res = drive(cfg.with_(report_sum=False), Tp, advance,
                 start_step=start_step, fetch=False, warm_exec=warm_exec)
     return _finalize_carried(cfg, res, crop, fetch)
 
@@ -372,13 +374,14 @@ def make_padded_carry_machinery(cfg: HeatConfig, mesh):
                              out_specs=spec, check_vma=False)
 
     def seed(T_owned: jax.Array) -> jax.Array:
-        # donated: the owned-field buffer (1 GiB at 16384^2 f32) must not
-        # stay pinned for the whole solve alongside the padded state.
-        # (CPU can't donate and warns about it — skip there; the virtual-
-        # device test mesh is the only CPU user.)
-        donate = (0,) if jax.default_backend() != "cpu" else ()
-        return jax.jit(smap(lambda local: halo_pad(local, bc_value, kf)),
-                       donate_argnums=donate)(T_owned)
+        # the caller must drop its T_owned reference after seeding (see
+        # _solve_padded_carry): the owned-field buffer (1 GiB at 16384^2
+        # f32) must not stay pinned for the whole solve alongside the
+        # padded state. (Donation can't help here — the padded output is a
+        # different shape, so the input buffer is never reusable and JAX
+        # warns.)
+        return jax.jit(smap(lambda local: halo_pad(local, bc_value, kf)))(
+            T_owned)
 
     # margins stay width kf across calls; only the step count shrinks on
     # the remainder chunk

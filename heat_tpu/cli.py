@@ -474,17 +474,17 @@ def cmd_bench(args) -> int:
 
     import jax
 
-    from .benchmark import ROOFLINE_POINTS_PER_S, headline_measure
+    from .benchmark import N, STEPS, headline_measure
 
     if args.repeats < 1:
         print("bench: --repeats must be >= 1", file=sys.stderr)
         return 2
     on_tpu = jax.default_backend() == "tpu"
-    n = args.n or (4096 if on_tpu else 512)
-    steps = args.steps or (8192 if on_tpu else 256)
+    n = args.n or (N if on_tpu else 512)
+    steps = args.steps or (STEPS if on_tpu else 256)
     rec = headline_measure(n=n, steps=steps, repeats=args.repeats)
     print(f"{rec['value']:.4g} points/s "
-          f"({100 * rec['value'] / ROOFLINE_POINTS_PER_S:.0f}% of the "
+          f"({100 * rec['vs_baseline']:.0f}% of the "
           f"one-pass v5e HBM roofline; raw single-call "
           f"{rec['raw_single_call']:.4g}) on {rec['platform']}")
     print(_json.dumps(rec))
