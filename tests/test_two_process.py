@@ -142,3 +142,17 @@ def test_cli_launch_requires_worker_args(capsys):
     from heat_tpu.cli import main
 
     assert main(["launch", "-n", "2"]) == 2
+
+
+def test_cli_launch_propagates_worker_failure(tmp_cwd):
+    """Failure detection in the mpirun-analog launcher: when every worker
+    exits nonzero fast (startup-class config error), the launcher must
+    return the failure code promptly instead of hanging in collective
+    rendezvous — the dead-peer cleanup of cmd_launch.run_world."""
+    from heat_tpu.cli import main
+
+    (tmp_cwd / "input.dat").write_text("16 0.25 0.05 2.0 3 0\n")
+    # mesh rank 3 on a 2-D config: every rank rejects it at validation
+    rc = main(["launch", "-n", "2", "run", "--backend", "sharded",
+               "--mesh", "2x2x2"])
+    assert rc != 0
