@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test bench bench-all bench-smoke weak-scaling native run viz clean
+.PHONY: test bench bench-all bench-smoke chip-check weak-scaling native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -13,6 +13,9 @@ bench:
 
 bench-all:
 	$(PY) benchmarks/run_all.py
+
+chip-check:
+	$(PY) benchmarks/chip_check.py
 
 bench-smoke:
 	$(PY) benchmarks/run_all.py --smoke

@@ -90,7 +90,7 @@ def main():
         # carries ~0.15 s of fixed dispatch+sync overhead per measurement,
         # which at short runs reads as a 4x throughput loss (round-2 finding).
         ("2_pallas_4096sq_f32",
-         HeatConfig(n=256 if s else 4096, ntime=20 if s else 8192,
+         HeatConfig(n=256 if s else 4096, ntime=20 if s else 16384,
                     dtype="float32", backend="pallas")),
         # 3. 16384^2 over a 2-D mesh (mpi+cuda analog, BASELINE 4x4 target)
         ("3_sharded_16384sq_f32_mesh",
@@ -99,7 +99,7 @@ def main():
                     mesh_shape=(4, 2) if (s and ndev >= 8) else None)),
         # 4. 3-D 512^3 7-point stencil
         ("4_pallas_512cube_f32",
-         HeatConfig(n=64 if s else 512, ndim=3, ntime=10 if s else 1600,
+         HeatConfig(n=64 if s else 512, ndim=3, ntime=10 if s else 3200,
                     dtype="float32", backend="pallas", sigma=1 / 6)),
         # 5. bf16 storage + f32 accumulate, 32768^2 (weak-scale flagship,
         #    fortran/input_all.dat: 32768^2 x 25000)
