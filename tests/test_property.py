@@ -1,0 +1,121 @@
+"""Property-based tests (hypothesis): the invariants SURVEY.md SS4 names,
+asserted over RANDOM fields, shapes, and decompositions rather than the
+handful of fixture configs the example-based tests pin.
+
+Example counts are kept small: every example traces+compiles XLA programs,
+and the virtual-device mesh makes each solve a real collective run.
+"""
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")  # not a runtime dependency
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from heat_tpu.backends import solve
+from heat_tpu.backends.serial_np import (step_edges_np, step_ghost_np,
+                                         step_periodic_np)
+from heat_tpu.config import HeatConfig, parse_input, write_input
+
+COMMON = dict(deadline=None, max_examples=10, derandomize=True,
+              print_blob=False)
+
+
+def _random_field(rng, shape):
+    return rng.uniform(1.0, 2.0, shape)
+
+
+@settings(**COMMON)
+@given(st.integers(2, 10), st.integers(0, 6),
+       st.sampled_from(["edges", "ghost", "periodic"]),
+       st.integers(0, 2**31 - 1))
+def test_sharded_matches_serial_on_random_fields(quarter, steps, bc, seed):
+    """Golden invariant on arbitrary data: the decomposed run matches the
+    serial oracle in f64 for every bc, any grid/step count, on a (2,4)
+    mesh (all 8 virtual devices; odd shard widths half the time).
+
+    Tolerance is a few ulps, not zero: fuzzing found that XLA emits FMA
+    for some local shapes (e.g. odd 17-wide shards from n=34) where numpy
+    does not, a 1-ulp/step codegen difference that no summation-order
+    discipline can remove. The fixed-config tests keep their bitwise
+    assertions as regression pins (there XLA's codegen happens to match)."""
+    n = 4 * quarter  # (2,4) mesh always applies; n/4 odd half the time,
+    cfg = HeatConfig(n=n, ntime=steps, dtype="float64", bc=bc,
+                     backend="sharded", mesh_shape=(2, 4))
+    T0 = _random_field(np.random.default_rng(seed), cfg.shape)
+    got = solve(cfg, T0=T0)
+    ref = solve(cfg.with_(backend="serial", mesh_shape=None), T0=T0)
+    np.testing.assert_allclose(got.T, ref.T, rtol=0,
+                               atol=1e-14 * max(steps, 1))
+
+
+@settings(**COMMON)
+@given(st.integers(5, 60), st.integers(5, 300), st.integers(1, 9),
+       st.integers(0, 2**31 - 1))
+def test_pallas_multistep_matches_sequential_random_shapes(m, n, k, seed):
+    """The fused kernel == k sequential steps on arbitrary (non-aligned)
+    shapes — the temporal-blocking dependency-cone argument, fuzzed."""
+    from heat_tpu.ops.pallas_stencil import ftcs_multistep_edges_pallas
+    from heat_tpu.ops.stencil import ftcs_step_edges
+
+    import jax.numpy as jnp
+
+    T = jnp.asarray(_random_field(np.random.default_rng(seed), (m, n)),
+                    jnp.float32)
+    fused = ftcs_multistep_edges_pallas(T, 0.2, k)
+    seq = T
+    for _ in range(k):
+        seq = ftcs_step_edges(seq, 0.2)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(seq),
+                               rtol=0, atol=5e-6)
+
+
+@settings(**COMMON)
+@given(st.integers(4, 64), st.integers(1, 20), st.integers(0, 2**31 - 1))
+def test_periodic_conserves_heat_on_random_fields(n, steps, seed):
+    """No boundary -> exact conservation, for ANY field (not just the
+    shipped ICs)."""
+    T = _random_field(np.random.default_rng(seed), (n, n))
+    out = T.copy()
+    for _ in range(steps):
+        out = step_periodic_np(out, 0.25)
+    assert np.sum(out, dtype=np.float64) == pytest.approx(
+        np.sum(T, dtype=np.float64), rel=1e-12)
+
+
+@settings(**COMMON)
+@given(st.integers(4, 64), st.integers(1, 20), st.integers(0, 2**31 - 1),
+       st.sampled_from(["edges", "ghost"]))
+def test_maximum_principle_on_random_fields(n, steps, seed, bc):
+    """With stable sigma, no interior cell can exceed the initial/boundary
+    extremes — for any starting field."""
+    T = _random_field(np.random.default_rng(seed), (n, n))
+    out = T.copy()
+    for _ in range(steps):
+        out = (step_edges_np(out, 0.25) if bc == "edges"
+               else step_ghost_np(out, 0.25, 1.0))
+    if bc == "edges":  # frozen ring: extremes bounded by the IC alone
+        lo, hi = T.min(), T.max()
+    else:  # ghost ring at bc_value joins the extremes
+        lo, hi = min(T.min(), 1.0), max(T.max(), 1.0)
+    assert out.min() >= lo - 1e-12 and out.max() <= hi + 1e-12
+
+
+@settings(**COMMON)
+@given(st.integers(3, 10**6), st.floats(1e-3, 1.0), st.floats(1e-3, 1.0),
+       st.floats(1e-3, 100.0), st.integers(0, 10**6), st.booleans())
+def test_input_dat_roundtrip(n, sigma, nu, dom_len, ntime, soln):
+    """write_input -> parse_input is the identity on the physics: repr
+    precision must round-trip any config (checkpoint fingerprints depend
+    on it)."""
+    cfg = HeatConfig(n=n, sigma=sigma, nu=nu, dom_len=dom_len, ntime=ntime,
+                     soln=soln)
+    import tempfile
+    from pathlib import Path
+
+    with tempfile.TemporaryDirectory() as d:
+        p = Path(d) / "input.dat"
+        write_input(cfg, p)
+        back = parse_input(p)
+    assert (back.n, back.sigma, back.nu, back.dom_len, back.ntime,
+            back.soln) == (n, sigma, nu, dom_len, ntime, soln)
