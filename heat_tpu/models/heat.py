@@ -37,9 +37,16 @@ class HeatModel:
         """adds + muls of the 2*ndim+1-point update."""
         return 2 * self.ndim + 2 + 2  # neighbor adds, -2nd*c, r*, +c
 
-    def steady_state(self, cfg: HeatConfig) -> np.ndarray:
-        """t→∞ limit: uniform bc_value for both BC families (all heat leaks
-        through the Dirichlet walls)."""
+    def steady_state(self, cfg: HeatConfig, T0=None) -> np.ndarray:
+        """t→∞ limit. Dirichlet families (edges/ghost): uniform bc_value —
+        all heat leaks through the walls. Periodic: no walls, heat is
+        conserved exactly, so the limit is the uniform MEAN of the initial
+        field (required as ``T0``)."""
+        if cfg.bc == "periodic":
+            if T0 is None:
+                raise ValueError(
+                    "periodic steady state is the IC mean — pass T0")
+            return np.full(cfg.shape, np.mean(np.asarray(T0, np.float64)))
         return np.full(cfg.shape, cfg.bc_value)
 
 

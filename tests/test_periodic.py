@@ -150,3 +150,17 @@ def test_cli_periodic(tmp_cwd, capsys):
                  "--dtype", "float32"]) == 0
     out = capsys.readouterr().out
     assert "periodic (torus)" in out
+
+
+def test_periodic_steady_state_is_ic_mean():
+    """t->inf on the torus: conservation forces the uniform IC mean (the
+    Dirichlet families instead drain to bc_value — models/heat.py)."""
+    from heat_tpu.models import get_model
+
+    cfg = BASE.with_(backend="xla", n=16, ntime=4000)
+    T0 = solve(cfg.with_(ntime=0)).T
+    res = solve(cfg)
+    np.testing.assert_allclose(
+        res.T, get_model(cfg).steady_state(cfg, T0), atol=1e-9)
+    with pytest.raises(ValueError, match="IC mean"):
+        get_model(cfg).steady_state(cfg)
