@@ -38,15 +38,37 @@ class HeatModel:
         return 2 * self.ndim + 2 + 2  # neighbor adds, -2nd*c, r*, +c
 
     def steady_state(self, cfg: HeatConfig, T0=None) -> np.ndarray:
-        """t→∞ limit. Dirichlet families (edges/ghost): uniform bc_value —
-        all heat leaks through the walls. Periodic: no walls, heat is
-        conserved exactly, so the limit is the uniform MEAN of the initial
-        field (required as ``T0``)."""
+        """t→∞ limit, per BC family.
+
+        - ``ghost``: uniform bc_value — all heat leaks through the
+          Dirichlet ghost walls.
+        - ``periodic``: no walls, heat conserved exactly — the uniform
+          MEAN of the initial field (required as ``T0``).
+        - ``edges``: the frozen ring keeps its IC values, so the limit is
+          that ring's harmonic extension; this oracle covers the uniform-
+          ring case (limit = the ring constant, requires ``T0``) and
+          refuses the general one honestly.
+        """
         if cfg.bc == "periodic":
             if T0 is None:
                 raise ValueError(
                     "periodic steady state is the IC mean — pass T0")
             return np.full(cfg.shape, np.mean(np.asarray(T0, np.float64)))
+        if cfg.bc == "edges":
+            if T0 is None:
+                raise ValueError(
+                    "edges steady state is set by the frozen IC boundary "
+                    "ring — pass T0")
+            T0 = np.asarray(T0, np.float64)
+            interior = np.zeros(cfg.shape, bool)
+            interior[tuple(slice(1, -1) for _ in range(cfg.ndim))] = True
+            ring = T0[~interior]
+            if np.ptp(ring) > 1e-12:
+                raise NotImplementedError(
+                    "non-uniform frozen ring: the t->inf limit is its "
+                    "harmonic extension, which this constant oracle "
+                    "cannot represent")
+            return np.full(cfg.shape, ring.flat[0])
         return np.full(cfg.shape, cfg.bc_value)
 
 

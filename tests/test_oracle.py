@@ -123,3 +123,23 @@ def test_3d_oracle_fixed_point():
                      bc="ghost", bc_value=2.0, backend="serial")
     res = solve(cfg)
     np.testing.assert_allclose(res.T, 2.0, atol=1e-14)
+
+
+def test_steady_state_edges_follows_ic_ring():
+    """edges-BC t->inf is set by the FROZEN IC boundary ring, not bc_value:
+    a uniform-2.0 IC with bc_value=1.0 (the python_cuda variant shape)
+    relaxes to 2.0 everywhere."""
+    cfg = HeatConfig(n=9, ntime=4000, dtype="float64", ic="uniform",
+                     bc="edges", bc_value=1.0, backend="serial")
+    from heat_tpu.grid import initial_condition
+
+    T0 = initial_condition(cfg)
+    res = solve(cfg)
+    model = get_model(cfg)
+    np.testing.assert_allclose(res.T, model.steady_state(cfg, T0),
+                               atol=1e-6)
+    with pytest.raises(ValueError, match="frozen IC boundary"):
+        model.steady_state(cfg)
+    ramp = np.linspace(0.0, 1.0, 9 * 9).reshape(9, 9)
+    with pytest.raises(NotImplementedError, match="harmonic"):
+        model.steady_state(cfg, ramp)
