@@ -56,6 +56,8 @@ int heat_write_table(const char* path, const double* data, long rows, long cols)
     for (long j = 0; j < cols; ++j) {
       if (j) buf.put_char(' ');
       buf.put_double(row[j]);
+      buf.maybe_flush();  // per value: the slack must bound ONE value,
+                          // not a whole row of caller-chosen width
     }
     buf.put_char('\n');
     buf.maybe_flush();

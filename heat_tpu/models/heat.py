@@ -59,10 +59,9 @@ class HeatModel:
                 raise ValueError(
                     "edges steady state is set by the frozen IC boundary "
                     "ring — pass T0")
-            T0 = np.asarray(T0, np.float64)
-            interior = np.zeros(cfg.shape, bool)
-            interior[tuple(slice(1, -1) for _ in range(cfg.ndim))] = True
-            ring = T0[~interior]
+            from ..grid import boundary_mask
+
+            ring = np.asarray(T0, np.float64)[boundary_mask(cfg)]
             if np.ptp(ring) > 1e-12:
                 raise NotImplementedError(
                     "non-uniform frozen ring: the t->inf limit is its "

@@ -131,8 +131,6 @@ def test_steady_state_edges_follows_ic_ring():
     relaxes to 2.0 everywhere."""
     cfg = HeatConfig(n=9, ntime=4000, dtype="float64", ic="uniform",
                      bc="edges", bc_value=1.0, backend="serial")
-    from heat_tpu.grid import initial_condition
-
     T0 = initial_condition(cfg)
     res = solve(cfg)
     model = get_model(cfg)
