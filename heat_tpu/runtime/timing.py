@@ -18,10 +18,6 @@ import time
 from typing import Any
 
 
-def now() -> float:
-    return time.perf_counter()
-
-
 def sync(x: Any) -> Any:
     """Block until device work producing x is done (== cudaDeviceSynchronize
     + MPI_BARRIER before reading the clock, fortran/mpi+cuda/heat.F90:262-264).
