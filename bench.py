@@ -60,11 +60,12 @@ def _env_int(name: str, default: int) -> int:
 # tunnel), then ~1 s/rep of actual compute — 900 s is a hang detector, not
 # a tight budget
 ATTEMPT_TIMEOUT_S = _env_int("HEAT_BENCH_TIMEOUT_S", 900)
-ATTEMPTS = _env_int("HEAT_BENCH_ATTEMPTS", 5)
-# round-2 observation: tunnel outages can run an hour+ (backend init hangs
-# at interpreter start) — back off far enough that the last attempts land
-# after a mid-length outage clears
-BACKOFF_S = (30, 90, 240, 600)
+ATTEMPTS = _env_int("HEAT_BENCH_ATTEMPTS", 7)
+# round-2 observation: a mid-round tunnel outage ran 2.5+ hours (remote
+# compile endpoint down, then device init hanging at interpreter start) —
+# the attempt ladder spans ~3.5 h so the last attempts land after an
+# outage of that scale clears
+BACKOFF_S = (30, 90, 240, 600, 1200, 1800)
 # failure signatures worth retrying (transient tunnel/backend states); any
 # other worker crash is deterministic — fail fast with the error line.
 # (Timeouts always retry; this list is only consulted for nonzero exits.)
