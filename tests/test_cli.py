@@ -80,10 +80,13 @@ def test_soln_flag_gating(tmp_cwd):
 
 def test_viz(input_dat):
     pytest.importorskip("matplotlib")
-    main(["run", "--backend", "serial", "--dtype", "float64"])
+    main(["run", "--backend", "serial", "--dtype", "float64", "--write-int"])
     rc = main(["viz", "soln.dat", "--save", "sol.png"])
     assert rc == 0
     assert (input_dat / "sol.png").stat().st_size > 0
+    # the reference's init.py workflow: render the pre-solve dump too
+    assert main(["viz", "int.dat", "--save", "init.png"]) == 0
+    assert (input_dat / "init.png").stat().st_size > 0
 
 
 def test_info(capsys):
