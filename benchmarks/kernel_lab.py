@@ -144,6 +144,163 @@ def pallas_3d_tiled(Tp, r, ksteps, R, M, k, km, logical,
 
 
 # ---------------------------------------------------------------------------
+# candidate: fully-ROLLED 3D body — the shipped 3D kernel shrink-slices the
+# (row, mid) axes per mini-step; mid-axis slices are sublane-misaligned and
+# are the remaining codegen suspect (the analogous 2D switch to rolls took
+# bf16 32k from 58% to 90% of roofline). All three axes via pltpu.roll +
+# masked multiplicative update; wrap corruption travels one cell per step,
+# confined to the k/km margins (lane wrap lands in frozen ring / discard
+# margin, same as the shipped kernel's lane rotates).
+# ---------------------------------------------------------------------------
+
+
+def make_3d_rolled(r, R, M, k, km, n_pad, ksteps):
+    rows = R + 2 * k
+    mids = M + 2 * km
+
+    def kernel(bounds_ref, c00, c01, c02, c10, c11, c12, c20, c21, c22,
+               out_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        store_dt = out_ref.dtype
+        acc_dt = jnp.float32
+        top = jnp.concatenate([c00[:], c01[:], c02[:]], axis=1)
+        mid = jnp.concatenate([c10[:], c11[:], c12[:]], axis=1)
+        bot = jnp.concatenate([c20[:], c21[:], c22[:]], axis=1)
+        band = jnp.concatenate([top, mid, bot], axis=0).astype(acc_dt)
+
+        bshape = (rows, mids, n_pad)
+        grow = i * R - k + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
+        gmid = j * M - km + jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
+        gcol = jax.lax.broadcasted_iota(jnp.int32, bshape, 2)
+        frozen = (
+            (grow <= bounds_ref[0, 0]) | (grow >= bounds_ref[0, 1])
+            | (gmid <= bounds_ref[0, 2]) | (gmid >= bounds_ref[0, 3])
+            | (gcol <= bounds_ref[0, 4]) | (gcol >= bounds_ref[0, 5])
+        )
+        maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
+
+        for _ in range(ksteps):
+            up = pltpu.roll(band, 1, 0)
+            dn = pltpu.roll(band, rows - 1, 0)
+            no = pltpu.roll(band, 1, 1)
+            so = pltpu.roll(band, mids - 1, 1)
+            lf = pltpu.roll(band, 1, 2)
+            rt = pltpu.roll(band, n_pad - 1, 2)
+            band = band + maskr * (up + dn + no + so + lf + rt - 6.0 * band)
+        out_ref[:] = band[k: k + R, km: km + M, :].astype(store_dt)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("r", "ksteps", "R", "M", "k", "km",
+                                    "logical"))
+def pallas_3d_rolled(Tp, r, ksteps, R, M, k, km, logical, bounds=None):
+    m_pad, mid_pad, n_pad = Tp.shape
+    m, mid, n = logical
+    assert m_pad % R == 0 and mid_pad % M == 0
+    assert R % k == 0 and M % km == 0 and ksteps <= min(k, km)
+    if bounds is None:
+        bounds = jnp.asarray([[0, m - 1, 0, mid - 1, 0, n - 1]], jnp.int32)
+    bounds = bounds.reshape(1, 6).astype(jnp.int32)
+    gr, gm = m_pad // R, mid_pad // M
+    rr, rm = R // k, M // km
+    nrb, nmb = m_pad // k, mid_pad // km
+    smem = pl.BlockSpec((1, 6), lambda i, j: (0, 0), memory_space=pltpu.SMEM)
+
+    def bs(shape, imap):
+        return pl.BlockSpec(shape, imap, memory_space=pltpu.VMEM)
+
+    def rcl(i):
+        return jnp.clip(i, 0, nrb - 1)
+
+    def mcl(j):
+        return jnp.clip(j, 0, nmb - 1)
+
+    in_specs = [
+        smem,
+        bs((k, km, n_pad), lambda i, j: (rcl(i * rr - 1), mcl(j * rm - 1), 0)),
+        bs((k, M, n_pad), lambda i, j: (rcl(i * rr - 1), j, 0)),
+        bs((k, km, n_pad), lambda i, j: (rcl(i * rr - 1), mcl((j + 1) * rm), 0)),
+        bs((R, km, n_pad), lambda i, j: (i, mcl(j * rm - 1), 0)),
+        bs((R, M, n_pad), lambda i, j: (i, j, 0)),
+        bs((R, km, n_pad), lambda i, j: (i, mcl((j + 1) * rm), 0)),
+        bs((k, km, n_pad), lambda i, j: (rcl((i + 1) * rr), mcl(j * rm - 1), 0)),
+        bs((k, M, n_pad), lambda i, j: (rcl((i + 1) * rr), j, 0)),
+        bs((k, km, n_pad), lambda i, j: (rcl((i + 1) * rr), mcl((j + 1) * rm), 0)),
+    ]
+    return pl.pallas_call(
+        make_3d_rolled(float(r), R, M, k, km, n_pad, ksteps),
+        out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
+        grid=(gr, gm),
+        in_specs=in_specs,
+        out_specs=bs((R, M, n_pad), lambda i, j: (i, j, 0)),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=VMEM_LIMIT),
+        interpret=jax.default_backend() != "tpu",
+    )(bounds, *([Tp] * 9))
+
+
+def check_3d_rolled():
+    rng = np.random.default_rng(7)
+    m, mid, n = 40, 24, 300
+    T = rng.uniform(1, 2, (m, mid, n)).astype(np.float32)
+    r = 0.15
+    k = km = 4
+    R, M = 8, 8
+    m_pad = _round_up(m, R)
+    mid_pad = _round_up(mid, M)
+    n_pad = _round_up(n, 128)
+    Tp = jnp.pad(jnp.asarray(T), ((0, m_pad - m), (0, mid_pad - mid),
+                                  (0, n_pad - n)))
+    for ks in (1, 3, 4):
+        out = pallas_3d_rolled(Tp, r=r, ksteps=ks, R=R, M=M, k=k, km=km,
+                               logical=(m, mid, n))[:m, :mid, :n]
+        ref = ref_steps(jnp.asarray(T), r, ks)
+        err = float(jnp.abs(out - ref).max())
+        print(f"3d rolled ksteps={ks}: max err {err:.2e}")
+        assert err < 2e-6, err
+
+
+def bench_3d_rolled(configs, n3=512, steps=240):
+    from heat_tpu.runtime.timing import sync
+
+    r = 0.15
+    made = {}
+    for R, M, k, km in configs:
+        m_pad = _round_up(n3, R)
+        mid_pad = _round_up(n3, M)
+        shape = (m_pad, mid_pad, n3)
+        if shape not in made:
+            made[shape] = jax.jit(
+                lambda shape=shape: jax.random.uniform(
+                    jax.random.PRNGKey(0), shape, jnp.float32, 1.0, 2.0))()
+            sync(made[shape])
+        dev = made[shape]
+
+        @jax.jit
+        def run(Tp, R=R, M=M, k=k, km=km):
+            def body(i, t):
+                return pallas_3d_rolled(t, r=r, ksteps=min(k, km), R=R, M=M,
+                                        k=k, km=km, logical=(n3, n3, n3))
+            return jax.lax.fori_loop(0, steps // min(k, km), body, Tp)
+
+        try:
+            t0 = time.perf_counter()
+            c = run.lower(dev).compile()
+            compile_s = time.perf_counter() - t0
+            nsteps = (steps // min(k, km)) * min(k, km)
+            pts, pts_raw = measure_rate(c, dev, n3 ** 3 * nsteps)
+            print(f"rolled R={R:4d} M={M:4d} k={k} km={km}: "
+                  f"{pts:.3e} pts/s  ({pts / 1.024e11 * 100:.0f}% roofline; "
+                  f"raw {pts_raw / 1.024e11 * 100:.0f}%)"
+                  f"  [compile {compile_s:.0f}s]", flush=True)
+        except Exception as e:
+            print(f"rolled R={R:4d} M={M:4d} k={k} km={km}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+
+
+# ---------------------------------------------------------------------------
 # candidate: thin-band 2D kernel variants — A/B against the shipped one
 #   shrink: row neighbors via shrinking slices (sublane-shifted reads)
 #           instead of sublane rolls; lanes still rolled
@@ -767,6 +924,11 @@ if __name__ == "__main__":
     elif exp == "bench2d_rolled":
         cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
         bench_2d_rolled(cfgs or [(256, 4096, 16, 128)])
+    elif exp == "check3d_rolled":
+        check_3d_rolled()
+    elif exp == "bench3d_rolled":
+        cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
+        bench_3d_rolled(cfgs or [(64, 64, 8, 8)])
     elif exp == "bench2d_rolled_f32":
         cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
         bench_2d_rolled(cfgs or [(256, 4096, 16, 128)], dtype="float32")
