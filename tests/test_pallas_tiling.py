@@ -120,3 +120,21 @@ def test_plan_3d_small_shapes():
                                                    "float32", 2)
     assert m_pad % R == 0 and mid_pad % M == 0
     assert k <= 2
+
+
+def test_plan_pins_match_measured_optima():
+    """The plans these constants produce were measured on-chip (round 2);
+    pin them so cost-model tweaks that would silently degrade a measured
+    optimum fail here and force a re-measure:
+    - bf16 32768^2 col-tiled 512x4096 fuse 16 -> 1.89e11 pts/s (92% of
+      the one-pass roofline; 256 rows measured 82%, 1024 rows compiles
+      >12 min)
+    - 512^3 (64,64,k=8) -> 1.19e11 (117%; the max()-model pick (48,96,2)
+      measured 68%)
+    - 4096^2 stays thin-band (155-158% measured)
+    """
+    assert ps._plan_2d((32768, 32768), "bfloat16", 16) == (
+        "coltiled", 512, 4096, 16, 128, 16)
+    assert ps._plan_3d((512, 512, 512), "float32", 8) == (
+        (512, 512, 512), 64, 64, 8)
+    assert ps._plan_2d((4096, 4096), "float32", 16) == ("thin", 16)
