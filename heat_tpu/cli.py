@@ -92,6 +92,8 @@ def build_parser() -> argparse.ArgumentParser:
     viz = sub.add_parser("viz", help="render a .dat file as a 3D surface")
     viz.add_argument("datfile")
     viz.add_argument("--save", default="sol.png")
+    viz.add_argument("--ndim", type=int, choices=[2, 3], default=2,
+                     help="3: render the mid-plane slice of an x-y-z-T file")
 
     info = sub.add_parser("info", help="show devices / native-lib status")  # noqa: F841
 
@@ -461,7 +463,7 @@ def cmd_launch(args) -> int:
 def cmd_viz(args) -> int:
     from .viz import render_dat
 
-    out = render_dat(args.datfile, args.save)
+    out = render_dat(args.datfile, args.save, ndim=args.ndim)
     print(f"wrote {out}")
     return 0
 

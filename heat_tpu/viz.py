@@ -22,16 +22,26 @@ from .io import read_dat
 
 
 def render_dat(path, save="sol.png", ndim: int = 2, zlim=(1.0, 2.5)):
+    """Render a .dat dump as the reference-style 3-D surface.
+
+    2-D files render directly (the reference's out.py presentation). For
+    the 3-D extension's ``x y z T`` quadruplet files, the mid-plane
+    z-slice is rendered — the reference has no 3-D analog to imitate.
+    """
     import matplotlib
 
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
     from matplotlib import cm
 
-    if ndim != 2:
-        raise NotImplementedError("surface rendering is 2-D only (like the reference)")
-    axes, T = read_dat(path, ndim=2)
-    x, y = axes
+    if ndim == 3:
+        (x, y, _z), T3 = read_dat(path, ndim=3)
+        T = T3[:, :, T3.shape[2] // 2]
+    elif ndim == 2:
+        axes, T = read_dat(path, ndim=2)
+        x, y = axes
+    else:
+        raise ValueError(f"render_dat supports ndim 2 or 3, got {ndim}")
     X, Y = np.meshgrid(x, y, indexing="ij")
     fig = plt.figure(figsize=(8, 6))
     ax = fig.add_subplot(projection="3d")

@@ -173,3 +173,14 @@ def test_cli_crash_resume_flow(tmp_cwd, capsys):
     clean = _solve(HeatConfig(n=16, ntime=6, dtype="float64",
                               backend="serial"))
     np.testing.assert_allclose(T, clean.T, rtol=0, atol=1e-12)
+
+
+def test_viz_3d_midplane(tmp_cwd):
+    """The 3-D extension's quadruplet files render as the mid-plane slice
+    (the reference has no 3-D viz to imitate)."""
+    pytest.importorskip("matplotlib")
+    (tmp_cwd / "input.dat").write_text("12 0.15 0.05 2.0 2 1\n")
+    assert main(["run", "--backend", "serial", "--dtype", "float64",
+                 "--ndim", "3"]) == 0
+    assert main(["viz", "soln.dat", "--ndim", "3", "--save", "s3.png"]) == 0
+    assert (tmp_cwd / "s3.png").stat().st_size > 0
