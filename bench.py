@@ -12,7 +12,7 @@ one-pass implementation on this chip. The measured config mirrors the
 reference's single-GPU benchmark shape (python/cuda/cuda.py:31-33: 4096^2,
 10k steps; we run 8192 steps, identical steady-state per-step cost).
 
-Capture robustness (round 2): the tunneled TPU backend is transiently
+Capture robustness (round 3): the tunneled TPU backend is transiently
 unavailable — round 1's driver capture died with rc=1 on
 "Unable to initialize backend 'axon'", and a bare device probe can HANG
 rather than raise. So the measurement runs in a *subprocess* under a hard
@@ -21,6 +21,20 @@ backoff, and on final failure it still prints exactly one parseable JSON
 line carrying an "error" field — the bench never again exits without a
 machine-readable verdict. Run with ``--worker`` to execute the measurement
 inline (no supervision).
+
+Round 2's failure mode was the *opposite* overshoot: the retry ladder
+spanned ~3.5 h (designed for tunnel outages) and the external capturer's
+own deadline killed the supervisor mid-ladder (rc=124 = GNU timeout's
+SIGTERM), voiding the one-line guarantee from outside. Two defenses now:
+
+1. **Total wall budget** (``HEAT_BENCH_TOTAL_BUDGET_S``, default 540 s):
+   attempts + backoff are scheduled against a single deadline; on budget
+   exhaustion the supervisor prints the error-JSON line and exits while
+   still alive. The budget must sit inside any plausible external watchdog
+   (round 2's killed somewhere past 900 s).
+2. **Signal backstop**: SIGTERM/SIGINT/SIGHUP print the error line before
+   dying, so even a deadline-kill from outside leaves a parseable verdict
+   (GNU timeout sends SIGTERM; only ``-k`` escalates to SIGKILL).
 
 Timing uses a scalar device->host fetch as the completion fence:
 ``block_until_ready`` does not block on queued work on the tunneled
@@ -34,6 +48,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -57,15 +72,17 @@ def _env_int(name: str, default: int) -> int:
 
 # per-attempt wall clock: H2D of the 64 MiB field over the ~8 MB/s tunnel
 # (~10 s), first compile (tens of s), lazy runtime init (tens of s on a cold
-# tunnel), then ~1 s/rep of actual compute — 900 s is a hang detector, not
+# tunnel), then ~1 s/rep of actual compute — 420 s is a hang detector, not
 # a tight budget
-ATTEMPT_TIMEOUT_S = _env_int("HEAT_BENCH_TIMEOUT_S", 900)
-ATTEMPTS = _env_int("HEAT_BENCH_ATTEMPTS", 7)
-# round-2 observation: a mid-round tunnel outage ran 2.5+ hours (remote
-# compile endpoint down, then device init hanging at interpreter start) —
-# the attempt ladder spans ~3.5 h so the last attempts land after an
-# outage of that scale clears
-BACKOFF_S = (30, 90, 240, 600, 1200, 1800)
+ATTEMPT_TIMEOUT_S = _env_int("HEAT_BENCH_TIMEOUT_S", 420)
+ATTEMPTS = _env_int("HEAT_BENCH_ATTEMPTS", 4)
+# everything — attempts AND backoff — is scheduled against this one
+# deadline; it must sit inside any external capturer's kill window
+# (round 2's was >900 s; round 2's 3.5 h ladder was killed from outside)
+TOTAL_BUDGET_S = _env_int("HEAT_BENCH_TOTAL_BUDGET_S", 540)
+# an attempt with less runway than this can't finish even cache-warm
+_MIN_ATTEMPT_S = 45
+BACKOFF_S = (15, 30, 60)
 # failure signatures worth retrying (transient tunnel/backend states); any
 # other worker crash is deterministic — fail fast with the error line.
 # (Timeouts always retry; this list is only consulted for nonzero exits.)
@@ -76,9 +93,15 @@ def measure() -> None:
     """The actual benchmark (runs in the supervised subprocess); the
     measurement itself lives in heat_tpu.benchmark — ONE definition shared
     with the `heat-tpu bench` CLI subcommand."""
-    from heat_tpu.benchmark import headline_measure
+    from heat_tpu import benchmark
 
-    record = headline_measure(n=N, steps=STEPS, repeats=REPEATS)
+    # N/STEPS/REPEATS are duplicated here so the supervisor never imports
+    # heat_tpu; the metric-name assert below only catches N drift, so pin
+    # STEPS/REPEATS explicitly or the measurement silently changes under
+    # the same metric string
+    assert (STEPS, REPEATS) == (benchmark.STEPS, benchmark.REPEATS), (
+        (STEPS, REPEATS), (benchmark.STEPS, benchmark.REPEATS))
+    record = benchmark.headline_measure(n=N, steps=STEPS, repeats=REPEATS)
     assert record["metric"] == METRIC, (record["metric"], METRIC)
     # flush: the pipe is block-buffered and JAX atexit teardown can hang
     # before interpreter stdio flush — the supervisor's salvage path needs
@@ -102,17 +125,76 @@ def _parse_result_line(stdout: str):
     return None
 
 
+def _error_line(err: str) -> str:
+    return json.dumps({
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "points/s",
+        "vs_baseline": 0.0,
+        "error": err,
+    })
+
+
+def _run_worker(holder, timeout: float) -> subprocess.CompletedProcess:
+    """``subprocess.run`` equivalent that parks the live Popen in
+    ``holder[0]`` so the signal backstop can reap it — an orphaned worker
+    would keep the single tunneled chip busy (and block on its readerless
+    stdout pipe) for up to ATTEMPT_TIMEOUT_S after the supervisor died."""
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    holder[0] = p
+    try:
+        out, err = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        out, err = p.communicate()
+        raise subprocess.TimeoutExpired(p.args, timeout, output=out,
+                                        stderr=err)
+    finally:
+        holder[0] = None
+    return subprocess.CompletedProcess(p.args, p.returncode, out, err)
+
+
 def supervise() -> int:
-    """Run ``measure`` in a subprocess with timeout + retry; always print
-    one parseable JSON line."""
+    """Run ``measure`` in a subprocess under a total wall budget; always
+    print one parseable JSON line — even when killed by an external
+    deadline (SIGTERM backstop)."""
+    t0 = time.monotonic()
+    deadline = t0 + TOTAL_BUDGET_S
     last_err = "no attempt ran"
+    worker = [None]  # the in-flight Popen, visible to the signal handler
+
+    def _die(signum, frame):  # noqa: ARG001 — signal handler signature
+        # an external watchdog beat our budget: reap the worker (it would
+        # otherwise keep holding the chip for up to ATTEMPT_TIMEOUT_S),
+        # emit the verdict line, then exit without interpreter teardown
+        # (JAX atexit can hang on the tunnel — that's how round 2 died)
+        if worker[0] is not None:
+            try:
+                worker[0].kill()
+            except OSError:
+                pass
+        print(_error_line(
+            f"killed by signal {signum} at "
+            f"{time.monotonic() - t0:.0f}s; last: {last_err}"), flush=True)
+        os._exit(1)
+
+    for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+        signal.signal(sig, _die)
+
     for attempt in range(1, ATTEMPTS + 1):
+        remaining = deadline - time.monotonic()
+        eff_timeout = min(ATTEMPT_TIMEOUT_S,
+                          remaining - min(5.0, 0.1 * remaining))
+        if eff_timeout < _MIN_ATTEMPT_S:
+            last_err += (f" | budget exhausted before attempt {attempt} "
+                         f"({TOTAL_BUDGET_S}s total)")
+            break
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--worker"],
-                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT_S,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
+            proc = _run_worker(worker, timeout=eff_timeout)
         except subprocess.TimeoutExpired as e:
             # the worker may have finished the measurement and printed its
             # result, then hung in runtime teardown over the flaky tunnel —
@@ -125,7 +207,7 @@ def supervise() -> int:
                 print(json.dumps(result))
                 return 0
             last_err = (f"attempt {attempt}: no result within "
-                        f"{ATTEMPT_TIMEOUT_S}s (hung backend init?)")
+                        f"{e.timeout:.0f}s (hung backend init?)")
         except OSError as e:  # spawn failure (ENOMEM etc.)
             last_err = f"attempt {attempt}: failed to spawn worker: {e}"
         else:
@@ -149,16 +231,15 @@ def supervise() -> int:
         print(f"bench attempt {attempt}/{ATTEMPTS} failed: {last_err}",
               file=sys.stderr)
         if attempt < ATTEMPTS:
-            time.sleep(BACKOFF_S[min(attempt - 1, len(BACKOFF_S) - 1)])
+            backoff = BACKOFF_S[min(attempt - 1, len(BACKOFF_S) - 1)]
+            # never sleep past the point where another attempt fits
+            runway = deadline - time.monotonic() - _MIN_ATTEMPT_S
+            if runway <= 0:
+                continue  # loop header will record budget exhaustion
+            time.sleep(min(backoff, runway))
     # final failure: still emit one machine-readable line (round 1's capture
     # produced rc=1 with nothing parseable — never again)
-    print(json.dumps({
-        "metric": METRIC,
-        "value": 0.0,
-        "unit": "points/s",
-        "vs_baseline": 0.0,
-        "error": last_err,
-    }))
+    print(_error_line(last_err), flush=True)
     return 1
 
 
@@ -169,10 +250,7 @@ def main() -> int:
     try:
         return supervise()
     except Exception as e:  # the one-parseable-line contract survives bugs
-        print(json.dumps({
-            "metric": METRIC, "value": 0.0, "unit": "points/s",
-            "vs_baseline": 0.0, "error": f"supervisor crashed: {e!r}",
-        }))
+        print(_error_line(f"supervisor crashed: {e!r}"), flush=True)
         return 1
 
 
