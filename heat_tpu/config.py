@@ -61,6 +61,11 @@ class HeatConfig:
                                 # TPU, xla elsewhere), or forced
     mesh_shape: Optional[Tuple[int, ...]] = None  # device mesh; None = auto
     heartbeat_every: int = 0    # print "time_it: i" every k steps (0 = off)
+    write_int: bool = False     # dump the initial field to int.dat pre-solve
+                                # (the single-process reference variants do
+                                # this unconditionally,
+                                # fortran/serial/heat.f90:50-55 — their
+                                # presets below turn it on)
     report_sum: bool = False    # global temperature sum (the reference's
                                 # commented-out MPI_Reduce, mpi+cuda/heat.F90:266-273)
     checkpoint_every: int = 0   # periodic snapshot interval (0 = off)
@@ -170,27 +175,41 @@ def write_input(cfg: HeatConfig, path: str | Path) -> None:
 # the reference can select their variant by name (see SURVEY.md quirk #1: the
 # IC/BC families differ silently between variants).
 VARIANTS = {
+    # Default-behavior parity (not just IC/BC): every Fortran single-process
+    # variant writes int.dat unconditionally before solving
+    # (fortran/serial/heat.f90:50-55, cuda_kernel/heat.F90:107-112,
+    # cuda_cuf/heat.F90:94) and prints "time_it:" every step (serial :62,
+    # cuda_kernel :31, cuda_cuf :29); the MPI variants heartbeat
+    # master-gated without an int.dat (mpi+cuda/heat.F90:207,
+    # hip/heat.F90:241); the python variants do neither. Opt out with
+    # ``--no-write-int`` / ``--heartbeat-every 0``.
+    #
     # fortran/serial/heat.f90: hat IC on [0.5,1.5]^2, frozen boundary cells
-    "serial": dict(ic="hat", bc="edges", backend="serial", dtype="float64"),
+    "serial": dict(ic="hat", bc="edges", backend="serial", dtype="float64",
+                   write_int=True, heartbeat_every=1),
     # fortran/cuda_kernel/heat.F90:99: hat with y in [0.5,1.0].
     # NOTE: f64 bit-parity implies the XLA step — the hand-written Pallas
     # kernel has no f64 (no f64 on the TPU VPU), so the pallas backend
     # transparently falls back. Run with --dtype float32 to exercise the
     # hand-written kernel itself (contract-tested in tests/test_config.py).
-    "cuda_kernel": dict(ic="hat_half", bc="edges", backend="pallas", dtype="float64"),
-    "cuda_managed": dict(ic="hat_half", bc="edges", backend="pallas", dtype="float64"),
+    "cuda_kernel": dict(ic="hat_half", bc="edges", backend="pallas", dtype="float64",
+                        write_int=True, heartbeat_every=1),
+    "cuda_managed": dict(ic="hat_half", bc="edges", backend="pallas", dtype="float64",
+                         write_int=True, heartbeat_every=1),
     # fortran/cuda_cuf/heat.F90:86: same IC family, compiler-generated kernels
-    "cuda_cuf": dict(ic="hat_half", bc="edges", backend="xla", dtype="float64"),
+    "cuda_cuf": dict(ic="hat_half", bc="edges", backend="xla", dtype="float64",
+                     write_int=True, heartbeat_every=1),
     # fortran/mpi+cuda/heat.F90:243-251: uniform 2.0, Dirichlet-by-ghost walls
     "mpi_cuda": dict(ic="uniform", bc="ghost", backend="sharded", comm="direct",
-                     dtype="float64"),
+                     dtype="float64", heartbeat_every=1),
     # same but the staged (NO_AWARE) communication path, makefile:3-4
     "mpi_cuda_na": dict(ic="uniform", bc="ghost", backend="sharded", comm="staged",
-                        dtype="float64"),
+                        dtype="float64", heartbeat_every=1),
     # fortran/hip/heat.F90: always-staged swap
     "hip": dict(ic="uniform", bc="ghost", backend="sharded", comm="staged",
-                dtype="float64"),
+                dtype="float64", heartbeat_every=1),
     # python/serial/heat.py: hat on [0.5,1.0]^2 w/ per-step edge reassert == edges BC
+    # (no int.dat, no time_it heartbeat — the python variants print/plot only)
     "python_serial": dict(ic="hat_small", bc="edges", backend="serial", dtype="float64"),
     # python/cuda/cuda.py: throughput benchmark (IC no-op bug not replicated;
     # uniform field benchmarks identically)
