@@ -71,6 +71,10 @@ _BAND_BUDGET_BYTES = 12 * 1024 * 1024
 # per-pass fusion cap: halo rows (and compile-time unroll) stay bounded;
 # measured throughput is flat past 16
 _KMAX_2D = 32
+# 3D per-pass fusion cap: the (row,mid)-tiled kernel's band pays a 2k
+# margin on BOTH non-lane axes, so deep unrolls blow the VMEM band budget
+# much earlier than in 2D — the _plan_3d search never considers k > 8
+_KMAX_3D = 8
 
 
 def _sublane(dtype) -> int:
@@ -297,7 +301,7 @@ def _plan_3d(shape, dtype_str, ksteps: int):
     n_pad = _round_up(max(n, 128), 128)
     item = jnp.dtype(dtype_str).itemsize
     best = None
-    for k in range(1, min(max(ksteps, 1), 8) + 1):
+    for k in range(1, min(max(ksteps, 1), _KMAX_3D) + 1):
         km = _round_up(k, sub)
         for R in (8, 16, 32, 48, 64, 96, 128):
             if R % k:
@@ -718,10 +722,18 @@ def ftcs_multistep_periodic_pallas(T: jax.Array, r: float, ksteps: int) -> jax.A
     exchange. Chunked so pad/crop overhead stays ~2 passes per _KMAX_2D
     steps.
     """
+    if ksteps <= 0:
+        return T
     nd = T.ndim
     cap = periodic_pad_width(T.shape, ksteps)
-    # gate on the wrap-padded shape — the shape the kernel actually sees
-    if not pallas_available(tuple(s + 2 * cap for s in T.shape), T.dtype):
+    # gate on EVERY wrap-padded shape the chunk loop will build — the full
+    # chunks (cap) and the remainder chunk pad differently, and for 3D a
+    # plan for the cap-padded shape does not guarantee one for the smaller
+    # remainder shape (_multistep asserts rather than falls back)
+    last = ksteps % cap or cap
+    widths = {min(cap, ksteps), last}
+    if not all(pallas_available(tuple(s + 2 * w for s in T.shape), T.dtype)
+               for w in widths):
         out = T
         for _ in range(ksteps):
             out = ftcs_step_periodic(out, r)

@@ -28,10 +28,22 @@ def maybe_profile(trace_dir: Optional[str]):
 
 
 def check_finite(T, step: int, label: str = "field") -> None:
-    """Raise with step context if the field has NaN/Inf (device or host array)."""
+    """Raise with step context if the field has NaN/Inf (device or host array).
+
+    Device arrays reduce ON DEVICE (``jnp.isfinite(...).all()``): in a
+    multi-host job the global field spans other processes and
+    ``np.asarray`` on it raises RuntimeError — the reduction's replicated
+    scalar is always fetchable, and a scalar fetch is tunnel-cheap.
+    """
     import numpy as np
 
-    ok = bool(np.isfinite(np.asarray(T).astype(np.float32)).all())
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(T, jax.Array) and not isinstance(T, jax.core.Tracer):
+        ok = bool(jnp.isfinite(T).all())
+    else:
+        ok = bool(np.isfinite(np.asarray(T).astype(np.float32)).all())
     if not ok:
         raise FloatingPointError(
             f"non-finite values in {label} at step {step} — check the CFL "

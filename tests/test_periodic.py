@@ -164,3 +164,31 @@ def test_periodic_steady_state_is_ic_mean():
         res.T, get_model(cfg).steady_state(cfg, T0), atol=1e-9)
     with pytest.raises(ValueError, match="IC mean"):
         get_model(cfg).steady_state(cfg)
+
+
+def test_periodic_remainder_chunk_gates_per_shape(monkeypatch):
+    """ADVICE r2 #4: the periodic multistep's remainder chunk wrap-pads by
+    k < cap; if the kernel has no plan for that smaller shape the dispatch
+    must fall back to XLA rather than hit _multistep's assert."""
+    import jax.numpy as jnp
+
+    import heat_tpu.ops.pallas_stencil as ps
+
+    n = 24
+    shape = (n, n, n)
+    rng = np.random.RandomState(0)
+    T = jnp.asarray(rng.rand(*shape), jnp.float32)
+    ksteps = 20
+    cap = ps.periodic_pad_width(shape, ksteps)
+    rem = ksteps % cap
+    assert 0 < rem < cap  # the test needs a genuine remainder chunk
+    blocked = tuple(s + 2 * rem for s in shape)
+    real = ps.pallas_available
+    monkeypatch.setattr(
+        ps, "pallas_available",
+        lambda shp, dt: tuple(shp) != blocked and real(shp, dt))
+    out = ps.ftcs_multistep_periodic_pallas(T, 0.1, ksteps)
+    ref = T
+    for _ in range(ksteps):
+        ref = ps.ftcs_step_periodic(ref, 0.1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
