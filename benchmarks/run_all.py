@@ -30,15 +30,21 @@ def bench_one(name, cfg, repeat=1):
     # fetch=False: ICs build on device and the final field never crosses the
     # wire — only timings come back (GiB-scale fetches cost minutes tunneled).
     # warm_exec: one throwaway execution so lazy first-run runtime init
-    # doesn't pollute solve_s.
-    res = solve(cfg, fetch=False, warm_exec=True)
+    # doesn't pollute solve_s. two_point_repeats: the overhead-corrected
+    # headline protocol (timing.two_point_rate) measured alongside, so the
+    # official table and bench.py's metric share one protocol; the raw
+    # single-call number stays as the conservative figure (device backends
+    # only — the numpy oracle has no dispatch overhead to cancel and
+    # reports null there).
+    res = solve(cfg, fetch=False, warm_exec=True, two_point_repeats=2)
     best = res.timing
     for _ in range(repeat - 1):
-        r = solve(cfg, fetch=False, warm_exec=True)
+        r = solve(cfg, fetch=False, warm_exec=True, two_point_repeats=2)
         if r.timing.solve_s < best.solve_s:
             best = r.timing
     itemsize = {"float64": 8, "float32": 4, "bfloat16": 2}[cfg.dtype]
     roofline = HBM_BYTES_PER_S / (2 * itemsize)
+    tp = best.points_per_s_two_point
     row = {
         "name": name,
         "measured_ts": time.time(),  # per-row: partial --only re-measures
@@ -49,13 +55,17 @@ def bench_one(name, cfg, repeat=1):
         "solve_s": best.solve_s,
         "per_step_s": best.per_step_s,
         "points_per_s": best.points_per_s,
+        "points_per_s_two_point": tp,
         "roofline_frac": best.points_per_s / roofline,
+        "roofline_frac_two_point": tp / roofline if tp else None,
         "devices": len(jax.devices()),
         "platform": jax.default_backend(),
     }
+    tp_note = (f"  two-point {tp:.3e} ({100 * tp / roofline:.1f}%)"
+               if tp else "")
     print(f"{name:40s} {row['points_per_s']:.3e} pts/s  "
           f"({100 * row['roofline_frac']:.1f}% of HBM roofline)  "
-          f"per-step {row['per_step_s'] * 1e6:.1f} us")
+          f"per-step {row['per_step_s'] * 1e6:.1f} us" + tp_note)
     return row
 
 

@@ -22,7 +22,7 @@ import numpy as np
 from ..config import HeatConfig
 from ..runtime import checkpoint, debug
 from ..runtime.logging import master_print
-from ..runtime.timing import Timing, sync
+from ..runtime.timing import Timing, sync, two_point_rate
 from . import SolveResult
 
 
@@ -63,8 +63,17 @@ def drive(
     warmup: bool = True,
     fetch: bool = True,
     warm_exec: bool = False,
+    two_point_repeats: int = 0,
 ) -> SolveResult:
-    """Run ``advance(T, k)`` (jitted, static k, donated T) to ``cfg.ntime``."""
+    """Run ``advance(T, k)`` (jitted, static k, donated T) to ``cfg.ntime``.
+
+    ``two_point_repeats > 0`` additionally measures the overhead-corrected
+    two-point rate (``timing.two_point_rate`` — the headline benchmark's
+    protocol) on a COPY of the final state, so the solve result is
+    untouched; costs one extra buffer pair and 1 + 3*repeats extra chunk
+    executions (warm + per-repeat single + back-to-back pair) — for
+    benchmark configs the chunk is the whole solve, so budget device time
+    accordingly."""
     t_all0 = time.perf_counter()
     chunk = event_interval(cfg)
     remaining = cfg.ntime - start_step
@@ -113,6 +122,15 @@ def drive(
         sync(T_dev)
     solve_s = time.perf_counter() - t0
 
+    tp_rate = None
+    if two_point_repeats and remaining > 0:
+        k0 = min(chunk, remaining)
+        fn = compiled.get(k0) or (lambda t: advance(t, k0))
+        # the copy (not T_dev) is donated into the protocol, so the solve's
+        # final state survives the extra executions
+        tp_rate, _ = two_point_rate(fn, jnp.copy(T_dev), cfg.points * k0,
+                                    repeats=two_point_repeats)
+
     # fetch=False skips the final device->host copy (benchmark mode: the
     # copy is seconds for GiB-scale fields on a tunneled link and the caller
     # only wants timings)
@@ -136,7 +154,8 @@ def drive(
             gsum = float(np.asarray(jnp.sum(T_dev, dtype=acc)))
             gsum_dtype = np.dtype(acc).name
     timing = Timing(total_s=time.perf_counter() - t_all0, compile_s=compile_s,
-                    solve_s=solve_s, steps=remaining, points=cfg.points)
+                    solve_s=solve_s, steps=remaining, points=cfg.points,
+                    points_per_s_two_point=tp_rate)
     return SolveResult(cfg=cfg, T=T_host, timing=timing, gsum=gsum,
                        gsum_dtype=gsum_dtype,
                        start_step=start_step, T_dev=T_dev)

@@ -288,7 +288,7 @@ def _finalize_carried(cfg: HeatConfig, res, crop, fetch: bool):
 
 
 def _solve_padded_carry(cfg: HeatConfig, T0, mesh, fetch: bool,
-                        warm_exec: bool):
+                        warm_exec: bool, two_point_repeats: int = 0):
     """Default sharded solve: padded-carry state (make_padded_carry_machinery)."""
     sharding = NamedSharding(mesh, P(*mesh.axis_names))
     T_owned, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
@@ -296,28 +296,40 @@ def _solve_padded_carry(cfg: HeatConfig, T0, mesh, fetch: bool,
     Tp = seed(T_owned)
     del T_owned  # unpin the owned-field device buffer for the solve
     res = drive(cfg.with_(report_sum=False), Tp, advance,
-                start_step=start_step, fetch=False, warm_exec=warm_exec)
+                start_step=start_step, fetch=False, warm_exec=warm_exec,
+                two_point_repeats=two_point_repeats)
     return _finalize_carried(cfg, res, crop, fetch)
 
 
 def fuse_depth_sharded(cfg: HeatConfig, axis_sizes) -> int:
     """Halo width per exchange: requested fuse depth capped by the smallest
-    local extent (a shard can't lend deeper halo than it owns).
+    local extent (a shard can't lend deeper halo than it owns) and by the
+    local kernel's per-pass fusion cap for the rank.
 
-    Auto depth balances the two k-dependent costs per owned point-step:
-    each exchange pays a pad+crop copy of the local block (~2/k full-field
-    passes) against redundant margin work growing as ~2*d*k/L — minimized
-    at k* = sqrt(L/d), clamped to the 2D kernel's fusion cap (_KMAX_2D).
-    Measured on 16384^2 f32 single-chip, 1000-step sweep (k* clamps to
-    32): k=8 -> 94% of the one-pass roofline, k=16 -> 98%, k=32 -> 112%
-    (the official 500-step results.json row, on the padded-carry path,
-    records 113.8%)."""
-    from ..ops.pallas_stencil import _KMAX_2D
+    Auto depth balances the k-dependent costs per owned point-step:
+    per-exchange overhead (~1/k per step — on the default padded-carry
+    path that is the collective dispatch + the exchange breaking kernel
+    fusion, no longer a pad+crop copy) against redundant margin work
+    growing as ~2*d*k/L — minimized at k* = sqrt(L/d). Measured on
+    16384^2 f32 single-chip, 1000-step sweep ON the padded-carry path
+    (k* clamps to 32): k=8 -> 94% of the one-pass roofline, k=16 -> 98%,
+    k=32 -> 112% (the official 500-step results.json row records 113.8%)
+    — so the exchange-count term still dominates at 2D scale and the
+    sqrt form stands as measured.
 
+    The cap is rank-dependent: 2D clamps at _KMAX_2D (=32, measured
+    optimal above); 3D clamps at the 3D kernel's own per-pass chunk depth
+    _KMAX_3D (=8) — exchanging wider than the kernel consumes per pass
+    pays 2*d*k margin compute on three axes while the extra collective
+    savings past k=8 are marginal (for realistic 3D shards sqrt(L/d) <= 8
+    anyway: 512^3 over 2x2x2 gives k*=9->8)."""
+    from ..ops.pallas_stencil import _KMAX_2D, _KMAX_3D
+
+    kmax = _KMAX_2D if cfg.ndim == 2 else _KMAX_3D
     local_min = min(cfg.n // s for s in axis_sizes)
     want = cfg.fuse_steps
     if not want:
-        want = max(1, min(_KMAX_2D, round((local_min / cfg.ndim) ** 0.5)))
+        want = max(1, min(kmax, round((local_min / cfg.ndim) ** 0.5)))
     return max(1, min(want, local_min))
 
 
@@ -397,7 +409,8 @@ def make_padded_carry_machinery(cfg: HeatConfig, mesh):
 
 @register("sharded")
 def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
-          fetch: bool = True, warm_exec: bool = False, **_) -> SolveResult:
+          fetch: bool = True, warm_exec: bool = False,
+          two_point_repeats: int = 0, **_) -> SolveResult:
     mesh = mesh or build_mesh(cfg.ndim, cfg.mesh_shape)
     validate_divisible(cfg.n, mesh)
     master_print(f"Automatic mesh decomposition: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
@@ -420,12 +433,14 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
         # copies). Checkpoint/numerics runs keep the owned-state path —
         # their mid-run host visits (snapshot dumps, finite checks) need
         # the owned field, which padded state only yields via a crop.
-        res = _solve_padded_carry(cfg, T0, mesh, fetch, warm_exec)
+        res = _solve_padded_carry(cfg, T0, mesh, fetch, warm_exec,
+                                  two_point_repeats)
     else:
         sharding = NamedSharding(mesh, P(*mesh.axis_names))
         T, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
         res = drive(cfg, T, make_advance(cfg, mesh), start_step=start_step,
-                    fetch=fetch, warm_exec=warm_exec)
+                    fetch=fetch, warm_exec=warm_exec,
+                    two_point_repeats=two_point_repeats)
     res.mesh_shape = tuple(mesh.devices.shape)
     res.mesh = mesh
     return res

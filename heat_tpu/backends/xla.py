@@ -44,7 +44,8 @@ def make_advance(cfg: HeatConfig):
 
 @register("xla")
 def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None,
-          fetch: bool = True, warm_exec: bool = False, **_) -> SolveResult:
+          fetch: bool = True, warm_exec: bool = False,
+          two_point_repeats: int = 0, **_) -> SolveResult:
     T, start_step = resolve_initial_field(cfg, T0)
     return drive(cfg, T, make_advance(cfg), start_step=start_step, fetch=fetch,
-                 warm_exec=warm_exec)
+                 warm_exec=warm_exec, two_point_repeats=two_point_repeats)

@@ -83,6 +83,12 @@ class Timing:
     solve_s: float = 0.0          # solve-only wall clock
     steps: int = 0
     points: int = 0               # grid points updated per step
+    # overhead-corrected rate from the two-point protocol (``two_point_rate``,
+    # the same measurement bench.py's headline uses) when the solve ran with
+    # two_point_repeats > 0; None otherwise. Reported alongside the raw
+    # single-call ``points_per_s`` so the official table and the headline
+    # metric share one protocol (VERDICT r2 #9).
+    points_per_s_two_point: float | None = None
 
     @property
     def per_step_s(self) -> float:

@@ -100,3 +100,24 @@ def test_two_point_rate_cancels_fixed_overhead(monkeypatch):
     corrected2, raw2 = timing_mod.two_point_rate(
         lambda x: (_time.sleep(0.001), x)[1], "x", work=1.0, repeats=2)
     assert corrected2 == raw2
+
+
+def test_two_point_repeats_through_solve():
+    """VERDICT r2 #9: the solve path can measure the overhead-corrected
+    two-point rate alongside the raw one — on a COPY, so the solve result
+    is bit-identical to a plain run."""
+    cfg = HeatConfig(n=32, ntime=8, dtype="float64", backend="xla")
+    plain = solve(cfg)
+    timed = solve(cfg, two_point_repeats=1)
+    np.testing.assert_array_equal(plain.T, timed.T)
+    assert plain.timing.points_per_s_two_point is None
+    assert timed.timing.points_per_s_two_point > 0
+
+
+def test_two_point_repeats_sharded_padded_carry():
+    cfg = HeatConfig(n=16, ntime=4, dtype="float64", backend="sharded",
+                     mesh_shape=(2, 2))
+    res = solve(cfg, two_point_repeats=1)
+    assert res.timing.points_per_s_two_point > 0
+    ref = solve(cfg.with_(backend="serial", mesh_shape=None))
+    np.testing.assert_array_equal(res.T, ref.T)

@@ -216,3 +216,37 @@ def test_padded_carry_matches_owned_state_path():
     fast = solve(cfg)
     classic = solve(cfg.with_(check_numerics=True))
     np.testing.assert_allclose(fast.T, classic.T, rtol=0, atol=0)
+
+
+def test_fuse_depth_rank_aware_caps():
+    """VERDICT r2 weak #5: auto fuse depth must cap at the local kernel's
+    per-pass chunk depth for the rank — 3D's kernel chunks at _KMAX_3D=8,
+    so exchanging wider pays margin compute on three axes for marginal
+    collective savings."""
+    from heat_tpu.backends.sharded import fuse_depth_sharded
+    from heat_tpu.ops.pallas_stencil import _KMAX_2D, _KMAX_3D
+
+    cfg3 = HeatConfig(n=512, ndim=3, dtype="float32", backend="sharded")
+    # sqrt(512/3) ~ 13 would exceed the 3D kernel's chunk depth of 8
+    assert fuse_depth_sharded(cfg3, (1, 1, 1)) == _KMAX_3D
+    assert fuse_depth_sharded(cfg3, (2, 2, 2)) <= _KMAX_3D
+    # 2D keeps its measured optimum (16384^2: k* clamps to 32)
+    cfg2 = HeatConfig(n=16384, ndim=2, dtype="float32", backend="sharded")
+    assert fuse_depth_sharded(cfg2, (1, 1)) == _KMAX_2D
+    # explicit requests are honored (capped only by the local extent)
+    assert fuse_depth_sharded(cfg3.with_(fuse_steps=16), (1, 1, 1)) == 16
+    # tiny local extents still clamp
+    assert fuse_depth_sharded(cfg3.with_(n=8), (4, 4, 4)) <= 2
+
+
+def test_sharded_3d_auto_depth_matches_serial():
+    """Pin the 3D auto-depth path end to end: auto fuse (now capped at 8)
+    must still bit-match the serial oracle."""
+    cfg = HeatConfig(n=16, ndim=3, ntime=10, dtype="float64",
+                     backend="sharded", mesh_shape=(2, 2, 2))
+    from heat_tpu.backends.sharded import fuse_depth_sharded
+
+    assert 1 < fuse_depth_sharded(cfg, (2, 2, 2)) <= 8
+    res = solve(cfg)
+    ref = solve(cfg.with_(backend="serial", mesh_shape=None))
+    np.testing.assert_array_equal(res.T, ref.T)
