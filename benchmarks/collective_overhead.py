@@ -1,0 +1,172 @@
+"""Measure per-collective dispatch overhead on the attached chip.
+
+VERDICT r2 item 3: BASELINE.md's v5p-32 weak-scaling projection rested on
+an *assumed* 5 µs per-ppermute cost. One chip cannot measure ICI wire
+latency, but it CAN measure the per-collective launch/dispatch overhead
+the projection's latency term is built from, three ways:
+
+1. ``ppermute_chain``: shard_map programs with m chained self-ppermutes
+   (perm [(0,0)] on a 1-device axis) over a realistic halo slab;
+   slope of time vs m = per-ppermute dispatch cost.
+2. ``dispatch_chain``: the same chain with plain elementwise ops instead
+   of collectives — separates "any op dispatch" from "collective
+   dispatch".
+3. ``exchange_delta``: the sharded backend's own ``padded_multi`` (one
+   width-k exchange + k fused steps) vs the bare kernel on the same
+   block — the per-exchange cost the single-chip fuse-depth sweep
+   actually pays (exchange = fusion break + masked-neighbor select on a
+   1x1 mesh; no wire).
+
+Writes benchmarks/collective_overhead.json and prints one line per probe.
+Run on the real chip: ``python benchmarks/collective_overhead.py``
+Smoke (CPU): ``python benchmarks/collective_overhead.py --smoke``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _sync(x):
+    from heat_tpu.runtime.timing import sync
+
+    return sync(x)
+
+
+def _best_time(call, x, repeats=5):
+    """Best-of wall time of call(x) with the scalar-fetch fence; the
+    fixed tunnel overhead is NOT subtracted here — probes difference
+    pairs of these, which cancels it exactly like two_point_rate."""
+    _sync(call(x))  # warm (no donation in these probes); scalar-fetch fence
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        y = call(x)
+        _sync(y)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def probe_chains(smoke: bool):
+    """Probes 1 + 2: chained self-ppermutes vs chained elementwise ops."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(jax.devices()[:1], ("x",))
+    # a realistic halo slab: width-8 exchange of a 16384-wide row block, f32
+    slab = jnp.zeros((8, 1024 if smoke else 16384), jnp.float32)
+    ms = (0, 1, 2, 4, 8, 16)
+
+    def chain(m, collective):
+        def body(s):
+            for i in range(m):
+                if collective:
+                    s = jax.lax.ppermute(s, "x", [(0, 0)])
+                # the +i dependency chain stops XLA from CSE-merging the
+                # repeated identical stages (and is the non-collective
+                # chain's whole payload)
+                s = s + jnp.float32(1 + i)
+            return s
+
+        return jax.jit(shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                                 out_specs=P("x")))
+
+    out = {}
+    for collective in (True, False):
+        name = "ppermute_chain" if collective else "dispatch_chain"
+        times = {}
+        for m in ms:
+            fn = chain(m, collective)
+            times[m] = _best_time(fn, slab)
+        # least-squares slope of time vs m = per-stage cost
+        import numpy as np
+
+        xs = np.asarray(list(times), float)
+        ys = np.asarray([times[m] for m in times], float)
+        slope = float(np.polyfit(xs, ys, 1)[0])
+        out[name] = {"times_s": {str(m): times[m] for m in times},
+                     "per_stage_s": slope}
+        print(f"{name}: per-stage {slope * 1e6:.2f} us "
+              f"(t0={times[0] * 1e3:.2f} ms, t16={times[16] * 1e3:.2f} ms)")
+    # the collective's own cost is the chain slope minus the elementwise
+    # chain's slope (both carry one add per stage)
+    per_ppermute = (out["ppermute_chain"]["per_stage_s"]
+                    - out["dispatch_chain"]["per_stage_s"])
+    out["per_ppermute_dispatch_s"] = per_ppermute
+    print(f"per-ppermute dispatch overhead: {per_ppermute * 1e6:.2f} us")
+    return out
+
+
+def probe_exchange_delta(smoke: bool):
+    """Probe 3: the sharded backend's real per-exchange cost at mesh 1x1.
+
+    Times the padded-carry advance at fuse depth k (one exchange per k
+    steps) for k in {1, 8, 32} over a fixed step count; the per-exchange
+    cost C falls out of t(k) = steps*(t_step + C/k) between k pairs."""
+    import numpy as np
+
+    from heat_tpu.backends.sharded import solve as sharded_solve
+    from heat_tpu.config import HeatConfig
+
+    n = 512 if smoke else 16384
+    steps = 32 if smoke else 512
+    out = {}
+    rates = {}
+    for k in (1, 8, 32):
+        cfg = HeatConfig(n=n, ntime=steps, dtype="float32",
+                         backend="sharded", mesh_shape=(1, 1), fuse_steps=k)
+        res = sharded_solve(cfg, fetch=False, warm_exec=True,
+                            two_point_repeats=2)
+        tp = res.timing.points_per_s_two_point or res.timing.points_per_s
+        rates[k] = tp
+        out[f"fuse_{k}"] = {"points_per_s_two_point": tp,
+                            "solve_s": res.timing.solve_s}
+        print(f"exchange_delta fuse={k}: {tp:.3e} pts/s")
+    # t_step(k) = t_compute + C/k: least-squares over all measured k uses
+    # every paid-for data point and is less noise-sensitive than one pair
+    import numpy as np
+
+    inv_k = np.asarray([1 / k for k in rates], float)
+    t_step = np.asarray([n * n / rates[k] for k in rates], float)
+    C, t_comp = np.polyfit(inv_k, t_step, 1)
+    out["per_exchange_s"] = float(C)
+    out["t_step_compute_s"] = float(t_comp)
+    print(f"per-exchange cost (1x1 mesh, no wire): {C * 1e6:.2f} us")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny sizes, CPU-safe")
+    args = ap.parse_args()
+    if args.smoke:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    rec = {"ts": time.time(), "platform": jax.default_backend(),
+           "smoke": bool(args.smoke)}
+    rec.update(probe_chains(args.smoke))
+    rec["exchange_delta"] = probe_exchange_delta(args.smoke)
+    out = Path(__file__).parent / (
+        "collective_overhead_smoke.json" if args.smoke
+        else "collective_overhead.json")
+    out.write_text(json.dumps(rec, indent=2))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -545,13 +545,28 @@ def pallas_2d_coltiled(Tp, r, ksteps, R, C, kr, kc, logical, bounds=None):
     )(bounds, *([Tp] * 9))
 
 
-def make_2d_coltiled_rolled(r, R, C, kr, kc, ksteps):
+def make_2d_coltiled_rolled(r, R, C, kr, kc, ksteps, variant="f32"):
     """Col-tiled band, but mini-steps are full-band wrap rotates with a
     masked multiplicative update (the thin kernel's scheme on a 2-axis
     tile): every op is lane/sublane-aligned — no shrinking slices, which
-    Mosaic compiles pathologically at deep unrolls on misaligned offsets."""
+    Mosaic compiles pathologically at deep unrolls on misaligned offsets.
+
+    Variants (round 3: the 32768^2 bf16 config measures at the VPU op-rate
+    ceiling, ~12.4 ops/pt-step x 2.2e12 ops/s — ops/pt must drop below
+    ~10.7 to clear the bf16 one-pass HBM roofline):
+    - "f32"        shipped form: f32 band, band + maskr*(sum - 4*band)
+    - "fma"        f32 band, A*band + maskr*sum with A = 1 - 4*maskr
+                   hoisted out of the unroll (one fewer vector op/step;
+                   differs from "f32" only in rounding order)
+    - "bf16native" band stays in storage dtype; rolls move half the bytes;
+                   update upcasts to f32 and rounds back per mini-step
+    - "bf16fma"    both of the above
+    """
     rows = R + 2 * kr
     cols = C + 2 * kc
+    assert variant in ("f32", "fma", "bf16native", "bf16fma"), variant
+    native = variant in ("bf16native", "bf16fma")
+    fma = variant in ("fma", "bf16fma")
 
     def kernel(bounds_ref, c00, c01, c02, c10, c11, c12, c20, c21, c22,
                out_ref):
@@ -562,7 +577,9 @@ def make_2d_coltiled_rolled(r, R, C, kr, kc, ksteps):
         top = jnp.concatenate([c00[:], c01[:], c02[:]], axis=1)
         mid = jnp.concatenate([c10[:], c11[:], c12[:]], axis=1)
         bot = jnp.concatenate([c20[:], c21[:], c22[:]], axis=1)
-        band = jnp.concatenate([top, mid, bot], axis=0).astype(acc_dt)
+        band = jnp.concatenate([top, mid, bot], axis=0)
+        if not native:
+            band = band.astype(acc_dt)
 
         bshape = (rows, cols)
         grow = i * R - kr + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
@@ -572,13 +589,25 @@ def make_2d_coltiled_rolled(r, R, C, kr, kc, ksteps):
             | (gcol <= bounds_ref[0, 2]) | (gcol >= bounds_ref[0, 3])
         )
         maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
+        if fma:
+            decay = (1.0 - 4.0 * maskr).astype(acc_dt)  # hoisted constant
 
         for _ in range(ksteps):  # wrap corruption travels 1 cell/step,
             up = pltpu.roll(band, 1, 0)      # confined to the kr/kc margins
             dn = pltpu.roll(band, rows - 1, 0)
             lf = pltpu.roll(band, 1, 1)
             rt = pltpu.roll(band, cols - 1, 1)
-            band = band + maskr * (up + dn + lf + rt - 4.0 * band)
+            if native:
+                up, dn = up.astype(acc_dt), dn.astype(acc_dt)
+                lf, rt = lf.astype(acc_dt), rt.astype(acc_dt)
+                c = band.astype(acc_dt)
+            else:
+                c = band
+            if fma:
+                new = decay * c + maskr * (up + dn + lf + rt)
+            else:
+                new = c + maskr * (up + dn + lf + rt - 4.0 * c)
+            band = new.astype(store_dt) if native else new
         out_ref[:] = band[kr: kr + R, kc: kc + C].astype(store_dt)
 
     return kernel
@@ -586,9 +615,9 @@ def make_2d_coltiled_rolled(r, R, C, kr, kc, ksteps):
 
 @functools.partial(jax.jit,
                    static_argnames=("r", "ksteps", "R", "C", "kr", "kc",
-                                    "logical"))
+                                    "logical", "variant"))
 def pallas_2d_coltiled_rolled(Tp, r, ksteps, R, C, kr, kc, logical,
-                              bounds=None):
+                              bounds=None, variant="f32"):
     m_pad, n_pad = Tp.shape
     m, n = logical
     assert m_pad % R == 0 and n_pad % C == 0
@@ -623,7 +652,7 @@ def pallas_2d_coltiled_rolled(Tp, r, ksteps, R, C, kr, kc, logical,
         bs((kr, kc), lambda i, j: (rcl((i + 1) * rr), ccl((j + 1) * rc))),
     ]
     return pl.pallas_call(
-        make_2d_coltiled_rolled(float(r), R, C, kr, kc, ksteps),
+        make_2d_coltiled_rolled(float(r), R, C, kr, kc, ksteps, variant),
         out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
         grid=(gr, gc),
         in_specs=in_specs,
@@ -636,7 +665,12 @@ def pallas_2d_coltiled_rolled(Tp, r, ksteps, R, C, kr, kc, logical,
 def check_2d_coltiled_rolled():
     rng = np.random.default_rng(3)
     m, n = 100, 500
-    for dt, tol in ((np.float32, 2e-6), (jnp.bfloat16, 3e-2)):
+    cases = ((np.float32, "f32", 2e-6), (np.float32, "fma", 2e-6),
+             (jnp.bfloat16, "f32", 3e-2), (jnp.bfloat16, "fma", 3e-2),
+             # per-mini-step bf16 rounding accumulates: looser tolerance
+             (jnp.bfloat16, "bf16native", 6e-2),
+             (jnp.bfloat16, "bf16fma", 6e-2))
+    for dt, variant, tol in cases:
         T = rng.uniform(1, 2, (m, n)).astype(dt)
         r = 0.2
         R, C, kr, kc = 16, 256, 16, 128
@@ -646,16 +680,17 @@ def check_2d_coltiled_rolled():
         for ks in (1, 5, 16):
             out = pallas_2d_coltiled_rolled(
                 Tp, r=r, ksteps=ks, R=R, C=C, kr=kr, kc=kc,
-                logical=(m, n))[:m, :n]
+                logical=(m, n), variant=variant)[:m, :n]
             ref = ref_steps(jnp.asarray(T), r, ks)
             err = float(jnp.abs(out.astype(jnp.float32)
                                 - ref.astype(jnp.float32)).max())
-            print(f"2d coltiled-rolled {np.dtype(dt).name} ksteps={ks}: "
-                  f"max err {err:.2e}")
+            print(f"2d coltiled-rolled {np.dtype(dt).name} {variant} "
+                  f"ksteps={ks}: max err {err:.2e}")
             assert err < tol, err
 
 
-def bench_2d_rolled(configs, n2=32768, dtype="bfloat16", steps=96):
+def bench_2d_rolled(configs, n2=32768, dtype="bfloat16", steps=96,
+                    variant="f32"):
     from heat_tpu.runtime.timing import sync
 
     r = 0.25
@@ -679,7 +714,7 @@ def bench_2d_rolled(configs, n2=32768, dtype="bfloat16", steps=96):
             def body(i, t):
                 return pallas_2d_coltiled_rolled(
                     t, r=r, ksteps=k, R=R, C=C, kr=kr, kc=kc,
-                    logical=(n2, n2))
+                    logical=(n2, n2), variant=variant)
             return jax.lax.fori_loop(0, steps // k, body, Tp)
 
         try:
@@ -689,13 +724,13 @@ def bench_2d_rolled(configs, n2=32768, dtype="bfloat16", steps=96):
             nsteps = (steps // k) * k
             pts, pts_raw = measure_rate(c, dev, n2 * n2 * nsteps)
             roof = 2.048e11 if dtype == "bfloat16" else 1.024e11
-            print(f"rolled R={R:4d} C={C:6d} kr={kr} kc={kc}: {pts:.3e} "
-                  f"pts/s ({pts / roof * 100:.0f}% {dtype} roofline; raw "
-                  f"{pts_raw / roof * 100:.0f}%)"
+            print(f"rolled {variant} R={R:4d} C={C:6d} kr={kr} kc={kc}: "
+                  f"{pts:.3e} pts/s ({pts / roof * 100:.0f}% {dtype} "
+                  f"roofline; raw {pts_raw / roof * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
-            print(f"rolled R={R:4d} C={C:6d} kr={kr} kc={kc}: FAILED "
-                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+            print(f"rolled {variant} R={R:4d} C={C:6d} kr={kr} kc={kc}: "
+                  f"FAILED {type(e).__name__}: {str(e)[:200]}", flush=True)
 
 
 def check_2d_coltiled():
@@ -924,6 +959,14 @@ if __name__ == "__main__":
     elif exp == "bench2d_rolled":
         cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
         bench_2d_rolled(cfgs or [(256, 4096, 16, 128)])
+    elif exp == "bench2d_rolled_var":
+        # args: variant then R,C,kr,kc quadruples
+        if len(sys.argv) < 3:
+            sys.exit("usage: kernel_lab.py bench2d_rolled_var "
+                     "{f32|fma|bf16native|bf16fma} [R,C,kr,kc ...]")
+        variant = sys.argv[2]
+        cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[3:]]
+        bench_2d_rolled(cfgs or [(256, 4096, 16, 128)], variant=variant)
     elif exp == "check3d_rolled":
         check_3d_rolled()
     elif exp == "bench3d_rolled":

@@ -10,9 +10,21 @@ export PYTHONPATH="$(cd "$(dirname "$0")/.." && pwd):${PYTHONPATH:-}"
 cd "$(dirname "$0")/.."
 for i in $(seq 1 "${PROBES:-48}"); do
   if timeout 120 python -c "import jax; assert jax.devices()" 2>/dev/null; then
-    echo "=== TPU back at $(date); starting sweep"
+    echo "=== TPU back at $(date); starting round-3 sweep"
+    echo "=== bench (driver artifact dry run)"
+    timeout 700 python bench.py
+    echo "=== collective_overhead (weak-scaling anchor)"
+    timeout 1800 python benchmarks/collective_overhead.py
+    echo "=== kernel variant checks"
+    timeout 1800 python benchmarks/kernel_lab.py check2d_rolled
+    echo "=== fma A/B at the shipped tile"
+    timeout 2400 python benchmarks/kernel_lab.py bench2d_rolled_var fma 256,4096,16,128
+    echo "=== bf16native A/B"
+    timeout 2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16native 256,4096,16,128
+    echo "=== bf16fma A/B"
+    timeout 2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16fma 256,4096,16,128
     echo "=== chip_check"; timeout 2400 python benchmarks/chip_check.py
-    echo "=== run_all";   timeout 3600 python benchmarks/run_all.py
+    echo "=== run_all";   timeout 5400 python benchmarks/run_all.py
     echo "=== sweep done at $(date)"
     exit 0
   fi
