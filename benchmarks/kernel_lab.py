@@ -154,9 +154,11 @@ def pallas_3d_tiled(Tp, r, ksteps, R, M, k, km, logical,
 # ---------------------------------------------------------------------------
 
 
-def make_3d_rolled(r, R, M, k, km, n_pad, ksteps):
+def make_3d_rolled(r, R, M, k, km, n_pad, ksteps, variant="f32"):
     rows = R + 2 * k
     mids = M + 2 * km
+    assert variant in ("f32", "fma"), variant
+    fma = variant == "fma"
 
     def kernel(bounds_ref, c00, c01, c02, c10, c11, c12, c20, c21, c22,
                out_ref):
@@ -179,6 +181,8 @@ def make_3d_rolled(r, R, M, k, km, n_pad, ksteps):
             | (gcol <= bounds_ref[0, 4]) | (gcol >= bounds_ref[0, 5])
         )
         maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
+        if fma:
+            decay = (1.0 - 6.0 * maskr).astype(acc_dt)  # hoisted constant
 
         for _ in range(ksteps):
             up = pltpu.roll(band, 1, 0)
@@ -187,7 +191,11 @@ def make_3d_rolled(r, R, M, k, km, n_pad, ksteps):
             so = pltpu.roll(band, mids - 1, 1)
             lf = pltpu.roll(band, 1, 2)
             rt = pltpu.roll(band, n_pad - 1, 2)
-            band = band + maskr * (up + dn + no + so + lf + rt - 6.0 * band)
+            if fma:
+                band = decay * band + maskr * (up + dn + no + so + lf + rt)
+            else:
+                band = band + maskr * (up + dn + no + so + lf + rt
+                                       - 6.0 * band)
         out_ref[:] = band[k: k + R, km: km + M, :].astype(store_dt)
 
     return kernel
@@ -195,8 +203,9 @@ def make_3d_rolled(r, R, M, k, km, n_pad, ksteps):
 
 @functools.partial(jax.jit,
                    static_argnames=("r", "ksteps", "R", "M", "k", "km",
-                                    "logical"))
-def pallas_3d_rolled(Tp, r, ksteps, R, M, k, km, logical, bounds=None):
+                                    "logical", "variant"))
+def pallas_3d_rolled(Tp, r, ksteps, R, M, k, km, logical, bounds=None,
+                     variant="f32"):
     m_pad, mid_pad, n_pad = Tp.shape
     m, mid, n = logical
     assert m_pad % R == 0 and mid_pad % M == 0
@@ -231,7 +240,7 @@ def pallas_3d_rolled(Tp, r, ksteps, R, M, k, km, logical, bounds=None):
         bs((k, km, n_pad), lambda i, j: (rcl((i + 1) * rr), mcl((j + 1) * rm), 0)),
     ]
     return pl.pallas_call(
-        make_3d_rolled(float(r), R, M, k, km, n_pad, ksteps),
+        make_3d_rolled(float(r), R, M, k, km, n_pad, ksteps, variant),
         out_shape=jax.ShapeDtypeStruct(Tp.shape, Tp.dtype),
         grid=(gr, gm),
         in_specs=in_specs,
@@ -253,16 +262,18 @@ def check_3d_rolled():
     n_pad = _round_up(n, 128)
     Tp = jnp.pad(jnp.asarray(T), ((0, m_pad - m), (0, mid_pad - mid),
                                   (0, n_pad - n)))
-    for ks in (1, 3, 4):
-        out = pallas_3d_rolled(Tp, r=r, ksteps=ks, R=R, M=M, k=k, km=km,
-                               logical=(m, mid, n))[:m, :mid, :n]
-        ref = ref_steps(jnp.asarray(T), r, ks)
-        err = float(jnp.abs(out - ref).max())
-        print(f"3d rolled ksteps={ks}: max err {err:.2e}")
-        assert err < 2e-6, err
+    for variant in ("f32", "fma"):
+        for ks in (1, 3, 4):
+            out = pallas_3d_rolled(Tp, r=r, ksteps=ks, R=R, M=M, k=k, km=km,
+                                   logical=(m, mid, n),
+                                   variant=variant)[:m, :mid, :n]
+            ref = ref_steps(jnp.asarray(T), r, ks)
+            err = float(jnp.abs(out - ref).max())
+            print(f"3d rolled {variant} ksteps={ks}: max err {err:.2e}")
+            assert err < 2e-6, err
 
 
-def bench_3d_rolled(configs, n3=512, steps=240):
+def bench_3d_rolled(configs, n3=512, steps=240, variant="f32"):
     from heat_tpu.runtime.timing import sync
 
     r = 0.15
@@ -282,7 +293,8 @@ def bench_3d_rolled(configs, n3=512, steps=240):
         def run(Tp, R=R, M=M, k=k, km=km):
             def body(i, t):
                 return pallas_3d_rolled(t, r=r, ksteps=min(k, km), R=R, M=M,
-                                        k=k, km=km, logical=(n3, n3, n3))
+                                        k=k, km=km, logical=(n3, n3, n3),
+                                        variant=variant)
             return jax.lax.fori_loop(0, steps // min(k, km), body, Tp)
 
         try:
@@ -291,12 +303,12 @@ def bench_3d_rolled(configs, n3=512, steps=240):
             compile_s = time.perf_counter() - t0
             nsteps = (steps // min(k, km)) * min(k, km)
             pts, pts_raw = measure_rate(c, dev, n3 ** 3 * nsteps)
-            print(f"rolled R={R:4d} M={M:4d} k={k} km={km}: "
+            print(f"rolled {variant} R={R:4d} M={M:4d} k={k} km={km}: "
                   f"{pts:.3e} pts/s  ({pts / 1.024e11 * 100:.0f}% roofline; "
                   f"raw {pts_raw / 1.024e11 * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
-            print(f"rolled R={R:4d} M={M:4d} k={k} km={km}: FAILED "
+            print(f"rolled {variant} R={R:4d} M={M:4d} k={k} km={km}: FAILED "
                   f"{type(e).__name__}: {str(e)[:200]}", flush=True)
 
 
@@ -306,6 +318,10 @@ def bench_3d_rolled(configs, n3=512, steps=240):
 #           instead of sublane rolls; lanes still rolled
 #   bf16native: band stays in storage dtype; operands upcast at the adds
 #               (VERDICT r1: do store-dtype rolls beat upcast-then-roll?)
+#   rolled: the SHIPPED _make_kernel_2d body verbatim (the A side)
+#   rolledfma: shipped body with the decay constant A = 1-4*maskr hoisted
+#              out of the unroll (one fewer vector op per mini-step — the
+#              round-3 op-reduction candidate for the 4096^2 headline)
 # ---------------------------------------------------------------------------
 
 
@@ -351,6 +367,21 @@ def make_thin2d_variant(r, tile, kpad, n_pad, ksteps, variant):
                 band = (c + maskr * (up + dn + lf + rt - 4.0 * c)
                         ).astype(store_dt)
             out_ref[:] = band[kpad: kpad + tile]
+        elif variant in ("rolled", "rolledfma"):
+            maskr = jnp.where(frozen, 0.0, r).astype(acc_dt)
+            band = band0.astype(acc_dt)
+            if variant == "rolledfma":
+                decay = (1.0 - 4.0 * maskr).astype(acc_dt)
+            for _ in range(ksteps):
+                up = pltpu.roll(band, 1, 0)
+                dn = pltpu.roll(band, rows - 1, 0)
+                lf = pltpu.roll(band, 1, 1)
+                rt = pltpu.roll(band, n_pad - 1, 1)
+                if variant == "rolledfma":
+                    band = decay * band + maskr * (up + dn + lf + rt)
+                else:
+                    band = band + maskr * (up + dn + lf + rt - 4.0 * band)
+            out_ref[:] = band[kpad: kpad + tile].astype(store_dt)
         else:
             raise ValueError(variant)
 
@@ -392,7 +423,9 @@ def check_thin2d_variants():
     rng = np.random.default_rng(2)
     m, n = 96, 260
     for variant, dt, tol in (("shrink", np.float32, 2e-6),
-                             ("bf16native", jnp.bfloat16, 5e-2)):
+                             ("bf16native", jnp.bfloat16, 5e-2),
+                             ("rolled", np.float32, 2e-6),
+                             ("rolledfma", np.float32, 2e-6)):
         T = rng.uniform(1, 2, (m, n)).astype(dt)
         tile, kpad = 32, 16
         m_pad = _round_up(m, tile)
@@ -972,6 +1005,13 @@ if __name__ == "__main__":
     elif exp == "bench3d_rolled":
         cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
         bench_3d_rolled(cfgs or [(64, 64, 8, 8)])
+    elif exp == "bench3d_rolled_var":
+        if len(sys.argv) < 3:
+            sys.exit("usage: kernel_lab.py bench3d_rolled_var {f32|fma} "
+                     "[R,M,k,km ...]")
+        variant = sys.argv[2]
+        cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[3:]]
+        bench_3d_rolled(cfgs or [(64, 64, 8, 8)], variant=variant)
     elif exp == "bench2d_rolled_f32":
         cfgs = [tuple(int(t) for t in a.split(",")) for a in sys.argv[2:]]
         bench_2d_rolled(cfgs or [(256, 4096, 16, 128)], dtype="float32")

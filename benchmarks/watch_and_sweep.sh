@@ -17,12 +17,19 @@ for i in $(seq 1 "${PROBES:-48}"); do
     timeout 1800 python benchmarks/collective_overhead.py
     echo "=== kernel variant checks"
     timeout 1800 python benchmarks/kernel_lab.py check2d_rolled
+    timeout 1800 python benchmarks/kernel_lab.py checkthin
+    timeout 1800 python benchmarks/kernel_lab.py check3d_rolled
     echo "=== fma A/B at the shipped tile"
     timeout 2400 python benchmarks/kernel_lab.py bench2d_rolled_var fma 256,4096,16,128
     echo "=== bf16native A/B"
     timeout 2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16native 256,4096,16,128
     echo "=== bf16fma A/B"
     timeout 2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16fma 256,4096,16,128
+    echo "=== thin fma A/B at the 4096^2 headline tile"
+    timeout 2400 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolledfma,256,16
+    echo "=== 3D fma A/B at the shipped 512^3 plan"
+    timeout 2400 python benchmarks/kernel_lab.py bench3d_rolled_var f32 64,64,8,8
+    timeout 2400 python benchmarks/kernel_lab.py bench3d_rolled_var fma 64,64,8,8
     echo "=== chip_check"; timeout 2400 python benchmarks/chip_check.py
     echo "=== run_all";   timeout 5400 python benchmarks/run_all.py
     echo "=== sweep done at $(date)"
