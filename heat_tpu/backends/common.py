@@ -178,7 +178,10 @@ def _rebuild_from_shard_blocks(cfg: HeatConfig, sharding, blocks):
             raise ValueError(
                 f"shard checkpoint block at offset {starts} does not match "
                 f"the current mesh layout {sorted(by_start)} — resume with "
-                f"the mesh shape the checkpoint was written under")
+                f"the mesh shape the checkpoint was written under (or, if "
+                f"the shape is unchanged, the shard->device ORDERING moved: "
+                f"e.g. a JAX/topology change reordered build_mesh's device "
+                f"placement between save and resume)")
         # host->target device in one hop (jnp.asarray would stage through
         # the default device first: a doubled transfer at GiB scale)
         arrays.append(jax.device_put(np.asarray(data).astype(dt), dev))

@@ -62,7 +62,25 @@ def build_mesh(
     n_used = int(np.prod(shape))
     if n_used > len(devices):
         raise ValueError(f"mesh {shape} needs {n_used} devices, have {len(devices)}")
-    dev_array = np.asarray(devices[:n_used]).reshape(shape)
+    use = devices[:n_used]
+    if len(use) > 1 and getattr(use[0], "platform", None) == "tpu":
+        # Physical-topology-aware placement: mesh neighbors should be ICI
+        # torus neighbors (and on multi-slice jobs the outer axis should
+        # ride DCN) — the scaling-book layout rule. A naive reshape can
+        # put mesh-adjacent shards on physically distant chips, turning
+        # every halo ppermute into a multi-hop route. The reference gets
+        # the same property from MPI_Cart_create's reorder flag
+        # (fortran/mpi+cuda/heat.F90:97); on TPU the topology is known to
+        # the runtime, so use it.
+        try:  # best-effort: the experimental namespace may move/vanish
+            from jax.experimental import mesh_utils
+
+            dev_array = np.asarray(
+                mesh_utils.create_device_mesh(shape, devices=use))
+        except Exception:  # odd shapes/topologies: plain order still works
+            dev_array = np.asarray(use).reshape(shape)
+    else:
+        dev_array = np.asarray(use).reshape(shape)
     return Mesh(dev_array, MESH_AXES[:ndim])
 
 

@@ -250,3 +250,16 @@ def test_sharded_3d_auto_depth_matches_serial():
     res = solve(cfg)
     ref = solve(cfg.with_(backend="serial", mesh_shape=None))
     np.testing.assert_array_equal(res.T, ref.T)
+
+
+def test_build_mesh_cpu_keeps_plain_device_order():
+    """Off-TPU, build_mesh is a plain reshape (deterministic shard->device
+    binding for the virtual-device tests); the ICI-topology-aware ordering
+    (mesh_utils) only engages on real multi-chip TPU."""
+    import jax
+
+    from heat_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(2, (4, 2))
+    assert [d.id for d in mesh.devices.flat] == [
+        d.id for d in jax.devices()[:8]]
