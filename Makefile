@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test bench bench-all bench-smoke chip-check weak-scaling native run viz clean
+.PHONY: test bench bench-all bench-smoke chip-check weak-scaling \
+        collective-overhead native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -22,6 +23,9 @@ bench-smoke:
 
 weak-scaling:
 	$(PY) benchmarks/weak_scaling.py --virtual 8
+
+collective-overhead:   # measured anchor for the weak-scaling projection
+	$(PY) benchmarks/collective_overhead.py
 
 native:
 	$(MAKE) -C heat_tpu/io/native
