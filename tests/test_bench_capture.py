@@ -103,12 +103,6 @@ def test_sigterm_leaves_parseable_line(tmp_path):
     """The round-2 killer, reproduced: an external deadline SIGTERMs the
     supervisor mid-attempt. The backstop handler must print the verdict
     line before the process dies."""
-    def worker_pids():
-        r = subprocess.run(["pgrep", "-f", "bench.py --worker"],
-                           capture_output=True, text=True)
-        return set(r.stdout.split())
-
-    pre_existing = worker_pids()
     env = dict(os.environ)
     env.update(HEAT_BENCH_TIMEOUT_S="300", HEAT_BENCH_TOTAL_BUDGET_S="300",
                JAX_PLATFORMS="cpu")
@@ -117,7 +111,34 @@ def test_sigterm_leaves_parseable_line(tmp_path):
                                       "bench.py")],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         env=env)
-    time.sleep(2.0)  # supervisor up, worker mid-import/measure
+
+    def our_worker_pids():
+        # scoped to THIS supervisor's children so a concurrent bench run
+        # (e.g. the chip sweep) can't satisfy the readiness check
+        r = subprocess.run(
+            ["pgrep", "-P", str(proc.pid), "-f", "bench.py --worker"],
+            capture_output=True, text=True)
+        return {int(p) for p in r.stdout.split()}
+
+    # Wait for the supervisor's worker to appear before signalling: the
+    # worker is spawned only after the signal backstop is installed, so
+    # its existence proves the handler is live. (A fixed 2 s sleep raced
+    # interpreter startup under load — SIGTERM landed before the handler
+    # and the default action killed the process with rc=-15.)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        ours = our_worker_pids()
+        if ours:
+            break
+        if proc.poll() is not None:
+            out, _ = proc.communicate()
+            raise AssertionError(
+                f"supervisor exited early rc={proc.returncode}; "
+                f"stdout: {out!r}")
+        time.sleep(0.1)
+    else:
+        proc.kill()
+        raise AssertionError("worker never appeared within 60s")
     proc.send_signal(signal.SIGTERM)
     out, _ = proc.communicate(timeout=30)
     assert proc.returncode == 1
@@ -125,9 +146,22 @@ def test_sigterm_leaves_parseable_line(tmp_path):
     assert parsed["metric"] == bench.METRIC
     assert "signal 15" in parsed["error"]
     # the backstop must also reap the in-flight worker — an orphan would
-    # keep holding the (single) chip for up to ATTEMPT_TIMEOUT_S
+    # keep holding the (single) chip for up to ATTEMPT_TIMEOUT_S. The
+    # worker pids were recorded while the supervisor was alive (pgrep -P
+    # can't find them post-reparenting), so check them directly.
     time.sleep(1.0)
-    leaked = worker_pids() - pre_existing
+    leaked = set()
+    for pid in ours:
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read()  # empty for zombies — dead, awaiting reap
+            with open(f"/proc/{pid}/stat") as f:
+                state = f.read().rsplit(")", 1)[1].split()[0]
+        except OSError:
+            continue  # gone entirely
+        # identity check guards against PID reuse during the settle sleep
+        if state != "Z" and b"--worker" in cmdline:
+            leaked.add((pid, state))
     assert not leaked, f"orphaned worker pids: {leaked}"
 
 
