@@ -113,7 +113,7 @@ def probe_exchange_delta(smoke: bool):
     """Probe 3: the sharded backend's real per-exchange cost at mesh 1x1.
 
     Times the padded-carry advance at fuse depth k (one exchange per k
-    steps) for k in {1, 8, 32} over a fixed step count; the per-exchange
+    steps) for k in {1, 8, 16} over a fixed step count; the per-exchange
     cost C falls out of t(k) = steps*(t_step + C/k) between k pairs."""
     import numpy as np
 
@@ -124,7 +124,10 @@ def probe_exchange_delta(smoke: bool):
     steps = 32 if smoke else 512
     out = {}
     rates = {}
-    for k in (1, 8, 32):
+    # k=16 (not 32): the round-3 sweep's fuse=32 case sat >25 min in
+    # Mosaic compile at this width and blew the phase timeout; {1,8,16}
+    # give the 1/k fit all the spread it needs
+    for k in (1, 8, 16):
         cfg = HeatConfig(n=n, ntime=steps, dtype="float32",
                          backend="sharded", mesh_shape=(1, 1), fuse_steps=k)
         res = sharded_solve(cfg, fetch=False, warm_exec=True,
@@ -133,11 +136,9 @@ def probe_exchange_delta(smoke: bool):
         rates[k] = tp
         out[f"fuse_{k}"] = {"points_per_s_two_point": tp,
                             "solve_s": res.timing.solve_s}
-        print(f"exchange_delta fuse={k}: {tp:.3e} pts/s")
+        print(f"exchange_delta fuse={k}: {tp:.3e} pts/s", flush=True)
     # t_step(k) = t_compute + C/k: least-squares over all measured k uses
     # every paid-for data point and is less noise-sensitive than one pair
-    import numpy as np
-
     inv_k = np.asarray([1 / k for k in rates], float)
     t_step = np.asarray([n * n / rates[k] for k in rates], float)
     C, t_comp = np.polyfit(inv_k, t_step, 1)
@@ -159,12 +160,21 @@ def main():
 
     rec = {"ts": time.time(), "platform": jax.default_backend(),
            "smoke": bool(args.smoke)}
-    rec.update(probe_chains(args.smoke))
-    rec["exchange_delta"] = probe_exchange_delta(args.smoke)
     out = Path(__file__).parent / (
         "collective_overhead_smoke.json" if args.smoke
         else "collective_overhead.json")
-    out.write_text(json.dumps(rec, indent=2))
+    def flush():
+        # atomic + after each probe: the round-3 sweep lost a completed
+        # chains probe when a later probe blew the phase timeout before
+        # the single end-of-run write
+        tmp = out.with_suffix(".tmp")
+        tmp.write_text(json.dumps(rec, indent=2))
+        tmp.replace(out)
+
+    rec.update(probe_chains(args.smoke))
+    flush()
+    rec["exchange_delta"] = probe_exchange_delta(args.smoke)
+    flush()
     print(f"wrote {out}")
 
 

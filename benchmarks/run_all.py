@@ -69,10 +69,80 @@ def bench_one(name, cfg, repeat=1):
     return row
 
 
+def _write_atomic(out: Path, obj):
+    """Temp-file + rename: a SIGKILL mid-write (row timeout, external
+    deadline) must not leave truncated JSON that poisons later merges."""
+    import os
+
+    tmp = out.with_suffix(".tmp")
+    tmp.write_text(json.dumps(obj, indent=2))
+    os.replace(tmp, out)
+
+
+def _read_rows(out: Path):
+    if not out.exists():
+        return []
+    try:
+        return json.loads(out.read_text()).get("rows", [])
+    except json.JSONDecodeError:  # pre-atomic-write corruption: start over
+        return []
+
+
+def _merge_rows(out: Path, rows):
+    """Merge rows into the results file by name, preserving existing order
+    (partial re-measures must not clobber other configs' numbers)."""
+    old = _read_rows(out)
+    fresh = {r["name"]: r for r in rows}
+    merged = [fresh.pop(r["name"], r) for r in old] + list(fresh.values())
+    _write_atomic(out, {"ts": time.time(), "rows": merged})
+    return merged
+
+
+def supervise_rows(names, out: Path, row_timeout: int):
+    """Run each config row in its own subprocess under a per-row deadline.
+
+    Round-3 lesson: a single pathological row (the sharded fuse=32 case
+    sat >25 min — tunnel stall or Mosaic compile cliff) ate the phase's
+    whole timeout and the end-of-run write never happened, voiding every
+    other row's measurement. Children merge their own row into
+    results.json as they finish, so the artifact grows incrementally and
+    a hung row costs only itself."""
+    import subprocess
+
+    if not out.exists():
+        _write_atomic(out, {"ts": time.time(), "rows": []})
+    for name in names:
+        cmd = [sys.executable, __file__, "--only", name, "--row-timeout", "0"]
+        t_start = time.time()
+        try:
+            rc = subprocess.run(cmd, timeout=row_timeout).returncode
+            err = None if rc == 0 else f"row subprocess rc={rc}"
+        except subprocess.TimeoutExpired:
+            err = f"timed out after {row_timeout}s"
+        if err:
+            # a child can merge its measured row and THEN stall in runtime
+            # teardown (the tunneled-platform hang mode) — don't clobber a
+            # measurement that already landed
+            landed = any(r["name"] == name
+                         and r.get("measured_ts", 0) >= t_start
+                         and "error" not in r for r in _read_rows(out))
+            if landed:
+                print(f"{name:40s} child died post-measurement ({err}); "
+                      f"row kept")
+                continue
+            print(f"{name:40s} FAILED: {err}")
+            _merge_rows(out, [{"name": name, "error": err,
+                               "measured_ts": time.time()}])
+    print(f"wrote {out}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny sizes, CPU-safe")
     ap.add_argument("--only", help="substring filter on config name")
+    ap.add_argument("--row-timeout", type=int, default=1500,
+                    help="seconds per config row, each in its own "
+                         "subprocess (0 = run rows in-process)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -118,26 +188,31 @@ def main():
                     dtype="bfloat16", backend="pallas")),
     ]
 
+    # smoke mode must never clobber chip-measured numbers
+    out = Path(__file__).parent / (
+        "results_smoke.json" if args.smoke else "results.json")
+
+    names = [n for n, _ in configs if not args.only or args.only in n]
+    if args.row_timeout > 0 and not args.smoke:
+        supervise_rows(names, out, args.row_timeout)
+        return
+
     rows = []
     for name, cfg in configs:
-        if args.only and args.only not in name:
+        if name not in names:
             continue
         try:
             rows.append(bench_one(name, cfg))
         except Exception as e:  # record failures, keep measuring
             print(f"{name:40s} FAILED: {type(e).__name__}: {e}")
-            rows.append({"name": name, "error": f"{type(e).__name__}: {e}"})
-
-    # smoke mode must never clobber chip-measured numbers
-    out = Path(__file__).parent / (
-        "results_smoke.json" if args.smoke else "results.json")
+            rows.append({"name": name, "error": f"{type(e).__name__}: {e}",
+                         "measured_ts": time.time()})
     if args.only and out.exists():
-        # partial re-measure: merge into the existing rows by name instead
-        # of clobbering the other configs' numbers
-        old = json.loads(out.read_text()).get("rows", [])
-        fresh = {r["name"]: r for r in rows}
-        rows = [fresh.pop(r["name"], r) for r in old] + list(fresh.values())
-    out.write_text(json.dumps({"ts": time.time(), "rows": rows}, indent=2))
+        # partial re-measure: merge by name instead of clobbering
+        _merge_rows(out, rows)
+    else:
+        out.write_text(json.dumps({"ts": time.time(), "rows": rows},
+                                  indent=2))
     print(f"wrote {out}")
 
 

@@ -1,0 +1,79 @@
+"""benchmarks/run_all.py row isolation (round 3).
+
+A single pathological row (the fuse=32 stall) must cost only itself:
+children merge rows incrementally and the supervisor records
+timeout/crash rows without losing the others.
+"""
+import importlib.util
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "run_all", Path(__file__).resolve().parent.parent / "benchmarks"
+    / "run_all.py")
+run_all = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(run_all)
+
+
+def test_merge_rows_preserves_order_and_updates(tmp_path):
+    out = tmp_path / "results.json"
+    run_all._merge_rows(out, [{"name": "a", "v": 1}, {"name": "b", "v": 1}])
+    run_all._merge_rows(out, [{"name": "a", "v": 2}])  # update in place
+    run_all._merge_rows(out, [{"name": "c", "v": 1}])  # append new
+    rows = json.loads(out.read_text())["rows"]
+    assert [r["name"] for r in rows] == ["a", "b", "c"]
+    assert rows[0]["v"] == 2 and rows[1]["v"] == 1
+
+
+def test_supervise_rows_records_failures_keeps_rest(tmp_path, monkeypatch,
+                                                    capsys):
+    out = tmp_path / "results.json"
+
+    def fake_run(cmd, timeout=None):
+        name = cmd[cmd.index("--only") + 1]
+        if name == "hangs":
+            raise subprocess.TimeoutExpired(cmd, timeout)
+        if name == "crashes":
+            return subprocess.CompletedProcess(cmd, 1)
+        # a healthy child merges its own row, like bench_one's path does
+        run_all._merge_rows(out, [{"name": name, "points_per_s": 1.0}])
+        return subprocess.CompletedProcess(cmd, 0)
+
+    # supervise_rows does `import subprocess` locally — patch the module
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    run_all.supervise_rows(["ok1", "hangs", "crashes", "ok2"], out,
+                           row_timeout=5)
+    rows = {r["name"]: r for r in json.loads(out.read_text())["rows"]}
+    assert rows["ok1"]["points_per_s"] == 1.0
+    assert rows["ok2"]["points_per_s"] == 1.0
+    assert "timed out" in rows["hangs"]["error"]
+    assert "rc=1" in rows["crashes"]["error"]
+
+
+def test_supervise_keeps_row_when_child_dies_post_measurement(
+        tmp_path, monkeypatch):
+    """A child can merge its measured row and then stall in runtime
+    teardown until the row timeout fires — the measurement must survive."""
+    import time as time_mod
+
+    out = tmp_path / "results.json"
+
+    def fake_run(cmd, timeout=None):
+        name = cmd[cmd.index("--only") + 1]
+        run_all._merge_rows(out, [{"name": name, "points_per_s": 7.0,
+                                   "measured_ts": time_mod.time()}])
+        raise subprocess.TimeoutExpired(cmd, timeout)  # teardown hang
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    run_all.supervise_rows(["slow_teardown"], out, row_timeout=5)
+    (row,) = json.loads(out.read_text())["rows"]
+    assert row["points_per_s"] == 7.0 and "error" not in row
+
+
+def test_merge_survives_corrupt_results_file(tmp_path):
+    out = tmp_path / "results.json"
+    out.write_text('{"ts": 1, "rows": [{"na')  # truncated by a SIGKILL
+    run_all._merge_rows(out, [{"name": "a", "v": 1}])
+    assert json.loads(out.read_text())["rows"] == [{"name": "a", "v": 1}]
