@@ -1,0 +1,16 @@
+"""Shared helpers for the benchmark scripts."""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+
+def write_atomic(out: Path, obj) -> None:
+    """Temp-file + rename: a SIGKILL mid-write (row/phase timeout,
+    external deadline) must not leave truncated JSON that poisons later
+    merges or re-reads."""
+    tmp = out.with_suffix(".tmp")
+    tmp.write_text(json.dumps(obj, indent=2))
+    os.replace(tmp, out)

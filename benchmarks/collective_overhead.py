@@ -25,7 +25,6 @@ Smoke (CPU): ``python benchmarks/collective_overhead.py --smoke``
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 from pathlib import Path
@@ -163,13 +162,13 @@ def main():
     out = Path(__file__).parent / (
         "collective_overhead_smoke.json" if args.smoke
         else "collective_overhead.json")
+    from _util import write_atomic
+
     def flush():
         # atomic + after each probe: the round-3 sweep lost a completed
         # chains probe when a later probe blew the phase timeout before
         # the single end-of-run write
-        tmp = out.with_suffix(".tmp")
-        tmp.write_text(json.dumps(rec, indent=2))
-        tmp.replace(out)
+        write_atomic(out, rec)
 
     rec.update(probe_chains(args.smoke))
     flush()

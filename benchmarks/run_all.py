@@ -18,6 +18,9 @@ import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from _util import write_atomic  # noqa: E402
 
 HBM_BYTES_PER_S = 819e9  # v5e; v5p would be ~2.76e12
 
@@ -69,16 +72,6 @@ def bench_one(name, cfg, repeat=1):
     return row
 
 
-def _write_atomic(out: Path, obj):
-    """Temp-file + rename: a SIGKILL mid-write (row timeout, external
-    deadline) must not leave truncated JSON that poisons later merges."""
-    import os
-
-    tmp = out.with_suffix(".tmp")
-    tmp.write_text(json.dumps(obj, indent=2))
-    os.replace(tmp, out)
-
-
 def _read_rows(out: Path):
     if not out.exists():
         return []
@@ -94,7 +87,7 @@ def _merge_rows(out: Path, rows):
     old = _read_rows(out)
     fresh = {r["name"]: r for r in rows}
     merged = [fresh.pop(r["name"], r) for r in old] + list(fresh.values())
-    _write_atomic(out, {"ts": time.time(), "rows": merged})
+    write_atomic(out, {"ts": time.time(), "rows": merged})
     return merged
 
 
@@ -110,7 +103,7 @@ def supervise_rows(names, out: Path, row_timeout: int):
     import subprocess
 
     if not out.exists():
-        _write_atomic(out, {"ts": time.time(), "rows": []})
+        write_atomic(out, {"ts": time.time(), "rows": []})
     for name in names:
         cmd = [sys.executable, __file__, "--only", name, "--row-timeout", "0"]
         t_start = time.time()
@@ -211,8 +204,7 @@ def main():
         # partial re-measure: merge by name instead of clobbering
         _merge_rows(out, rows)
     else:
-        out.write_text(json.dumps({"ts": time.time(), "rows": rows},
-                                  indent=2))
+        write_atomic(out, {"ts": time.time(), "rows": rows})
     print(f"wrote {out}")
 
 

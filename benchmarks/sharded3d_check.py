@@ -1,0 +1,60 @@
+"""512^3 sharded-1x1x1 no-regression check (VERDICT r2 item 7).
+
+The rank-aware fuse-depth cap (3D auto depth now clamps at _KMAX_3D=8
+instead of borrowing the 2D _KMAX_2D=32) changes the exchange width the
+sharded backend picks for 3D shards. This measures the sharded backend
+at 512^3 on the degenerate 1x1x1 mesh — auto depth and the old depth-32
+request side by side — so the cap change is pinned to a measured
+improvement (or at least no regression) rather than a model.
+
+Writes benchmarks/sharded3d_check.json. Run on the real chip.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def measure(fuse_steps: int | None, n=512, steps=960):
+    from heat_tpu.backends.sharded import solve as sharded_solve
+    from heat_tpu.config import HeatConfig
+
+    cfg = HeatConfig(n=n, ndim=3, ntime=steps, dtype="float32",
+                     backend="sharded", mesh_shape=(1, 1, 1),
+                     sigma=1 / 6, fuse_steps=fuse_steps or 0)
+    res = sharded_solve(cfg, fetch=False, warm_exec=True,
+                        two_point_repeats=2)
+    tp = res.timing.points_per_s_two_point or res.timing.points_per_s
+    return {"fuse_steps_requested": fuse_steps or "auto",
+            "points_per_s": res.timing.points_per_s,
+            "points_per_s_two_point": tp,
+            "solve_s": res.timing.solve_s}
+
+
+def main():
+    import jax
+
+    out = Path(__file__).parent / "sharded3d_check.json"
+    from _util import write_atomic
+
+    rec = {"ts": time.time(), "platform": jax.default_backend(), "rows": []}
+
+    def flush():
+        write_atomic(out, rec)
+
+    for fuse in (None, 8, 32):  # auto (==8 after the cap), the cap, the old 2D-borrowed depth
+        row = measure(fuse)
+        rec["rows"].append(row)
+        print(f"sharded 512^3 1x1x1 fuse={row['fuse_steps_requested']}: "
+              f"{row['points_per_s_two_point']:.3e} pts/s two-point "
+              f"({row['solve_s']:.2f}s solve)", flush=True)
+        flush()
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,42 +1,65 @@
 #!/bin/bash
-# Outage recovery: probe the tunneled TPU every 5 min; on recovery run
-# the on-chip certification + the full benchmark suite. Used during the
-# round-2 6+ hour tunnel outage (see TROUBLESHOOTING.md "Outages") so
-# the chip work queue drains the moment the tunnel returns, with results
-# flushed to benchmarks/*.json as they land.
+# Outage recovery: drain the chip work queue across tunnel flaps.
+#
+# Round-3 lesson: the tunnel doesn't just go down and come back — it
+# FLAPS (12 min up at 03:46, wedged again by 03:58). A linear sweep
+# burns each phase's full timeout against a dead tunnel. So: probe
+# before every phase; when the tunnel is down, park in the wait loop
+# instead of consuming the queue. Phases write their artifacts
+# incrementally+atomically (collective_overhead.py, run_all.py), so a
+# mid-phase wedge costs only the un-flushed remainder.
+#
+# Queue order is value-per-minute: the bench rehearsal and the flagship
+# kernel A/Bs (VERDICT #2) first, correctness certification and the
+# long full-table refresh last.
 set -u
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
 export PYTHONPATH="$(cd "$(dirname "$0")/.." && pwd):${PYTHONPATH:-}"
 cd "$(dirname "$0")/.."
-for i in $(seq 1 "${PROBES:-48}"); do
-  if timeout 120 python -c "import jax; assert jax.devices()" 2>/dev/null; then
-    echo "=== TPU back at $(date); starting round-3 sweep"
-    echo "=== bench (driver artifact dry run)"
-    timeout 700 python bench.py
-    echo "=== collective_overhead (weak-scaling anchor)"
-    timeout 1800 python benchmarks/collective_overhead.py
-    echo "=== kernel variant checks"
-    timeout 1800 python benchmarks/kernel_lab.py check2d_rolled
-    timeout 1800 python benchmarks/kernel_lab.py checkthin
-    timeout 1800 python benchmarks/kernel_lab.py check3d_rolled
-    echo "=== fma A/B at the shipped tile"
-    timeout 2400 python benchmarks/kernel_lab.py bench2d_rolled_var fma 256,4096,16,128
-    echo "=== bf16native A/B"
-    timeout 2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16native 256,4096,16,128
-    echo "=== bf16fma A/B"
-    timeout 2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16fma 256,4096,16,128
-    echo "=== thin fma A/B at the 4096^2 headline tile"
-    timeout 2400 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolledfma,256,16
-    echo "=== 3D fma A/B at the shipped 512^3 plan"
-    timeout 2400 python benchmarks/kernel_lab.py bench3d_rolled_var f32 64,64,8,8
-    timeout 2400 python benchmarks/kernel_lab.py bench3d_rolled_var fma 64,64,8,8
-    echo "=== chip_check"; timeout 2400 python benchmarks/chip_check.py
-    echo "=== run_all";   timeout 5400 python benchmarks/run_all.py
-    echo "=== sweep done at $(date)"
-    exit 0
+
+DEADLINE=$(( $(date +%s) + ${BUDGET_S:-36000} ))
+
+probe() { timeout 120 python -c "import jax; assert jax.devices()" 2>/dev/null; }
+
+wait_up() {
+  until probe; do
+    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+      echo "=== budget exhausted waiting for tunnel at $(date)"; exit 1
+    fi
+    echo "tunnel down at $(date); waiting"
+    sleep 300
+  done
+}
+
+phase() {  # phase <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "=== budget exhausted before $name"; exit 1
   fi
-  echo "probe $i: still down at $(date)"
-  sleep 300
-done
-echo "gave up at $(date)"
-exit 1
+  wait_up
+  echo "=== $name start $(date)"
+  if timeout "$to" "$@"; then
+    echo "=== $name OK $(date)"
+  else
+    echo "=== $name FAILED rc=$? $(date)"
+  fi
+}
+
+phase bench                 700 python bench.py
+phase fma_ab               2400 python benchmarks/kernel_lab.py bench2d_rolled_var fma 256,4096,16,128
+phase bf16native_ab        2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16native 256,4096,16,128
+phase bf16fma_ab           2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16fma 256,4096,16,128
+phase f32_rolled_base      2400 python benchmarks/kernel_lab.py bench2d_rolled_var f32 256,4096,16,128
+phase collective_overhead  1800 python benchmarks/collective_overhead.py
+phase check2d_rolled       1800 python benchmarks/kernel_lab.py check2d_rolled
+phase checkthin            1800 python benchmarks/kernel_lab.py checkthin
+phase check3d_rolled       1800 python benchmarks/kernel_lab.py check3d_rolled
+phase thin_fma_ab          2400 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolledfma,256,16
+phase 3d_f32_ab            2400 python benchmarks/kernel_lab.py bench3d_rolled_var f32 64,64,8,8
+phase 3d_fma_ab            2400 python benchmarks/kernel_lab.py bench3d_rolled_var fma 64,64,8,8
+phase sharded3d_check      1800 python benchmarks/sharded3d_check.py
+phase chip_check           2400 python benchmarks/chip_check.py
+# must exceed run_all's supervised worst case: 5 rows x 1500 s row
+# timeout + per-child startup
+phase run_all              9000 python benchmarks/run_all.py
+echo "=== sweep done at $(date)"
