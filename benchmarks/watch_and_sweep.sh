@@ -51,6 +51,7 @@ phase bf16native_ab        2400 python benchmarks/kernel_lab.py bench2d_rolled_v
 phase bf16fma_ab           2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16fma 256,4096,16,128
 phase f32_rolled_base      2400 python benchmarks/kernel_lab.py bench2d_rolled_var f32 256,4096,16,128
 phase collective_overhead  1800 python benchmarks/collective_overhead.py
+phase exchange_lab         1800 python benchmarks/exchange_lab.py
 phase check2d_rolled       1800 python benchmarks/kernel_lab.py check2d_rolled
 phase checkthin            1800 python benchmarks/kernel_lab.py checkthin
 phase check3d_rolled       1800 python benchmarks/kernel_lab.py check3d_rolled
