@@ -157,17 +157,32 @@ def main():
 
     from heat_tpu.parallel.mesh import build_mesh
 
-    for kf in (1, 8):
-        cfg = HeatConfig(n=n, ntime=64, dtype="float32", backend="sharded",
-                         mesh_shape=(1, 1), fuse_steps=kf)
-        hmesh = build_mesh(cfg.ndim, cfg.mesh_shape)
-        seed, advance, crop = make_padded_carry_machinery(cfg, hmesh)
-        Tp = seed(jnp.zeros((n, n), jnp.float32))
-        compiled = advance.lower(Tp, 64).compile()
-        census = _census(compiled)
-        rec["variants"][f"real_advance_fuse{kf}"] = {"hlo": census}
-        print(f"real advance fuse={kf}: hlo={census}", flush=True)
-        write_atomic(out, rec)
+    steps = 64
+    for exchange in ("seq", "indep"):
+        for kf in (1, 8):
+            cfg = HeatConfig(n=n, ntime=steps, dtype="float32",
+                             backend="sharded", mesh_shape=(1, 1),
+                             fuse_steps=kf, exchange=exchange)
+            hmesh = build_mesh(cfg.ndim, cfg.mesh_shape)
+            seed, advance, crop = make_padded_carry_machinery(cfg, hmesh)
+            Tp = seed(jnp.zeros((n, n), jnp.float32))
+            compiled = advance.lower(Tp, steps).compile()
+            census = _census(compiled)
+            # the advance donates its carry, so two_point recycles buffers
+            # static step-count arg is baked into the executable; Tp is
+            # donated into the measurement (lowering didn't consume it) —
+            # a second seeded buffer would double resident padded state
+            rate, _ = two_point_rate(compiled, Tp, n * n * steps,
+                                     repeats=3)
+            del Tp
+            per_step = n * n / rate if rate else None
+            key = f"real_advance_{exchange}_fuse{kf}"
+            rec["variants"][key] = {"hlo": census,
+                                    "per_step_s": per_step}
+            print(f"real advance {exchange} fuse={kf}: "
+                  f"per-step {per_step * 1e6:9.1f} us  hlo={census}",
+                  flush=True)
+            write_atomic(out, rec)
     print(f"wrote {out}")
 
 

@@ -49,7 +49,7 @@ from ..config import HeatConfig
 from ..ops.pallas_stencil import (_NO_FREEZE, ftcs_multistep_bounded_pallas,
                                   pallas_available)
 from ..ops.stencil import accum_dtype_for, laplacian_interior
-from ..parallel.halo import halo_exchange, halo_pad
+from ..parallel.halo import halo_exchange, halo_exchange_indep, halo_pad
 from ..parallel.mesh import build_mesh, validate_divisible
 from ..runtime.logging import master_print
 from ..utils import jnp_dtype
@@ -83,6 +83,9 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
         and kernel_ok
     )
 
+    exchange_fn = (halo_exchange_indep if cfg.exchange == "indep"
+                   else halo_exchange)
+
     def padded_multi(padded: jax.Array, wpad: int, ksteps: int) -> jax.Array:
         """Exchange the width-``wpad`` ghost ring, then run ``ksteps`` <=
         wpad fused steps; input AND output are the full padded shard (the
@@ -90,7 +93,7 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
         every margin cell before anything reads them). This is the
         pad-free core: the padded-carry solve path calls it directly so
         the per-exchange pad+crop copy of the whole block disappears."""
-        padded0 = halo_exchange(
+        padded0 = exchange_fn(
             padded, axis_names, axis_sizes, bc_value,
             staged=staged, width=wpad, periodic=periodic,
         )
@@ -192,6 +195,10 @@ def make_parity_machinery(cfg: HeatConfig, mesh):
     staged = cfg.comm == "staged"
     periodic = cfg.bc == "periodic"
     n = cfg.n
+    # bit-identical formulations (tests/test_sharded.py pins it), so the
+    # literal update-then-swap ordering is preserved either way
+    exchange_fn = (halo_exchange_indep if cfg.exchange == "indep"
+                   else halo_exchange)
     spec = P(*axis_names)
     smap = functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
                              out_specs=spec, check_vma=False)
@@ -223,16 +230,16 @@ def make_parity_machinery(cfg: HeatConfig, mesh):
         new = jnp.where(_pinned_mask(padded), padded,
                         new.astype(padded.dtype))
         # ghost update AFTER the stencil — the literal :218 ``call swap()``
-        return halo_exchange(new, axis_names, axis_sizes, bc_value,
-                             staged=staged, width=1, periodic=periodic)
+        return exchange_fn(new, axis_names, axis_sizes, bc_value,
+                           staged=staged, width=1, periodic=periodic)
 
     def seed(T_owned: jax.Array, from_ic: bool) -> jax.Array:
         def body(local):
             padded = halo_pad(local, bc_value, 1)
             if from_ic:
-                padded = halo_exchange(padded, axis_names, axis_sizes,
-                                       bc_value, staged=staged, width=1,
-                                       periodic=periodic)
+                padded = exchange_fn(padded, axis_names, axis_sizes,
+                                     bc_value, staged=staged, width=1,
+                                     periodic=periodic)
             return padded
 
         return jax.jit(smap(body))(T_owned)

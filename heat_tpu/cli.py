@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--comm", choices=["direct", "staged"],
                      help="halo exchange: device-direct (CUDA-aware analog) "
                           "or host-staged (NO_AWARE analog)")
+    run.add_argument("--exchange", choices=["seq", "indep"],
+                     help="ghost-write formulation: axes chained (seq, "
+                          "reference-like) or all-independent (indep); "
+                          "bit-identical results")
     run.add_argument("--mesh", type=_parse_mesh,
                      help="device mesh shape, e.g. 4x2 (sharded backend)")
     run.add_argument("--virtual-devices", type=int, metavar="N",
@@ -149,9 +153,10 @@ def _apply_overrides(cfg: HeatConfig, args) -> HeatConfig:
     subcommand exposing a subset of run's flags (``plan``) reuses this
     instead of hand-rolling a drifting copy."""
     over = {}
-    for field in ("backend", "dtype", "ic", "bc", "ndim", "comm", "fuse_steps",
-                  "local_kernel", "heartbeat_every", "checkpoint_every",
-                  "checkpoint_dir", "profile_dir", "write_int"):
+    for field in ("backend", "dtype", "ic", "bc", "ndim", "comm", "exchange",
+                  "fuse_steps", "local_kernel", "heartbeat_every",
+                  "checkpoint_every", "checkpoint_dir", "profile_dir",
+                  "write_int"):
         v = getattr(args, field, None)
         if v is not None:
             over[field] = v
