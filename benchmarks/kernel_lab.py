@@ -12,6 +12,10 @@ import sys
 import time
 
 import jax
+
+if "--cpu" in sys.argv:  # interpret-mode checks during tunnel outages;
+    sys.argv.remove("--cpu")  # the env var alone is re-pinned by the
+    jax.config.update("jax_platforms", "cpu")  # site hook (TROUBLESHOOTING)
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
