@@ -22,6 +22,14 @@ are visible, not inferred:
 Run on chip: ``python benchmarks/exchange_lab.py [n]``; CPU smoke:
 ``python benchmarks/exchange_lab.py --smoke``. Writes
 benchmarks/exchange_lab.json (atomic, incremental).
+
+Findings so far (CPU census, 4x2 virtual mesh): the sequential exchange
+costs the compiled advance 3 copies/iteration (2 full-local-shard);
+``exchange="indep"`` removes one full-shape copy. The remaining
+full-shard copy is NOT exchange-related — a control with a pure
+stencil loop body (no exchange at all) shows the identical census, so
+it belongs to the fori_loop carry structure itself and no exchange
+reformulation can remove it.
 """
 
 from __future__ import annotations
