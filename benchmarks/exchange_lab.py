@@ -29,7 +29,13 @@ costs the compiled advance 3 copies/iteration (2 full-local-shard);
 full-shard copy is NOT exchange-related — a control with a pure
 stencil loop body (no exchange at all) shows the identical census, so
 it belongs to the fori_loop carry structure itself and no exchange
-reformulation can remove it.
+reformulation can remove it. Python-unrolling the fused-block loop was
+tried and REJECTED: a pure elementwise body unrolls to zero copies, but
+the real exchange+kernel body keeps one copy per unrolled block
+(executed-copy count unchanged from the while form), so the unroll only
+buys bigger programs. CPU censuses also understate the TPU picture —
+off-TPU the pallas kernel runs as inlined interpret HLO, not a Mosaic
+custom call — which is why the on-chip census rows below exist.
 """
 
 from __future__ import annotations
