@@ -1,0 +1,42 @@
+"""Compiled-HLO guard for the exchange formulations (round 3).
+
+The indep ghost-write formulation exists to eliminate a full-local-shard
+copy from the compiled multi-device advance (parallel/halo.py). This
+pins that property at compile level so a refactor can't silently
+reintroduce the copy: on the 4x2 virtual mesh the indep advance must
+carry strictly fewer full-shard copy ops than the seq one.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from heat_tpu.backends.sharded import make_padded_carry_machinery
+from heat_tpu.config import HeatConfig
+from heat_tpu.parallel.mesh import build_mesh
+
+
+def _full_shape_copies(txt: str, shape: str) -> int:
+    return len(re.findall(rf"=\s*{re.escape(shape)}\S*\s+copy\(", txt))
+
+
+@pytest.mark.parametrize("fuse", [1, 4])
+def test_indep_advance_has_fewer_fullshard_copies(fuse):
+    n = 64
+    mesh = build_mesh(2, (4, 2))
+    counts = {}
+    for exchange in ("seq", "indep"):
+        cfg = HeatConfig(n=n, ntime=8, dtype="float32", backend="sharded",
+                         mesh_shape=(4, 2), fuse_steps=fuse,
+                         exchange=exchange)
+        seed, advance, _ = make_padded_carry_machinery(cfg, mesh)
+        Tp = seed(jnp.zeros((n, n), jnp.float32))
+        txt = advance.lower(Tp, 8).compile().as_text()
+        # the padded local shard: (n/4 + 2w, n/2 + 2w)
+        w = fuse
+        shape = f"f32[{n // 4 + 2 * w},{n // 2 + 2 * w}]"
+        counts[exchange] = _full_shape_copies(txt, shape)
+    assert counts["indep"] < counts["seq"], counts
+    assert counts["indep"] <= 1, counts  # the one loop-structural copy
