@@ -37,7 +37,14 @@ phase() {  # phase <name> <timeout_s> <cmd...>
     echo "=== budget exhausted before $name"; exit 1
   fi
   wait_up
-  echo "=== $name start $(date)"
+  # clamp to the remaining budget: a phase must never run past the
+  # deadline — the driver's end-of-round bench needs the chip free
+  local remaining=$(( DEADLINE - $(date +%s) ))
+  if [ "$remaining" -lt 120 ]; then
+    echo "=== budget exhausted before $name"; exit 1
+  fi
+  [ "$to" -gt "$remaining" ] && to=$remaining
+  echo "=== $name start $(date) (timeout ${to}s)"
   if timeout "$to" "$@"; then
     echo "=== $name OK $(date)"
   else
