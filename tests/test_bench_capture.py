@@ -175,3 +175,19 @@ def test_worker_constants_match_library():
     assert bench.STEPS == benchmark.STEPS
     assert bench.REPEATS == benchmark.REPEATS
     assert bench.METRIC == benchmark.metric_name(bench.N)
+
+
+def test_error_line_carries_last_good(tmp_path, monkeypatch):
+    """Outage-era error lines attach the cached last real measurement
+    under last_good (timestamped) — never as the headline value."""
+    cache = tmp_path / "last_bench.json"
+    cache.write_text(json.dumps({"metric": bench.METRIC, "value": 1.5e11,
+                                 "measured_ts": 1785469590.0}))
+    monkeypatch.setattr(bench, "_LAST_GOOD", str(cache))
+    rec = json.loads(bench._error_line("backend gone"))
+    assert rec["value"] == 0.0 and rec["error"] == "backend gone"
+    assert rec["last_good"]["value"] == 1.5e11
+
+    monkeypatch.setattr(bench, "_LAST_GOOD", str(tmp_path / "missing.json"))
+    rec2 = json.loads(bench._error_line("backend gone"))
+    assert "last_good" not in rec2  # absent cache: plain error line
