@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: test bench bench-all bench-smoke chip-check weak-scaling \
-        collective-overhead native run viz clean
+        collective-overhead exchange-lab sharded3d-check sweep \
+        native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -26,6 +27,15 @@ weak-scaling:
 
 collective-overhead:   # measured anchor for the weak-scaling projection
 	$(PY) benchmarks/collective_overhead.py
+
+exchange-lab:          # where does the per-exchange cost go (HLO census)
+	$(PY) benchmarks/exchange_lab.py
+
+sharded3d-check:       # 512^3 sharded fuse-depth no-regression
+	$(PY) benchmarks/sharded3d_check.py
+
+sweep:                 # flap-tolerant full chip queue
+	bash benchmarks/watch_and_sweep.sh
 
 native:
 	$(MAKE) -C heat_tpu/io/native
