@@ -155,7 +155,11 @@ def _error_line(err: str) -> str:
     try:  # attach the last real chip measurement, clearly timestamped —
         # informative during an outage, never the headline value
         with open(_LAST_GOOD) as f:
-            rec["last_good"] = json.load(f)
+            cached = json.load(f)
+        # a stale cache from a different N/STEPS configuration must not
+        # ride along under this metric's error line
+        if isinstance(cached, dict) and cached.get("metric") == METRIC:
+            rec["last_good"] = cached
     except (OSError, json.JSONDecodeError):
         pass
     return json.dumps(rec)

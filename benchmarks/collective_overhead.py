@@ -109,12 +109,35 @@ def probe_chains(smoke: bool):
     return out
 
 
-def probe_exchange_delta(smoke: bool):
+def _auto_ks() -> tuple[int, ...]:
+    """Fuse depths for the exchange-delta sweep. Round 3's fuse=32 case
+    sat >25 min (compile cliff or tunnel wedge — unresolved then); 32 is
+    included only when compile_bisect.json has PROVEN its compile bounded
+    on this platform (VERDICT r3 #6 wants the {16,32} points for a
+    >=3-point t(k) fit; {1,8,16} alone already give three)."""
+    import json
+
+    base = (1, 8, 16)
+    try:
+        rows = json.loads(
+            (Path(__file__).parent / "compile_bisect.json").read_text()
+        )["rows"]
+        r32 = rows.get("32", {})
+        if "compile_s" in r32 and r32["compile_s"] < 600:
+            return base + (32,)
+    except (OSError, json.JSONDecodeError, KeyError):
+        pass
+    return base
+
+
+def probe_exchange_delta(smoke: bool, flush, rec: dict, ks=None):
     """Probe 3: the sharded backend's real per-exchange cost at mesh 1x1.
 
     Times the padded-carry advance at fuse depth k (one exchange per k
-    steps) for k in {1, 8, 16} over a fixed step count; the per-exchange
-    cost C falls out of t(k) = steps*(t_step + C/k) between k pairs."""
+    steps) over a fixed step count; the per-exchange cost C falls out of
+    t(k) = steps*(t_step + C/k). Each k's row flushes atomically the
+    moment it lands (a wedged deeper-k row must not void measured ones),
+    and the fit is refreshed after every row."""
     import numpy as np
 
     from heat_tpu.backends.sharded import solve as sharded_solve
@@ -122,12 +145,9 @@ def probe_exchange_delta(smoke: bool):
 
     n = 512 if smoke else 16384
     steps = 32 if smoke else 512
-    out = {}
+    out = rec.setdefault("exchange_delta", {})
     rates = {}
-    # k=16 (not 32): the round-3 sweep's fuse=32 case sat >25 min in
-    # Mosaic compile at this width and blew the phase timeout; {1,8,16}
-    # give the 1/k fit all the spread it needs
-    for k in (1, 8, 16):
+    for k in ks or _auto_ks():
         cfg = HeatConfig(n=n, ntime=steps, dtype="float32",
                          backend="sharded", mesh_shape=(1, 1), fuse_steps=k)
         res = sharded_solve(cfg, fetch=False, warm_exec=True,
@@ -135,22 +155,35 @@ def probe_exchange_delta(smoke: bool):
         tp = res.timing.points_per_s_two_point or res.timing.points_per_s
         rates[k] = tp
         out[f"fuse_{k}"] = {"points_per_s_two_point": tp,
-                            "solve_s": res.timing.solve_s}
+                            "solve_s": res.timing.solve_s,
+                            "compile_s": res.timing.compile_s}
         print(f"exchange_delta fuse={k}: {tp:.3e} pts/s", flush=True)
-    # t_step(k) = t_compute + C/k: least-squares over all measured k uses
-    # every paid-for data point and is less noise-sensitive than one pair
-    inv_k = np.asarray([1 / k for k in rates], float)
-    t_step = np.asarray([n * n / rates[k] for k in rates], float)
-    C, t_comp = np.polyfit(inv_k, t_step, 1)
-    out["per_exchange_s"] = float(C)
-    out["t_step_compute_s"] = float(t_comp)
-    print(f"per-exchange cost (1x1 mesh, no wire): {C * 1e6:.2f} us")
+        if len(rates) >= 2:
+            # t_step(k) = t_compute + C/k: least-squares over all measured
+            # k uses every paid-for point; refreshed per row so a later
+            # wedge still leaves the best fit money bought
+            inv_k = np.asarray([1 / k for k in rates], float)
+            t_step = np.asarray([n * n / rates[k] for k in rates], float)
+            C, t_comp = np.polyfit(inv_k, t_step, 1)
+            resid = t_step - (t_comp + C * inv_k)
+            out["per_exchange_s"] = float(C)
+            out["t_step_compute_s"] = float(t_comp)
+            out["fit_ks"] = sorted(rates)
+            out["fit_residuals_s"] = [float(r) for r in resid]
+        flush()
+    if "per_exchange_s" in out:
+        print(f"per-exchange cost (1x1 mesh, no wire): "
+              f"{out['per_exchange_s'] * 1e6:.2f} us over k={sorted(rates)}")
     return out
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny sizes, CPU-safe")
+    ap.add_argument("--ks", help="comma-separated fuse depths for the "
+                                 "exchange-delta probe (default: auto — "
+                                 "{1,8,16} + 32 iff compile_bisect proved "
+                                 "its compile bounded)")
     args = ap.parse_args()
     if args.smoke:
         import jax
@@ -173,7 +206,8 @@ def main():
 
     rec.update(probe_chains(args.smoke))
     flush()
-    rec["exchange_delta"] = probe_exchange_delta(args.smoke)
+    ks = tuple(int(s) for s in args.ks.split(",")) if args.ks else None
+    probe_exchange_delta(args.smoke, flush, rec, ks=ks)
     flush()
     print(f"wrote {out}")
 

@@ -1,0 +1,143 @@
+"""Bisect the fuse-depth compile cliff on the default sharded path.
+
+Round-3 open question (VERDICT r3 weak #3, memory `fuse32-compile-cliff`):
+the 16384^2 sharded fuse=32 case sat >25 min without completing — Mosaic
+compile cliff, or the tunnel wedge that hit at the same time? The auto
+depth planner (`fuse_depth_sharded`) picks k*=32 for exactly that config,
+so if it IS a compile cliff, the DEFAULT flagship run stalls.
+
+This lab answers it directly: for k in {8, 16, 20, 24, 28, 32} it times
+`advance.lower(...).compile()` of the real padded-carry flagship program
+(16384^2 f32, 1x1 mesh, 500-step chunk — byte-identical to what
+`run_all.py` row 3 compiles) in a per-k SUBPROCESS under a hard timeout,
+so a wedged compile costs one row, not the phase. Lowering uses a
+sharded ShapeDtypeStruct — no device buffers, no H2D: the row measures
+compile time alone (plus the tunnel's program-transfer cost, which the
+real user pays too).
+
+Each k runs against a FRESH compile cache dir by default (true cold
+compile; `--cache shared` measures the warm-cache behavior real reruns
+see). Rows land incrementally+atomically in benchmarks/compile_bisect.json.
+
+Run on chip: ``python benchmarks/compile_bisect.py``
+CPU smoke (interpret-mode, validates the harness only):
+``python benchmarks/compile_bisect.py --smoke``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+N = 16384
+STEPS = 500  # run_all row 3's chunk: drive compiles the whole solve as one
+KS = (8, 16, 20, 24, 28, 32)
+
+
+def child(k: int, n: int, steps: int, smoke: bool) -> None:
+    if smoke:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from heat_tpu.backends.sharded import make_padded_carry_machinery
+    from heat_tpu.config import HeatConfig
+    from heat_tpu.parallel.mesh import build_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = HeatConfig(n=n, ntime=steps, dtype="float32", backend="sharded",
+                     mesh_shape=(1, 1), fuse_steps=k)
+    mesh = build_mesh(cfg.ndim, cfg.mesh_shape)
+    _, advance, _ = make_padded_carry_machinery(cfg, mesh)
+    padded = jax.ShapeDtypeStruct(
+        (n + 2 * k, n + 2 * k), "float32",
+        sharding=NamedSharding(mesh, P(*mesh.axis_names)))
+    t0 = time.perf_counter()
+    lowered = advance.lower(padded, steps)
+    t_lower = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    lowered.compile()
+    t_compile = time.perf_counter() - t0
+    print(json.dumps({"k": k, "lower_s": t_lower, "compile_s": t_compile,
+                      "platform": jax.default_backend()}), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU interpret mode, tiny size (harness check)")
+    ap.add_argument("--child", type=int, help="run one k inline (internal)")
+    ap.add_argument("--timeout", type=int, default=900,
+                    help="seconds per k before the row is declared wedged")
+    ap.add_argument("--cache", choices=("fresh", "shared"), default="fresh",
+                    help="fresh: cold-compile each k in its own cache dir; "
+                         "shared: reuse /tmp/jax_cache (warm behavior)")
+    ap.add_argument("--ks", default=",".join(str(k) for k in KS))
+    args = ap.parse_args()
+
+    n = 512 if args.smoke else N
+    steps = 32 if args.smoke else STEPS
+    if args.child is not None:
+        child(args.child, n, steps, args.smoke)
+        return
+
+    from _util import write_atomic
+
+    out = Path(__file__).parent / (
+        "compile_bisect_smoke.json" if args.smoke else "compile_bisect.json")
+    rec = {"ts": time.time(), "n": n, "steps": steps, "cache": args.cache,
+           "timeout_s": args.timeout, "rows": {}}
+
+    for k in (int(s) for s in args.ks.split(",")):
+        env = dict(os.environ)
+        tmp = None
+        if args.cache == "fresh":
+            tmp = tempfile.mkdtemp(prefix=f"jax_cache_bisect_k{k}_")
+            env["JAX_COMPILATION_CACHE_DIR"] = tmp
+        else:
+            env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+        cmd = [sys.executable, __file__, "--child", str(k)]
+        if args.smoke:
+            cmd.append("--smoke")
+        t0 = time.time()
+        try:
+            p = subprocess.run(cmd, timeout=args.timeout, env=env,
+                               capture_output=True, text=True)
+            row = None
+            for line in reversed((p.stdout or "").strip().splitlines()):
+                try:
+                    row = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            if row is None:
+                tail = ((p.stderr or "") + (p.stdout or "")).splitlines()[-3:]
+                row = {"k": k, "error": f"rc={p.returncode}: "
+                       + " | ".join(tail)}
+        except subprocess.TimeoutExpired:
+            row = {"k": k, "error": f"WEDGED: no compile within "
+                   f"{args.timeout}s (killed)"}
+        row["wall_s"] = time.time() - t0
+        rec["rows"][str(k)] = row
+        msg = (f"compile k={k}: " +
+               (f"lower {row['lower_s']:.1f}s compile {row['compile_s']:.1f}s"
+                if "compile_s" in row else row["error"]))
+        print(msg, flush=True)
+        write_atomic(out, rec)
+        if tmp:
+            subprocess.run(["rm", "-rf", tmp], check=False)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -159,7 +159,9 @@ def main():
             per_call_s = padded.size / rate if rate else None
             rec["variants"][label] = {"hlo": census,
                                       "per_exchange_s": per_call_s}
-            print(f"{label:8s} per-exchange {per_call_s * 1e6:9.1f} us  "
+            per_call_us = (f"{per_call_s * 1e6:9.1f} us"
+                           if per_call_s is not None else "      n/a")
+            print(f"{label:8s} per-exchange {per_call_us}  "
                   f"hlo={census}", flush=True)
             write_atomic(out, rec)
 
@@ -193,8 +195,10 @@ def main():
             key = f"real_advance_{exchange}_fuse{kf}"
             rec["variants"][key] = {"hlo": census,
                                     "per_step_s": per_step}
+            per_step_us = (f"{per_step * 1e6:9.1f} us"
+                           if per_step is not None else "      n/a")
             print(f"real advance {exchange} fuse={kf}: "
-                  f"per-step {per_step * 1e6:9.1f} us  hlo={census}",
+                  f"per-step {per_step_us}  hlo={census}",
                   flush=True)
             write_atomic(out, rec)
     print(f"wrote {out}")

@@ -13,9 +13,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
+
+# per-row subprocess isolation (supervise_rows) re-imports jax in every
+# child; a persistent compile cache keeps that to a cache hit instead of a
+# full recompile — set here so direct invocations get it, not only runs
+# launched via watch_and_sweep.sh
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent))

@@ -39,4 +39,8 @@ def test_indep_advance_has_fewer_fullshard_copies(fuse):
         shape = f"f32[{n // 4 + 2 * w},{n // 2 + 2 * w}]"
         counts[exchange] = _full_shape_copies(txt, shape)
     assert counts["indep"] < counts["seq"], counts
+    # absolute pin on the CPU XLA pipeline's copy elision, calibrated
+    # against jax 0.9.x / jaxlib 0.9.x — if a jax upgrade trips this while
+    # the relative assertion above still holds, the source didn't regress;
+    # re-calibrate the pin against the new compiler
     assert counts["indep"] <= 1, counts  # the one loop-structural copy
