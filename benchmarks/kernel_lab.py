@@ -26,7 +26,21 @@ from jax.experimental.pallas import tpu as pltpu
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
-VMEM_LIMIT = 110 * 1024 * 1024
+import pathlib
+
+if str(pathlib.Path(__file__).resolve().parent.parent) not in sys.path:
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from heat_tpu import machine  # noqa: E402
+
+# the framework's Mosaic VMEM ceiling for this chip — lab kernels must
+# compile under the SAME limit as ops/pallas_stencil.py or lab-measured
+# feasibility doesn't transfer to the planner these sweeps tune
+VMEM_LIMIT = machine.current().vmem_limit_bytes
+
+
+def _roof(dtype) -> float:
+    """One-pass HBM roofline for the current chip class (heat_tpu.machine)."""
+    return machine.current().roofline_points_per_s(dtype)
 
 
 def _round_up(x, m):
@@ -307,9 +321,10 @@ def bench_3d_rolled(configs, n3=512, steps=240, variant="f32"):
             compile_s = time.perf_counter() - t0
             nsteps = (steps // min(k, km)) * min(k, km)
             pts, pts_raw = measure_rate(c, dev, n3 ** 3 * nsteps)
+            roof = _roof("float32")
             print(f"rolled {variant} R={R:4d} M={M:4d} k={k} km={km}: "
-                  f"{pts:.3e} pts/s  ({pts / 1.024e11 * 100:.0f}% roofline; "
-                  f"raw {pts_raw / 1.024e11 * 100:.0f}%)"
+                  f"{pts:.3e} pts/s  ({pts / roof * 100:.0f}% roofline; "
+                  f"raw {pts_raw / roof * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
             print(f"rolled {variant} R={R:4d} M={M:4d} k={k} km={km}: FAILED "
@@ -479,7 +494,7 @@ def bench_thin2d_variants(n2, dtype, configs, steps=64):
             compile_s = time.perf_counter() - t0
             nsteps = (steps // k) * k
             pts, pts_raw = measure_rate(c, dev, n2 * n2 * nsteps)
-            roof = 2.048e11 if dtype == "bfloat16" else 1.024e11
+            roof = _roof(dtype)
             print(f"{variant:10s} tile={tile:4d} kpad={kpad}: {pts:.3e} "
                   f"pts/s ({pts / roof * 100:.0f}% {dtype} roofline; raw "
                   f"{pts_raw / roof * 100:.0f}%)"
@@ -760,7 +775,7 @@ def bench_2d_rolled(configs, n2=32768, dtype="bfloat16", steps=96,
             compile_s = time.perf_counter() - t0
             nsteps = (steps // k) * k
             pts, pts_raw = measure_rate(c, dev, n2 * n2 * nsteps)
-            roof = 2.048e11 if dtype == "bfloat16" else 1.024e11
+            roof = _roof(dtype)
             print(f"rolled {variant} R={R:4d} C={C:6d} kr={kr} kc={kc}: "
                   f"{pts:.3e} pts/s ({pts / roof * 100:.0f}% {dtype} "
                   f"roofline; raw {pts_raw / roof * 100:.0f}%)"
@@ -823,7 +838,7 @@ def bench_2d(configs, n2=32768, dtype="bfloat16", steps=96):
             compile_s = time.perf_counter() - t0
             nsteps = (steps // k) * k
             pts, pts_raw = measure_rate(c, dev, n2 * n2 * nsteps)
-            roof = 2.048e11 if dtype == "bfloat16" else 1.024e11
+            roof = _roof(dtype)
             print(f"R={R:4d} C={C:6d} kr={kr} kc={kc}: {pts:.3e} pts/s "
                   f"({pts / roof * 100:.0f}% {dtype} roofline; raw "
                   f"{pts_raw / roof * 100:.0f}%)"
@@ -881,7 +896,7 @@ def bench_framework(cases):
             nsteps = (steps // ksteps) * ksteps
             pts, pts_raw = measure_rate(c, dev,
                                         float(np.prod(shape)) * nsteps)
-            roof = 819e9 / (2 * dt.itemsize)
+            roof = _roof(dt)
             print(f"{label:28s} plan={plan}: {pts:.3e} pts/s "
                   f"({pts / roof * 100:.0f}% roofline; raw single-call "
                   f"{pts_raw:.3e} = {pts_raw / roof * 100:.0f}%) [compile "
@@ -966,9 +981,10 @@ def bench_3d(configs):
             compile_s = time.perf_counter() - t0
             nsteps = (steps // min(k, km)) * min(k, km)
             pts, pts_raw = measure_rate(c, dev, n3 ** 3 * nsteps)
+            roof = _roof("float32")
             print(f"R={R:4d} M={M:4d} k={k} km={km}: "
-                  f"{pts:.3e} pts/s  ({pts / 1.024e11 * 100:.0f}% roofline; "
-                  f"raw {pts_raw / 1.024e11 * 100:.0f}%)"
+                  f"{pts:.3e} pts/s  ({pts / roof * 100:.0f}% roofline; "
+                  f"raw {pts_raw / roof * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
             print(f"R={R:4d} M={M:4d} k={k} km={km}: FAILED "

@@ -29,12 +29,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 from _util import write_atomic  # noqa: E402
 
-HBM_BYTES_PER_S = 819e9  # v5e; v5p would be ~2.76e12
-
 
 def bench_one(name, cfg, repeat=1):
     import jax
 
+    from heat_tpu import machine
     from heat_tpu.backends import solve
 
     # fetch=False: ICs build on device and the final field never crosses the
@@ -52,10 +51,11 @@ def bench_one(name, cfg, repeat=1):
         r = solve(cfg, fetch=False, warm_exec=True, two_point_repeats=2)
         if r.timing.solve_s < best.solve_s:
             best = r.timing
-    itemsize = {"float64": 8, "float32": 4, "bfloat16": 2}[cfg.dtype]
-    roofline = HBM_BYTES_PER_S / (2 * itemsize)
+    chip = machine.current()
+    roofline = chip.roofline_points_per_s(cfg.dtype)
     tp = best.points_per_s_two_point
     row = {
+        "baseline_chip": chip.label,
         "name": name,
         "measured_ts": time.time(),  # per-row: partial --only re-measures
                                      # merge into older rows (see main)

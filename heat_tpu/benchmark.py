@@ -19,7 +19,6 @@ from __future__ import annotations
 N = 4096
 STEPS = 8192
 REPEATS = 3
-ROOFLINE_POINTS_PER_S = 1.024e11
 
 
 def metric_name(n: int = N) -> str:
@@ -50,11 +49,17 @@ def headline_measure(n: int = N, steps: int = STEPS,
     # advance donates its input, so two_point_rate recycles one buffer pair
     pts_per_s, raw = two_point_rate(compiled, x, n * n * steps,
                                     repeats=repeats)
+    from . import machine
+
+    chip = machine.current()
     return {
         "metric": metric_name(n),
         "value": pts_per_s,
         "unit": "points/s",
-        "vs_baseline": pts_per_s / ROOFLINE_POINTS_PER_S,
+        "vs_baseline": pts_per_s / chip.roofline_points_per_s("float32"),
         "raw_single_call": raw,
         "platform": platform,
+        # which chip class's one-pass HBM roofline vs_baseline divides by —
+        # "(uncalibrated)" = spec-derived table entry, not a fitted one
+        "baseline_chip": chip.label,
     }

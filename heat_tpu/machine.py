@@ -1,0 +1,145 @@
+"""Per-chip machine model: the one table of hardware constants.
+
+Round-3 verdict (weak #5): the planner/roofline constants were v5e
+values baked into three different modules — on a v5p the 2D/3D planners
+would pick measurably wrong geometry and ``vs_baseline`` would silently
+compare against the wrong chip's roofline (~3.4x pessimistic). This
+module centralizes them, keyed by ``jax.devices()[0].device_kind``.
+
+Calibration status matters and is carried per chip:
+
+- **v5e**: ``calibrated=True`` — every rate here is fitted from on-chip
+  measurements (rounds 1-3; see the derivation notes on each constant in
+  ops/pallas_stencil.py's round-3 history and BASELINE.md).
+- **v4 / v5p / v6e**: ``calibrated=False`` — HBM bandwidth is public
+  spec; the effective VPU rates are the v5e fitted rates scaled by the
+  public peak-compute ratio (a crude proxy: the VPU is not the MXU, so
+  treat planner geometry on these chips as a starting point and
+  recalibrate from a sweep). Roofline fractions on these chips are
+  labeled uncalibrated in bench output.
+
+The planner caches in ops/pallas_stencil.py key on shape/dtype only (the
+chip is fixed per process); tests that override the chip must register
+their planner caches here so ``override()`` can clear them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+_MIB = 1024 * 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipModel:
+    name: str                     # canonical short name ("v5e", ...)
+    hbm_bytes_per_s: float        # sustained HBM bandwidth
+    vpu_ops_per_s: float          # effective 2D stencil vector-op rate
+    ops_rate_3d: float            # effective 3D stencil op rate
+    vmem_limit_bytes: int         # Mosaic vmem_limit_bytes ceiling
+    vmem_fit_bytes: int           # planner feasibility bound (headroom)
+    band_budget_bytes: int        # 2D thin-band target footprint
+    coltiled_band_cap_bytes: int  # col-tiled band cap (compile sanity)
+    calibrated: bool              # True = rates fitted on this chip class
+
+    def roofline_points_per_s(self, dtype) -> float:
+        """Ideal one-pass-per-step HBM roofline: bytes/point/step =
+        2*itemsize (read + write), the bound no one-kernel-launch-per-step
+        design can exceed — BASELINE.md's vs_baseline denominator."""
+        import numpy as np
+
+        return self.hbm_bytes_per_s / (2 * np.dtype(dtype).itemsize)
+
+    @property
+    def label(self) -> str:
+        return self.name + ("" if self.calibrated else " (uncalibrated)")
+
+
+# v5e: all rates measured/fitted on the attached chip (rounds 1-3).
+V5E = ChipModel("v5e", hbm_bytes_per_s=819e9, vpu_ops_per_s=2.2e12,
+                ops_rate_3d=2.86e12, vmem_limit_bytes=110 * _MIB,
+                vmem_fit_bytes=88 * _MIB, band_budget_bytes=12 * _MIB,
+                coltiled_band_cap_bytes=10 * _MIB, calibrated=True)
+
+
+def _scaled(name: str, hbm: float, peak_ratio: float,
+            vmem_mib: int = 110) -> ChipModel:
+    """Spec-derived model: public HBM number; VPU rates = v5e fitted rates
+    x the public peak-compute ratio vs v5e (197 bf16 TFLOP/s)."""
+    return ChipModel(
+        name, hbm_bytes_per_s=hbm,
+        vpu_ops_per_s=V5E.vpu_ops_per_s * peak_ratio,
+        ops_rate_3d=V5E.ops_rate_3d * peak_ratio,
+        vmem_limit_bytes=vmem_mib * _MIB,
+        vmem_fit_bytes=(vmem_mib - 22) * _MIB,
+        band_budget_bytes=V5E.band_budget_bytes,
+        coltiled_band_cap_bytes=V5E.coltiled_band_cap_bytes,
+        calibrated=False)
+
+
+# public specs (jax-ml.github.io/scaling-book chip table): v4 1228 GB/s /
+# 275 bf16 TFLOP/s; v5p 2765 GB/s / 459; v6e (Trillium) 1640 GB/s / 918
+_CHIPS = {
+    "v5e": V5E,
+    "v5p": _scaled("v5p", 2765e9, 459 / 197),
+    "v4": _scaled("v4", 1228e9, 275 / 197),
+    "v6e": _scaled("v6e", 1640e9, 918 / 197),
+}
+
+# unknown device kinds (and CPU test runs) fall back to the v5e table —
+# the chip this repo is calibrated on — but report uncalibrated
+_DEFAULT = dataclasses.replace(V5E, calibrated=False)
+
+_override: Optional[str] = None
+_cache: Optional[ChipModel] = None
+_dependent_caches: list[Callable[[], None]] = []
+
+
+def classify(device_kind: str) -> ChipModel:
+    """Map a jax ``device_kind`` string to a chip model. Known spellings:
+    v5e reports "TPU v5 lite" / "TPU v5e"; v5p reports "TPU v5" / "TPU
+    v5p"; v4 "TPU v4"; v6e "TPU v6 lite" / "TPU v6e"."""
+    k = device_kind.lower().replace(" ", "")
+    if "v5e" in k or "v5lite" in k:
+        return _CHIPS["v5e"]
+    if "v5p" in k or k.endswith("v5"):
+        return _CHIPS["v5p"]
+    if "v6" in k or "trillium" in k:
+        return _CHIPS["v6e"]
+    if "v4" in k:
+        return _CHIPS["v4"]
+    return _DEFAULT
+
+
+def register_cache(clear: Callable[[], None]) -> None:
+    """Planner caches whose entries embed chip constants register their
+    cache_clear here; ``override()`` flushes them."""
+    _dependent_caches.append(clear)
+
+
+def current() -> ChipModel:
+    """The chip model for this process's default device (cached: the
+    attached chip cannot change mid-process; ``override`` for tests)."""
+    global _cache
+    if _override is not None:
+        return classify(_override)
+    if _cache is None:
+        import jax
+
+        try:
+            kind = jax.devices()[0].device_kind
+        except Exception:  # no backend at all: planner still needs numbers
+            return _DEFAULT
+        _cache = classify(kind) if jax.default_backend() == "tpu" else _DEFAULT
+    return _cache
+
+
+def override(device_kind: Optional[str]) -> None:
+    """Force the chip model (tests / what-if planning). ``None`` restores
+    autodetection. Flushes registered planner caches either way."""
+    global _override, _cache
+    _override = device_kind
+    _cache = None
+    for clear in _dependent_caches:
+        clear()

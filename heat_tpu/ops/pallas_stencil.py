@@ -54,22 +54,33 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import machine
 from .stencil import (accum_dtype_for, ftcs_step_edges, ftcs_step_ghost,
                       ftcs_step_periodic)
 
-# VMEM ceiling passed to Mosaic; band sizing below stays well under it so
-# the unrolled mini-step chain's live temporaries fit alongside the
-# double-buffered pipeline. 110 MiB (of the chip's 128): the 3D plan's
-# 512^3 (64,64,k=8) winner measures 102.05 MiB scoped demand — a 100 MiB
-# ceiling rejects it at compile time (measured; the planner's _fits_vmem
-# estimate runs ~20 MiB below Mosaic's true stack demand).
-_VMEM_LIMIT_BYTES = 110 * 1024 * 1024
-# target in-kernel band footprint (accumulation dtype); measured on v5e:
-# 6 MiB caps 32768^2 bf16 at 69 Gpts/s (16-row tiles, 3x halo-compute
-# overhead), 12 MiB doubles it to 135 Gpts/s (64-row tiles)
-_BAND_BUDGET_BYTES = 12 * 1024 * 1024
+# Chip-dependent constants (VMEM ceilings, band budgets, fitted op/HBM
+# rates for the cost models) live in heat_tpu.machine, selected by
+# device_kind — v5e values are measured, other chips spec-derived. The
+# derivation notes for the v5e numbers:
+# - vmem_limit 110 MiB (of the chip's 128): the 3D plan's 512^3
+#   (64,64,k=8) winner measures 102.05 MiB scoped demand; a 100 MiB
+#   ceiling rejects it at compile time (the planner's _fits_vmem estimate
+#   runs ~20 MiB below Mosaic's true stack demand).
+# - band_budget 12 MiB: 6 MiB caps 32768^2 bf16 at 69 Gpts/s (16-row
+#   tiles, 3x halo overhead); 12 MiB doubles it to 135 Gpts/s.
+# - vpu_ops 2.2e12: backed out of overhead-corrected on-chip runs (rolled
+#   col-tiled bf16 32768^2 at 512x4096 = 1.89e11 pts/s x ~12.4 ops/pt
+#   ~= 2.3e12; thin-band 4096^2 f32 ~= 2.0e12; midpoint).
+# - ops_rate_3d 2.86e12: fit from the 512^3 sweep with ADDITIVE
+#   compute+bandwidth cost (max() mispicked k=2 at 68% roofline over k=8
+#   at 112%); (R=64,M=64) k=4/k=8 rates match within 1%.
+# - coltiled_band_cap 10 MiB: bands past it send Mosaic compiles from
+#   ~1 min (256-row tiles) to 5 min (512) to >12 min (1024 rows).
+_chip = machine.current
+
 # per-pass fusion cap: halo rows (and compile-time unroll) stay bounded;
-# measured throughput is flat past 16
+# measured throughput is flat past 16. Architectural (dependency-cone /
+# unroll bound), not a per-chip rate — stays module-level.
 _KMAX_2D = 32
 # 3D per-pass fusion cap: the (row,mid)-tiled kernel's band pays a 2k
 # margin on BOTH non-lane axes, so deep unrolls blow the VMEM band budget
@@ -101,7 +112,7 @@ def _tile_2d(n_pad: int, kpad: int) -> int:
     """Row-tile height: a multiple of kpad (so halo blocks index evenly),
     sized to keep the (tile + 2*kpad)-row band near the budget (the band is
     held in the f32 accumulation dtype regardless of storage dtype)."""
-    cap = _BAND_BUDGET_BYTES // (n_pad * 4) - 2 * kpad
+    cap = _chip().band_budget_bytes // (n_pad * 4) - 2 * kpad
     tile = min(256, max(cap, kpad))
     return max(kpad, (tile // kpad) * kpad)
 
@@ -183,7 +194,7 @@ def _pallas_2d(T: jax.Array, r: float, ksteps: int,
         ],
         out_specs=main(lambda i: (i, 0)),
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            vmem_limit_bytes=_chip().vmem_limit_bytes,
         ),
         cost_estimate=pl.CostEstimate(
             flops=9 * (tile + 2 * kpad) * grid[0] * n_pad * ksteps,
@@ -211,33 +222,19 @@ def _pallas_2d(T: jax.Array, r: float, ksteps: int,
 # --------------------------------------------------------------------------
 
 
-# v5e machine balance for the plans' cost model: effective vector-op rate
-# backed out of overhead-corrected on-chip measurements (dispatch+sync over
-# the tunnel costs ~0.15 s/measurement; two-point timing cancels it):
-# rolled col-tiled bf16 32768^2 at 512x4096 tile = 1.89e11 pts/s x ~12.4
-# ops/pt-step ~= 2.3e12; thin-band 4096^2 f32 ~= 2.0e12. Use the midpoint.
-_VPU_OPS_PER_S = 2.2e12
-# 3D kernel's effective op rate, fit from the 512^3 sweep with ADDITIVE
-# compute+bandwidth cost (the max() model mispicked k=2 at 68% roofline
-# over k=8 at 112%): measured (R=64,M=64) family k=4/k=8 rates match
-# 13*band/tile / 2.86e12 + (band+tile)*4/(tile*k)/819e9 within 1%
-_OPS_RATE_3D = 2.86e12
-_HBM_BYTES_PER_S = 819e9
-# col-tiled bands above ~10 MiB (accumulation dtype) send Mosaic compiles
-# from ~1 min (256-row tiles) to 5 min (512 rows, measured 92% roofline)
-# to >12 min (1024 rows) — cap the search there; the modeled gain past it
-# is <4% while compile time doubles
-_COLTILED_BAND_CAP_BYTES = 10 * 1024 * 1024
-# VMEM feasibility for the 3x3 scheme: double-buffered in/out blocks in the
-# storage dtype + the assembled band and its mini-step temporaries in the
-# accumulation dtype must fit under the Mosaic limit with headroom
-_VMEM_FIT_BYTES = 88 * 1024 * 1024
+# cost-model rates and caps come from the per-chip table (see the
+# derivation block at the top of this module); the planner caches below
+# embed them, so machine.override() must flush those caches — they
+# register with machine.register_cache at the bottom of this module
 
 
 def _fits_vmem(band_cells: int, tile_cells: int, item: int) -> bool:
+    # VMEM feasibility for the 3x3 scheme: double-buffered in/out blocks in
+    # the storage dtype + the assembled band and its mini-step temporaries
+    # in the accumulation dtype must fit under the Mosaic limit w/ headroom
     pipeline = 2 * (band_cells + tile_cells) * item
     working = 3 * band_cells * 4  # band + ~2 live temps, accumulation dtype
-    return pipeline + working <= _VMEM_FIT_BYTES
+    return pipeline + working <= _chip().vmem_fit_bytes
 
 
 def _grid_specs_3x3(shape_blocks, halo_blocks, nblocks, extra_dims):
@@ -300,6 +297,7 @@ def _plan_3d(shape, dtype_str, ksteps: int):
     sub = _sublane(dtype_str)
     n_pad = _round_up(max(n, 128), 128)
     item = jnp.dtype(dtype_str).itemsize
+    chip = _chip()
     best = None
     for k in range(1, min(max(ksteps, 1), _KMAX_3D) + 1):
         km = _round_up(k, sub)
@@ -314,8 +312,8 @@ def _plan_3d(shape, dtype_str, ksteps: int):
                 tile = R * M
                 if not _fits_vmem(band * n_pad, tile * n_pad, item):
                     continue
-                compute = 13.0 * band / tile / _OPS_RATE_3D
-                bw = (band + tile) * item / (tile * k) / _HBM_BYTES_PER_S
+                compute = 13.0 * band / tile / chip.ops_rate_3d
+                bw = (band + tile) * item / (tile * k) / chip.hbm_bytes_per_s
                 # cost per LOGICAL point: alignment padding is computed then
                 # discarded (R=70 on a 512-row grid pads 9% dead rows)
                 pad = (_round_up(max(m, R), R) * _round_up(max(mid, M), M)
@@ -412,7 +410,7 @@ def _pallas_3d_aligned(Tp: jax.Array, r: float, ksteps: int, kplan: int,
         in_specs=[smem] + in_specs,
         out_specs=out_spec,
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            vmem_limit_bytes=_chip().vmem_limit_bytes,
         ),
         cost_estimate=pl.CostEstimate(
             flops=13 * band * n_pad * grid[0] * grid[1] * ksteps,
@@ -439,15 +437,16 @@ def _plan_2d(shape, dtype_str, ksteps: int):
     item = jnp.dtype(dtype_str).itemsize
     sub = _sublane(dtype_str)
     n_pad = _round_up(max(n, 128), 128)
+    chip = _chip()
 
     def cost_thin(k):
-        # additive compute+bandwidth, like the 3D model (_OPS_RATE_3D
+        # additive compute+bandwidth, like the 3D model (ops_rate_3d
         # note): measured thin 4096^2 f32 = 6.2e-12 s/pt-step; additive
         # predicts 6.16e-12 where max() says 5.63e-12
         kpad = _halo_2d(k, dtype_str)
         tile = _tile_2d(n_pad, kpad)
-        compute = 11.0 * (tile + 2 * kpad) / tile / _VPU_OPS_PER_S
-        bw = (2.0 * tile + 2 * kpad) * item / (tile * k) / _HBM_BYTES_PER_S
+        compute = 11.0 * (tile + 2 * kpad) / tile / chip.vpu_ops_per_s
+        bw = (2.0 * tile + 2 * kpad) * item / (tile * k) / chip.hbm_bytes_per_s
         return compute + bw
 
     k_thin = min(max(ksteps, 1), _KMAX_2D)
@@ -467,10 +466,13 @@ def _plan_2d(shape, dtype_str, ksteps: int):
                 tile = R * C
                 if not _fits_vmem(band, tile, item):
                     continue
-                if band * 4 > _COLTILED_BAND_CAP_BYTES:  # compile sanity
+                # compile sanity: bands past the cap send Mosaic compiles
+                # from ~1 min to 5 min (512 rows) to >12 min (1024 rows);
+                # the modeled gain past it is <4%
+                if band * 4 > chip.coltiled_band_cap_bytes:
                     continue
-                compute = 11.0 * band / tile / _VPU_OPS_PER_S
-                bw = (band + tile) * item / (tile * k) / _HBM_BYTES_PER_S
+                compute = 11.0 * band / tile / chip.vpu_ops_per_s
+                bw = (band + tile) * item / (tile * k) / chip.hbm_bytes_per_s
                 key = (compute + bw, band, -k)
                 if best_col is None or key < best_col[0]:
                     best_col = (key, R, C, kr, kc, k)
@@ -548,7 +550,7 @@ def _pallas_2d_coltiled(Tp: jax.Array, r: float, ksteps: int, R: int, C: int,
         in_specs=[smem] + in_specs,
         out_specs=out_spec,
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_VMEM_LIMIT_BYTES,
+            vmem_limit_bytes=_chip().vmem_limit_bytes,
         ),
         cost_estimate=pl.CostEstimate(
             flops=11 * band * grid[0] * grid[1] * ksteps,
@@ -769,3 +771,9 @@ def ftcs_multistep_ghost_pallas(T: jax.Array, r: float, bc_value, ksteps: int) -
     for _ in range(ksteps):
         out = ftcs_step_ghost(out, r, bc_value)
     return out
+
+
+# the plan caches embed the chip's rates/caps in their values; a chip-model
+# override (tests, what-if planning) must flush them
+machine.register_cache(_plan_2d.cache_clear)
+machine.register_cache(_plan_3d.cache_clear)

