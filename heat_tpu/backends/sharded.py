@@ -33,6 +33,8 @@ BC semantics:
 from __future__ import annotations
 
 import functools
+import os
+import threading
 from typing import Optional
 
 import jax
@@ -294,17 +296,158 @@ def _finalize_carried(cfg: HeatConfig, res, crop, fetch: bool):
     return res
 
 
+# measured-safe auto fuse depth: k=16 compiles in ~1 min at 16384^2 and
+# still lands 98% of the one-pass roofline (fuse_depth_sharded docstring);
+# the k*=32 auto pick is worth ~14% more but is the depth the round-3
+# sweep saw stall >25 min in compile (cause chip-gated — see
+# benchmarks/compile_bisect.py)
+_SAFE_FUSE = 16
+
+
+def _bounded_compile(fn, budget_s: float):
+    """Run ``fn`` (an XLA/Mosaic compile) in a daemon thread with a wall
+    budget. Returns (result, None) on success, (None, "timeout") when the
+    budget expires — the thread is left running (a C++ compile cannot be
+    interrupted from Python; it dies with the process or finishes into
+    the persistent compile cache). fn's exceptions propagate."""
+    box: dict = {}
+
+    def run():
+        try:
+            box["r"] = fn()
+        except BaseException as e:  # noqa: BLE001 — reraised below
+            box["e"] = e
+
+    t = threading.Thread(target=run, daemon=True, name="heat-compile-guard")
+    t.start()
+    t.join(budget_s)
+    if t.is_alive():
+        return None, "timeout"
+    if "e" in box:
+        raise box["e"]
+    return box.get("r"), None
+
+
+def _compile_probe(cfg: HeatConfig, mesh, kf: int, remaining: int,
+                   padded: bool) -> dict:
+    """AOT-compile every program drive() will run — each chunk size from
+    the SAME derivation drive uses (common.chunk_sizes: a remainder chunk
+    still unrolls the deep-fused kernel and is a distinct XLA program) —
+    on the path's actual global state shape. No device buffers, no data
+    transfer. Returns {chunk_size: compiled executable}; the caller hands
+    it to drive(precompiled=...) so the probe's work is never repeated."""
+    import jax as _jax
+
+    from .common import chunk_sizes
+
+    # belt and braces: also land the compiles in the persistent cache, so
+    # even an abandoned (timed-out) probe's eventual completion pays
+    # forward to a rerun
+    if not _jax.config.jax_compilation_cache_dir:
+        _jax.config.update("jax_compilation_cache_dir",
+                           os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                          "/tmp/jax_cache"))
+    if padded:
+        _, advance, _ = make_padded_carry_machinery(cfg, mesh)
+        shape = tuple(cfg.n + 2 * kf * int(s) for s in mesh.devices.shape)
+    else:
+        advance = make_advance(cfg, mesh)
+        shape = cfg.shape
+    struct = jax.ShapeDtypeStruct(
+        shape, jnp_dtype(cfg.dtype),
+        sharding=NamedSharding(mesh, P(*mesh.axis_names)))
+    return {k: advance.lower(struct, k).compile()
+            for k in chunk_sizes(cfg, remaining)}
+
+
+def _agree_any_timeout(timed_out: bool) -> bool:
+    """Multi-process agreement on the guard verdict: every process must
+    run the SAME advance program (different fuse depths mean different
+    halo widths and different collective sequences — a mismatched SPMD
+    program hangs the job), so if ANY process's probe timed out, all fall
+    back together. Mirrors _agree_resume_step's minimum rule."""
+    if jax.process_count() <= 1:
+        return timed_out
+    from jax.experimental import multihost_utils
+
+    flags = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(int(timed_out), jnp.int32)))
+    agreed = bool(flags.max())
+    if agreed != timed_out:
+        master_print("compile guard: a peer process's probe timed out — "
+                     "falling back job-wide")
+    return agreed
+
+
+def _guard_platform_ok() -> bool:
+    """The guard only pays for itself where Mosaic compiles can cliff
+    (TPU); CPU interpret-mode 'compiles' are trivially bounded. A seam so
+    tests can force the guard on without patching jax.default_backend
+    globally (which would flip the kernels' interpret-mode detection)."""
+    return jax.default_backend() == "tpu"
+
+
+def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
+                        padded: bool = True):
+    """Bound the compile time of the AUTO-selected fuse depth.
+
+    The planner's k* (fuse_depth_sharded) is a throughput optimum with no
+    compile-cost term, and deep-unroll Mosaic compiles can cliff (the
+    col-tiled band cap note in ops/pallas_stencil.py documents minutes-to
+    ->12-minutes growth). A user running the default config must never
+    stall unboundedly in compile, so: when the depth was auto-picked and
+    exceeds the measured-safe depth, every program drive() will compile is
+    compiled under one wall budget (``HEAT_COMPILE_BUDGET_S``, default
+    600 s; 0 disables); on timeout the solve falls back to fuse_steps=16
+    with a loud warning, job-wide (_agree_any_timeout), and the abandoned
+    compile finishes into the persistent cache (a rerun gets k* for free
+    if it does complete). Explicit --fuse-steps is honored unguarded —
+    the user asked for that exact program.
+
+    Returns ``(cfg, precompiled)``: on success ``precompiled`` carries the
+    probe's executables for drive(precompiled=...), so the guard costs
+    zero extra compiles."""
+    try:
+        budget = float(os.environ.get("HEAT_COMPILE_BUDGET_S", "600"))
+    except ValueError:
+        budget = 600.0
+    kf = fuse_depth_sharded(cfg, mesh.devices.shape)
+    if (cfg.fuse_steps or budget <= 0 or kf <= _SAFE_FUSE
+            or remaining <= 0 or not _guard_platform_ok()):
+        return cfg, None
+    pre, err = _bounded_compile(
+        lambda: _compile_probe(cfg, mesh, kf, remaining, padded), budget)
+    if not _agree_any_timeout(err is not None):
+        return cfg, pre
+    fallback = max(1, min(_SAFE_FUSE, *(cfg.n // s
+                                        for s in mesh.devices.shape)))
+    master_print(
+        f"WARNING: auto fuse depth {kf} did not compile within {budget:.0f}s "
+        f"(HEAT_COMPILE_BUDGET_S); falling back to fuse_steps={fallback} "
+        f"(~87% of the k={kf} sustained throughput at flagship scale: "
+        f"k=16 lands 98% of the one-pass roofline vs 112% at k=32). The "
+        f"abandoned compile continues into the compile cache — a rerun may "
+        f"pick {kf} up instantly. Pass --fuse-steps {kf} to wait it out.")
+    return cfg.with_(fuse_steps=fallback), None
+
+
 def _solve_padded_carry(cfg: HeatConfig, T0, mesh, fetch: bool,
                         warm_exec: bool, two_point_repeats: int = 0):
     """Default sharded solve: padded-carry state (make_padded_carry_machinery)."""
+    cfg, pre = _guard_fuse_compile(cfg, mesh, cfg.ntime, padded=True)
     sharding = NamedSharding(mesh, P(*mesh.axis_names))
     T_owned, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
+    # start_step is always 0 here (checkpointed runs take the owned-state
+    # path), so the guard's probe — run before the field resolved — saw
+    # the right remaining count; were that ever to change, drive would
+    # just compile the uncovered remainder size itself (unguarded but
+    # correct)
     seed, advance, crop = make_padded_carry_machinery(cfg, mesh)
     Tp = seed(T_owned)
     del T_owned  # unpin the owned-field device buffer for the solve
     res = drive(cfg.with_(report_sum=False), Tp, advance,
                 start_step=start_step, fetch=False, warm_exec=warm_exec,
-                two_point_repeats=two_point_repeats)
+                two_point_repeats=two_point_repeats, precompiled=pre)
     return _finalize_carried(cfg, res, crop, fetch)
 
 
@@ -443,11 +586,17 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
         res = _solve_padded_carry(cfg, T0, mesh, fetch, warm_exec,
                                   two_point_repeats)
     else:
+        # owned-state path (checkpoint / numerics runs): same auto fuse
+        # depth, same deep-unroll kernel — guard it too, with the probe
+        # compiling THIS path's program (owned global shape, and a
+        # remaining count that respects checkpoint resume)
         sharding = NamedSharding(mesh, P(*mesh.axis_names))
         T, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
+        cfg, pre = _guard_fuse_compile(cfg, mesh, cfg.ntime - start_step,
+                                       padded=False)
         res = drive(cfg, T, make_advance(cfg, mesh), start_step=start_step,
                     fetch=fetch, warm_exec=warm_exec,
-                    two_point_repeats=two_point_repeats)
+                    two_point_repeats=two_point_repeats, precompiled=pre)
     res.mesh_shape = tuple(mesh.devices.shape)
     res.mesh = mesh
     return res

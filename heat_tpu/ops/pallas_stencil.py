@@ -289,7 +289,8 @@ def _plan_3d(shape, dtype_str, ksteps: int):
     """Choose ((m_pad, mid_pad, n_pad), R, M, kchunk) for the tiled 3D
     kernel: minimize (compute + bandwidth) per LOGICAL point-step —
     additive, not max(): measured, the two don't overlap enough (see
-    _OPS_RATE_3D) — scaled by the alignment-padding waste factor.
+    machine.ops_rate_3d derivation note at the top of this
+    module) — scaled by the alignment-padding waste factor.
     Ops/pt-step ~ 13 x band/tile area ratio (2 lane rotates + 2
     sublane-shifted reads + ~9 arithmetic; row-axis neighbor reads are
     addressing offsets)."""
@@ -319,7 +320,7 @@ def _plan_3d(shape, dtype_str, ksteps: int):
                 pad = (_round_up(max(m, R), R) * _round_up(max(mid, M), M)
                        / max(m * mid, 1))
                 # ADDITIVE cost (measured: compute and HBM streaming do not
-                # overlap enough for max() — see _OPS_RATE_3D note); ties
+                # overlap enough for max() — see the ops_rate_3d note); ties
                 # break toward deeper fusion
                 key = ((compute + bw) * pad, band, -k)
                 if best is None or key < best[0]:
