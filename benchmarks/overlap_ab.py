@@ -1,0 +1,96 @@
+"""A/B: exchange="overlap" vs "indep" on the attached chip (VERDICT r3 #5).
+
+Times the real sharded solve (padded-carry path, two-point protocol) at
+16384^2 f32 on the 1x1 mesh for each exchange mode and fuse depth. On a
+single chip the ppermute degenerates (no wire), so what this measures is
+the RESTRUCTURING cost/benefit: the interior/rim split's extra kernel
+launches + band recompute vs the shorter critical path (interior no
+longer waits on the exchange's select/DUS chain). The multi-chip overlap
+win (collective latency hidden behind interior compute) is validated for
+correctness by dryrun sub-check #12 and awaits multi-chip hardware for
+measurement — this lab decides whether overlap SHIPS as a default
+(ship only if it at least ties on one chip: VERDICT r3 #5 "ship only if
+it wins").
+
+Fuse depths: 16 (the guard's safe depth) always; 32 added when
+compile_bisect.json has proven the deep compile bounded (same gate as
+collective_overhead).
+
+Run on chip: ``python benchmarks/overlap_ab.py``
+CPU smoke: ``python benchmarks/overlap_ab.py --smoke``
+Writes benchmarks/overlap_ab.json (atomic, incremental).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from _util import write_atomic  # noqa: E402
+
+
+def _ks() -> tuple[int, ...]:
+    try:
+        rows = json.loads(
+            (Path(__file__).parent / "compile_bisect.json").read_text()
+        )["rows"]
+        r32 = rows.get("32", {})
+        if "compile_s" in r32 and r32["compile_s"] < 600:
+            return (16, 32)
+    except (OSError, json.JSONDecodeError, KeyError):
+        pass
+    return (16,)
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    if smoke:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from heat_tpu.backends.sharded import solve as sharded_solve
+    from heat_tpu.config import HeatConfig
+
+    n = 512 if smoke else 16384
+    steps = 32 if smoke else 512
+    out = Path(__file__).parent / (
+        "overlap_ab_smoke.json" if smoke else "overlap_ab.json")
+    rec = {"ts": time.time(), "platform": jax.default_backend(),
+           "n": n, "steps": steps, "rows": {}}
+
+    for k in (4,) if smoke else _ks():
+        for exchange in ("indep", "overlap"):
+            cfg = HeatConfig(n=n, ntime=steps, dtype="float32",
+                             backend="sharded", mesh_shape=(1, 1),
+                             fuse_steps=k, exchange=exchange,
+                             local_kernel="pallas")
+            res = sharded_solve(cfg, fetch=False, warm_exec=True,
+                                two_point_repeats=2)
+            tp = (res.timing.points_per_s_two_point
+                  or res.timing.points_per_s)
+            rec["rows"][f"{exchange}_fuse{k}"] = {
+                "points_per_s_two_point": tp,
+                "solve_s": res.timing.solve_s,
+                "compile_s": res.timing.compile_s,
+            }
+            print(f"{exchange:8s} fuse={k:2d}: {tp:.3e} pts/s "
+                  f"(compile {res.timing.compile_s:.0f}s)", flush=True)
+            write_atomic(out, rec)
+        a = rec["rows"].get(f"indep_fuse{k}", {}).get(
+            "points_per_s_two_point")
+        b = rec["rows"].get(f"overlap_fuse{k}", {}).get(
+            "points_per_s_two_point")
+        if a and b:
+            print(f"fuse={k}: overlap/indep = {b / a:.3f}", flush=True)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,7 @@ phase bf16fma_ab           2400 python benchmarks/kernel_lab.py bench2d_rolled_v
 phase f32_rolled_base      2400 python benchmarks/kernel_lab.py bench2d_rolled_var f32 256,4096,16,128
 phase collective_overhead  2700 python benchmarks/collective_overhead.py
 phase exchange_lab         1800 python benchmarks/exchange_lab.py
+phase overlap_ab           2400 python benchmarks/overlap_ab.py
 phase compile_bisect_rest  4000 python benchmarks/compile_bisect.py --ks 8,16,20,24,28 --timeout 700
 phase sharded3d_check      1800 python benchmarks/sharded3d_check.py
 phase check2d_rolled       1800 python benchmarks/kernel_lab.py check2d_rolled

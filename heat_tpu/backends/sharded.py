@@ -35,6 +35,7 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -85,8 +86,35 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
         and kernel_ok
     )
 
-    exchange_fn = (halo_exchange_indep if cfg.exchange == "indep"
+    overlap = cfg.exchange == "overlap"
+    if overlap and not use_pallas:
+        raise ValueError(
+            "exchange='overlap' requires the Pallas local kernel (the "
+            "interior/rim split is built on the bounded multistep kernel); "
+            "use local_kernel='pallas', or exchange='indep'")
+    # overlap uses the indep ghost writes for the exchange itself (fewest
+    # full-shard copies, bit-identical — tests/test_sharded.py)
+    exchange_fn = (halo_exchange_indep if cfg.exchange in ("indep", "overlap")
                    else halo_exchange)
+
+    def _shard_bounds(padded_shape, wpad: int) -> list:
+        """Per-axis [lo, hi] freeze bounds in PADDED shard coordinates:
+        only global-domain edges freeze (Dirichlet ghosts, plus the
+        boundary ring under "edges" semantics); the wpad-cell discard
+        margin owns all array-edge garbage. Traced values (axis_index)."""
+        edges = 1 if cfg.bc == "edges" else 0
+        bounds = []
+        for d, name in enumerate(axis_names):
+            if periodic:  # torus: nothing frozen anywhere
+                bounds.extend([jnp.int32(-_NO_FREEZE),
+                               jnp.int32(_NO_FREEZE)])
+                continue
+            coord = jax.lax.axis_index(name)
+            M = padded_shape[d]
+            bounds.append(jnp.where(coord == 0, wpad - 1 + edges, -1))
+            bounds.append(jnp.where(coord == axis_sizes[d] - 1,
+                                    M - wpad - edges, M))
+        return bounds
 
     def padded_multi(padded: jax.Array, wpad: int, ksteps: int) -> jax.Array:
         """Exchange the width-``wpad`` ghost ring, then run ``ksteps`` <=
@@ -100,20 +128,7 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
             staged=staged, width=wpad, periodic=periodic,
         )
         if use_pallas:
-            # Only global-domain edges freeze (the bounds); the wpad-cell
-            # discard margin owns all array-edge garbage.
-            edges = 1 if cfg.bc == "edges" else 0
-            bounds = []
-            for d, name in enumerate(axis_names):
-                if periodic:  # torus: nothing frozen anywhere
-                    bounds.extend([jnp.int32(-_NO_FREEZE),
-                                   jnp.int32(_NO_FREEZE)])
-                    continue
-                coord = jax.lax.axis_index(name)
-                M = padded.shape[d]
-                bounds.append(jnp.where(coord == 0, wpad - 1 + edges, -1))
-                bounds.append(jnp.where(coord == axis_sizes[d] - 1,
-                                        M - wpad - edges, M))
+            bounds = _shard_bounds(padded.shape, wpad)
             return ftcs_multistep_bounded_pallas(
                 padded0, r, ksteps, jnp.stack(bounds).astype(jnp.int32))
 
@@ -158,6 +173,95 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
             p = mini_step(p)
         return p
 
+    def padded_multi_overlap(padded: jax.Array, wpad: int,
+                             ksteps: int) -> jax.Array:
+        """``padded_multi`` restructured so the halo exchange can fly
+        while the interior computes (SURVEY.md §7's "hard part"; VERDICT
+        r3 #5). Same contract, bit-identical owned values (pinned by
+        tests/test_overlap.py and dryrun sub-check #12).
+
+        The sequential form is exchange -> kernel: every cell waits on the
+        collectives. Here the fused block splits three ways:
+
+        1. **Interior** (owned cells >= wpad from the shard edge): their
+           ksteps<=wpad dependency cone reads only initial values of cells
+           at distance >= 0 — by the margin argument a cell at distance s
+           contributes only its step-(k-s) value, so distance-wpad cells
+           contribute initial values only and NO fresh ghost (and no
+           freeze mask) is ever consulted. Computed from the PRE-exchange
+           field: zero data dependency on the collectives, so XLA's
+           latency-hiding scheduler is free to hoist the ppermute starts
+           before it and sink the dones after it.
+        2. **Exchange**: the indep ghost-write formulation, unchanged.
+        3. **Rim bands** (owned cells < wpad from a shard face): per face,
+           a 3*wpad-deep input band — fresh ghosts + rim + support —
+           spanning the full extent of the other axes, run through the
+           same bounded kernel with face-offset bounds. Band-edge garbage
+           travels one cell per mini-step and never reaches the kept rim
+           (distance >= wpad >= ksteps), the same invariant as the
+           exchange itself.
+
+        Extra compute vs the fused form: the bands re-cover ~8*wpad/L of
+        the block (1.6% at 16384^2, wpad=32) plus 2*nd extra kernel
+        launches per block; the win is the exchange latency hidden behind
+        the interior pass. Kept-region writes are disjoint by
+        construction (earlier axes' bands own the corners)."""
+        w = wpad
+        nd = padded.ndim
+        Lp = padded.shape
+
+        def _set(out, src, dst_sl, src_sl):
+            # all slicing is static; skip degenerate spans (tiny shards)
+            if any(s.stop <= s.start for s in dst_sl):
+                return out
+            return out.at[tuple(dst_sl)].set(src[tuple(src_sl)])
+
+        # 1) interior, from the PRE-exchange field
+        owned = padded[tuple(slice(w, -w) for _ in range(nd))]
+        nofreeze = jnp.asarray([-_NO_FREEZE, _NO_FREEZE] * nd, jnp.int32)
+        interior = ftcs_multistep_bounded_pallas(owned, r, ksteps, nofreeze)
+        # 2) the exchange (the collectives the interior overlaps with)
+        padded0 = exchange_fn(
+            padded, axis_names, axis_sizes, bc_value,
+            staged=staged, width=w, periodic=periodic,
+        )
+        bounds = _shard_bounds(Lp, w)
+        out = padded0
+        # interior kept: owned cells at distance >= w (padded [2w, Lp-2w))
+        out = _set(out, interior,
+                   [slice(2 * w, Lp[d] - 2 * w) for d in range(nd)],
+                   [slice(w, Lp[d] - 3 * w) for d in range(nd)])
+        # 3) rim bands
+        for d in range(nd):
+            for lo in (True, False):
+                off = 0 if lo else Lp[d] - 3 * w
+                sl_in = [slice(None)] * nd
+                sl_in[d] = slice(off, off + 3 * w)
+                bnd = list(bounds)
+                bnd[2 * d] = bnd[2 * d] - off
+                bnd[2 * d + 1] = bnd[2 * d + 1] - off
+                band = ftcs_multistep_bounded_pallas(
+                    padded0[tuple(sl_in)], r, ksteps,
+                    jnp.stack(bnd).astype(jnp.int32))
+                sl_keep = [slice(None)] * nd
+                sl_dst = [slice(None)] * nd
+                for e in range(nd):
+                    if e == d:  # this face's w-deep owned rim
+                        sl_keep[e] = slice(w, 2 * w)
+                        sl_dst[e] = (slice(w, 2 * w) if lo
+                                     else slice(Lp[d] - 2 * w, Lp[d] - w))
+                    elif e < d:  # earlier axes' bands own the corners
+                        sl_keep[e] = slice(2 * w, Lp[e] - 2 * w)
+                        sl_dst[e] = sl_keep[e]
+                    else:  # later axes: full owned span (incl. corners)
+                        sl_keep[e] = slice(w, Lp[e] - w)
+                        sl_dst[e] = sl_keep[e]
+                out = _set(out, band, sl_dst, sl_keep)
+        return out
+
+    if overlap:
+        padded_multi = padded_multi_overlap
+
     def local_multi(local: jax.Array, w: int) -> jax.Array:
         out = padded_multi(halo_pad(local, bc_value, w), w, w)
         ctr = tuple(slice(w, -w) for _ in range(out.ndim))
@@ -198,8 +302,9 @@ def make_parity_machinery(cfg: HeatConfig, mesh):
     periodic = cfg.bc == "periodic"
     n = cfg.n
     # bit-identical formulations (tests/test_sharded.py pins it), so the
-    # literal update-then-swap ordering is preserved either way
-    exchange_fn = (halo_exchange_indep if cfg.exchange == "indep"
+    # literal update-then-swap ordering is preserved either way; "overlap"
+    # has no meaning at w=1 parity stepping — it gets indep's exchange
+    exchange_fn = (halo_exchange_indep if cfg.exchange in ("indep", "overlap")
                    else halo_exchange)
     spec = P(*axis_names)
     smap = functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
@@ -336,17 +441,14 @@ def _compile_probe(cfg: HeatConfig, mesh, kf: int, remaining: int,
     on the path's actual global state shape. No device buffers, no data
     transfer. Returns {chunk_size: compiled executable}; the caller hands
     it to drive(precompiled=...) so the probe's work is never repeated."""
-    import jax as _jax
-
     from .common import chunk_sizes
 
-    # belt and braces: also land the compiles in the persistent cache, so
-    # even an abandoned (timed-out) probe's eventual completion pays
-    # forward to a rerun
-    if not _jax.config.jax_compilation_cache_dir:
-        _jax.config.update("jax_compilation_cache_dir",
-                           os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                          "/tmp/jax_cache"))
+    # NOTE on the persistent compile cache: when the user (or the bench
+    # harness) sets JAX_COMPILATION_CACHE_DIR, jax honors it natively and
+    # an abandoned (timed-out) probe's eventual completion pays forward to
+    # a rerun. The guard deliberately does NOT flip the cache on itself —
+    # mutating process-global jax config from the probe thread would leak
+    # into every later compile (and race the main thread).
     if padded:
         _, advance, _ = make_padded_carry_machinery(cfg, mesh)
         shape = tuple(cfg.n + 2 * kf * int(s) for s in mesh.devices.shape)
@@ -404,21 +506,44 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
     if it does complete). Explicit --fuse-steps is honored unguarded —
     the user asked for that exact program.
 
-    Returns ``(cfg, precompiled)``: on success ``precompiled`` carries the
-    probe's executables for drive(precompiled=...), so the guard costs
-    zero extra compiles."""
+    Returns ``(cfg, precompiled, guard_s)``: on success ``precompiled``
+    carries the probe's executables for drive(precompiled=...), so the
+    guard costs zero extra compiles; ``guard_s`` is the probe's wall time
+    (drive folds it into the reported compile/total time — the guard must
+    not make minutes of compile invisible to timing consumers).
+
+    Divergence safety: every gate before the collective agreement derives
+    from cfg/mesh/platform — identical across an SPMD job by contract.
+    Per-host state that CAN diverge (the budget env var, probe exceptions,
+    probe timing) only feeds the agreed verdict, never whether the
+    collective is reached — a process skipping a collective its peers
+    entered would hang the job."""
+    t0 = time.perf_counter()
+    kf = fuse_depth_sharded(cfg, mesh.devices.shape)
+    if (cfg.fuse_steps or kf <= _SAFE_FUSE or remaining <= 0
+            or not _guard_platform_ok()):
+        return cfg, None, 0.0
     try:
         budget = float(os.environ.get("HEAT_COMPILE_BUDGET_S", "600"))
     except ValueError:
         budget = 600.0
-    kf = fuse_depth_sharded(cfg, mesh.devices.shape)
-    if (cfg.fuse_steps or budget <= 0 or kf <= _SAFE_FUSE
-            or remaining <= 0 or not _guard_platform_ok()):
-        return cfg, None
-    pre, err = _bounded_compile(
-        lambda: _compile_probe(cfg, mesh, kf, remaining, padded), budget)
-    if not _agree_any_timeout(err is not None):
-        return cfg, pre
+    pre, timed_out = None, False
+    if budget > 0:  # budget<=0 disables the probe, NOT the agreement
+        try:
+            pre, err = _bounded_compile(
+                lambda: _compile_probe(cfg, mesh, kf, remaining, padded),
+                budget)
+            timed_out = err is not None
+        except Exception as e:  # noqa: BLE001 — a probe crash (e.g.
+            # RESOURCE_EXHAUSTED on the deep unroll) means the k* program
+            # is unusable here: fall back rather than let drive hit the
+            # same error, and NEVER skip the agreement below (peers would
+            # hang in the collective)
+            master_print(f"compile guard: probe failed ({type(e).__name__}: "
+                         f"{e}); treating as timeout")
+            pre, timed_out = None, True
+    if not _agree_any_timeout(timed_out):
+        return cfg, pre, time.perf_counter() - t0
     fallback = max(1, min(_SAFE_FUSE, *(cfg.n // s
                                         for s in mesh.devices.shape)))
     master_print(
@@ -426,15 +551,16 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
         f"(HEAT_COMPILE_BUDGET_S); falling back to fuse_steps={fallback} "
         f"(~87% of the k={kf} sustained throughput at flagship scale: "
         f"k=16 lands 98% of the one-pass roofline vs 112% at k=32). The "
-        f"abandoned compile continues into the compile cache — a rerun may "
-        f"pick {kf} up instantly. Pass --fuse-steps {kf} to wait it out.")
-    return cfg.with_(fuse_steps=fallback), None
+        f"abandoned compile continues (and lands in the compile cache when "
+        f"JAX_COMPILATION_CACHE_DIR is set) — a rerun may pick {kf} up "
+        f"instantly. Pass --fuse-steps {kf} to wait it out.")
+    return cfg.with_(fuse_steps=fallback), None, time.perf_counter() - t0
 
 
 def _solve_padded_carry(cfg: HeatConfig, T0, mesh, fetch: bool,
                         warm_exec: bool, two_point_repeats: int = 0):
     """Default sharded solve: padded-carry state (make_padded_carry_machinery)."""
-    cfg, pre = _guard_fuse_compile(cfg, mesh, cfg.ntime, padded=True)
+    cfg, pre, guard_s = _guard_fuse_compile(cfg, mesh, cfg.ntime, padded=True)
     sharding = NamedSharding(mesh, P(*mesh.axis_names))
     T_owned, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
     # start_step is always 0 here (checkpointed runs take the owned-state
@@ -447,7 +573,8 @@ def _solve_padded_carry(cfg: HeatConfig, T0, mesh, fetch: bool,
     del T_owned  # unpin the owned-field device buffer for the solve
     res = drive(cfg.with_(report_sum=False), Tp, advance,
                 start_step=start_step, fetch=False, warm_exec=warm_exec,
-                two_point_repeats=two_point_repeats, precompiled=pre)
+                two_point_repeats=two_point_repeats, precompiled=pre,
+                precompile_s=guard_s)
     return _finalize_carried(cfg, res, crop, fetch)
 
 
@@ -592,11 +719,12 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
         # remaining count that respects checkpoint resume)
         sharding = NamedSharding(mesh, P(*mesh.axis_names))
         T, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
-        cfg, pre = _guard_fuse_compile(cfg, mesh, cfg.ntime - start_step,
-                                       padded=False)
+        cfg, pre, guard_s = _guard_fuse_compile(
+            cfg, mesh, cfg.ntime - start_step, padded=False)
         res = drive(cfg, T, make_advance(cfg, mesh), start_step=start_step,
                     fetch=fetch, warm_exec=warm_exec,
-                    two_point_repeats=two_point_repeats, precompiled=pre)
+                    two_point_repeats=two_point_repeats, precompiled=pre,
+                    precompile_s=guard_s)
     res.mesh_shape = tuple(mesh.devices.shape)
     res.mesh = mesh
     return res

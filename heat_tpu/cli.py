@@ -56,9 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--comm", choices=["direct", "staged"],
                      help="halo exchange: device-direct (CUDA-aware analog) "
                           "or host-staged (NO_AWARE analog)")
-    run.add_argument("--exchange", choices=["seq", "indep"],
+    run.add_argument("--exchange", choices=["seq", "indep", "overlap"],
                      help="ghost-write formulation: axes chained (seq, "
-                          "reference-like) or all-independent (indep); "
+                          "reference-like), all-independent (indep), or "
+                          "indep plus interior compute overlapped with the "
+                          "halo collectives (overlap; Pallas kernel only); "
                           "bit-identical results")
     run.add_argument("--mesh", type=_parse_mesh,
                      help="device mesh shape, e.g. 4x2 (sharded backend)")

@@ -26,7 +26,7 @@ _BACKENDS = ("serial", "xla", "pallas", "sharded")
 _BCS = ("edges", "ghost", "periodic")
 _ICS = ("hat", "hat_half", "hat_small", "uniform", "zero")
 _COMMS = ("direct", "staged")
-_EXCHANGES = ("seq", "indep")
+_EXCHANGES = ("seq", "indep", "overlap")
 _LOCAL_KERNELS = ("auto", "xla", "pallas")
 
 
@@ -62,8 +62,12 @@ class HeatConfig:
                                 # writes independent — one fewer full-shard
                                 # copy per exchange in the compiled multi-
                                 # device advance) vs "seq" (axes chained, the
-                                # reference-like form). Bit-identical results;
-                                # see parallel/halo.py::halo_exchange_indep
+                                # reference-like form) vs "overlap" (indep
+                                # exchange + interior compute issued while
+                                # halo slabs are in flight; Pallas local
+                                # kernel only). Bit-identical results; see
+                                # parallel/halo.py::halo_exchange_indep and
+                                # backends/sharded.py padded_multi_overlap
     local_kernel: str = "auto"  # sharded per-shard compute: auto (pallas on
                                 # TPU, xla elsewhere), or forced
     mesh_shape: Optional[Tuple[int, ...]] = None  # device mesh; None = auto

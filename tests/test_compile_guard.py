@@ -63,8 +63,9 @@ def test_guard_falls_back_on_compile_timeout(mesh, monkeypatch, capsys):
                         lambda *a, **kw: time.sleep(30))
     cfg = _flagship_cfg()
     assert sharded.fuse_depth_sharded(cfg, (1, 1)) == 32  # the cliff depth
-    out, pre = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
+    out, pre, guard_s = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
     assert out.fuse_steps == 16 and pre is None
+    assert guard_s > 0  # the probe's wall cost is reported, not hidden
     msg = capsys.readouterr().out
     assert "WARNING" in msg and "fuse_steps=16" in msg
 
@@ -78,7 +79,7 @@ def test_guard_falls_back_when_a_peer_timed_out(mesh, monkeypatch, capsys):
     monkeypatch.setattr(sharded, "_compile_probe",
                         lambda *a, **kw: {500: object()})
     monkeypatch.setattr(sharded, "_agree_any_timeout", lambda t: True)
-    out, pre = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
+    out, pre, _ = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
     assert out.fuse_steps == 16 and pre is None
 
 
@@ -93,7 +94,7 @@ def test_guard_hands_probe_executables_forward(mesh, monkeypatch):
         return fake
 
     monkeypatch.setattr(sharded, "_compile_probe", probe)
-    out, pre = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
+    out, pre, _ = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
     assert out.fuse_steps == 0      # auto depth survives
     assert pre is fake              # drive never recompiles the probe's work
     assert calls == [(32, 500, True)]
@@ -102,7 +103,6 @@ def test_guard_hands_probe_executables_forward(mesh, monkeypatch):
 @pytest.mark.parametrize("why,cfg_kw,env", [
     ("explicit fuse_steps is the user's own program",
      {"fuse_steps": 32}, {}),
-    ("budget 0 disables the guard", {}, {"HEAT_COMPILE_BUDGET_S": "0"}),
     ("remaining 0 compiles nothing", {"ntime": 0}, {}),
 ])
 def test_guard_stays_out_of_the_way(mesh, monkeypatch, why, cfg_kw, env):
@@ -113,7 +113,54 @@ def test_guard_stays_out_of_the_way(mesh, monkeypatch, why, cfg_kw, env):
         sharded, "_compile_probe",
         lambda *a, **kw: pytest.fail(f"probe must not run: {why}"))
     cfg = _flagship_cfg(**cfg_kw)
-    assert sharded._guard_fuse_compile(cfg, mesh, cfg.ntime) == (cfg, None)
+    assert sharded._guard_fuse_compile(cfg, mesh, cfg.ntime) == (cfg, None,
+                                                                 0.0)
+
+
+def test_guard_budget_zero_skips_probe_but_joins_agreement(mesh, monkeypatch):
+    """HEAT_COMPILE_BUDGET_S=0 is per-host state: it must disable the
+    probe but NOT the job-wide agreement — a process skipping a collective
+    its peers entered hangs the job (divergence-safety contract)."""
+    monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0")
+    monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
+    monkeypatch.setattr(
+        sharded, "_compile_probe",
+        lambda *a, **kw: pytest.fail("budget 0 must skip the probe"))
+    joined = []
+
+    def agree(t):
+        joined.append(t)
+        return t
+
+    monkeypatch.setattr(sharded, "_agree_any_timeout", agree)
+    cfg = _flagship_cfg()
+    out, pre, _ = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
+    assert (out, pre) == (cfg, None)
+    assert joined == [False]  # participated, voted "no timeout"
+
+
+def test_guard_probe_exception_falls_back_and_joins_agreement(
+        mesh, monkeypatch, capsys):
+    """A probe crash (e.g. RESOURCE_EXHAUSTED on the deep unroll) must
+    fall back — and still reach the agreement collective."""
+    monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "5")
+    monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
+
+    def boom(*a, **kw):
+        raise RuntimeError("RESOURCE_EXHAUSTED: vmem")
+
+    monkeypatch.setattr(sharded, "_compile_probe", boom)
+    joined = []
+
+    def agree(t):
+        joined.append(t)
+        return t
+
+    monkeypatch.setattr(sharded, "_agree_any_timeout", agree)
+    out, pre, _ = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
+    assert out.fuse_steps == 16 and pre is None
+    assert joined == [True]
+    assert "probe failed" in capsys.readouterr().out
 
 
 def test_guard_noop_on_cpu(mesh, monkeypatch):
@@ -122,7 +169,8 @@ def test_guard_noop_on_cpu(mesh, monkeypatch):
         sharded, "_compile_probe",
         lambda *a, **kw: pytest.fail("probe must not run on cpu"))
     cfg = _flagship_cfg()
-    assert sharded._guard_fuse_compile(cfg, mesh, cfg.ntime) == (cfg, None)
+    assert sharded._guard_fuse_compile(cfg, mesh, cfg.ntime) == (cfg, None,
+                                                                 0.0)
 
 
 def test_guard_noop_at_safe_depths(mesh, monkeypatch):
@@ -133,7 +181,7 @@ def test_guard_noop_at_safe_depths(mesh, monkeypatch):
     cfg = HeatConfig(n=512, ntime=100, dtype="float32", backend="sharded",
                      mesh_shape=(1, 1))  # auto k* = sqrt(512/2) = 16
     assert sharded.fuse_depth_sharded(cfg, (1, 1)) <= sharded._SAFE_FUSE
-    assert sharded._guard_fuse_compile(cfg, mesh, 100) == (cfg, None)
+    assert sharded._guard_fuse_compile(cfg, mesh, 100) == (cfg, None, 0.0)
 
 
 @pytest.mark.parametrize("padded", [True, False])

@@ -1,0 +1,96 @@
+"""exchange="overlap": interior compute overlapped with the halo
+collectives (VERDICT r3 #5, SURVEY.md §7's "hard part").
+
+The restructuring must be invisible in the numbers: owned values
+bit-identical to exchange="indep" (and so to the serial oracle) in f32,
+where every per-cell operation sequence is unchanged. bf16 3D may chunk
+the interior and band kernels differently (per-shape plans -> different
+intermediate bf16 roundings), so it gets a tolerance, not bitwise.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heat_tpu.backends.sharded import solve
+from heat_tpu.config import HeatConfig
+
+
+def _pair(cfg):
+    ref = solve(cfg.with_(exchange="indep"))
+    got = solve(cfg.with_(exchange="overlap"))
+    return np.asarray(ref.T), np.asarray(got.T)
+
+
+BASE = dict(dtype="float32", backend="sharded", local_kernel="pallas")
+
+
+@pytest.mark.parametrize("bc", ["edges", "ghost", "periodic"])
+@pytest.mark.parametrize("mesh_shape", [(1, 1), (2, 2), (4, 2)])
+def test_overlap_bitwise_2d(bc, mesh_shape):
+    cfg = HeatConfig(n=64, ntime=12, bc=bc, mesh_shape=mesh_shape,
+                     fuse_steps=4, **BASE)
+    ref, got = _pair(cfg)
+    np.testing.assert_array_equal(ref, got)
+
+
+@pytest.mark.parametrize("bc", ["edges", "periodic"])
+def test_overlap_bitwise_3d(bc):
+    cfg = HeatConfig(n=32, ndim=3, ntime=6, bc=bc, sigma=1 / 6,
+                     mesh_shape=(2, 2, 2), fuse_steps=2, **BASE)
+    ref, got = _pair(cfg)
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_overlap_bitwise_remainder_chunk():
+    # ntime % fuse != 0: the remainder block runs ksteps < wpad through
+    # the same split (margins stay wpad-wide)
+    cfg = HeatConfig(n=64, ntime=10, mesh_shape=(2, 2), fuse_steps=4,
+                     **BASE)
+    ref, got = _pair(cfg)
+    np.testing.assert_array_equal(ref, got)
+
+
+@pytest.mark.parametrize("n,fuse", [
+    (16, 8),   # L = 8 = w: rim bands ARE the whole shard, interior empty
+    (24, 8),   # w < L=12 < 2w: bands overlap mid-shard, interior empty
+    (32, 8),   # L = 16 = 2w exactly: interior empty, bands abut
+])
+def test_overlap_bitwise_tiny_shards(n, fuse):
+    cfg = HeatConfig(n=n, ntime=8, mesh_shape=(2, 2), fuse_steps=fuse,
+                     **BASE)
+    ref, got = _pair(cfg)
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_overlap_matches_serial_oracle():
+    from heat_tpu.backends.serial_np import solve as serial_solve
+
+    cfg = HeatConfig(n=48, ntime=10, mesh_shape=(2, 2), fuse_steps=4,
+                     **BASE)
+    want = serial_solve(cfg.with_(backend="serial")).T.astype(np.float32)
+    got = np.asarray(solve(cfg.with_(exchange="overlap")).T)
+    np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
+
+
+def test_overlap_bf16_close():
+    cfg = HeatConfig(n=64, ntime=8, dtype="bfloat16", backend="sharded",
+                     local_kernel="pallas", mesh_shape=(2, 2), fuse_steps=4)
+    ref = np.asarray(solve(cfg.with_(exchange="indep")).T, np.float32)
+    got = np.asarray(solve(cfg.with_(exchange="overlap")).T, np.float32)
+    np.testing.assert_allclose(got, ref, rtol=3e-2, atol=3e-2)
+
+
+def test_overlap_requires_pallas_kernel():
+    cfg = HeatConfig(n=64, ntime=4, exchange="overlap", dtype="float32",
+                     backend="sharded", local_kernel="xla")
+    with pytest.raises(ValueError, match="overlap"):
+        solve(cfg)
+
+
+def test_overlap_staged_comm():
+    # the HIP-preset staged (host round-trip) exchange still composes
+    cfg = HeatConfig(n=64, ntime=8, comm="staged", mesh_shape=(2, 2),
+                     fuse_steps=4, **BASE)
+    ref, got = _pair(cfg)
+    np.testing.assert_array_equal(ref, got)
