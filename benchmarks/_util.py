@@ -14,3 +14,23 @@ def write_atomic(out: Path, obj) -> None:
     tmp = out.with_suffix(".tmp")
     tmp.write_text(json.dumps(obj, indent=2))
     os.replace(tmp, out)
+
+
+def deep_fuse_proven(k: int = 32, budget_s: float = 600) -> bool:
+    """Has a bisect artifact PROVEN the depth-``k`` flagship compile
+    bounded? True once either the on-chip bisect or the chipless
+    AOT-topology bisect (round 4: the whole k=8..32 curve measured flat
+    at 5-9 s cold — the round-3 >25-min stall was the tunnel wedge)
+    recorded a sub-budget compile. The ONE gate the chip labs
+    (collective_overhead, overlap_ab) consult before queueing deep-fuse
+    rows."""
+    here = Path(__file__).parent
+    for fname in ("compile_bisect.json", "compile_bisect_topology.json"):
+        try:
+            rows = json.loads((here / fname).read_text())["rows"]
+            row = rows.get(str(k), {})
+            if "compile_s" in row and row["compile_s"] < budget_s:
+                return True
+        except (OSError, json.JSONDecodeError, KeyError):
+            continue
+    return False

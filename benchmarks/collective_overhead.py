@@ -111,23 +111,14 @@ def probe_chains(smoke: bool):
 
 def _auto_ks() -> tuple[int, ...]:
     """Fuse depths for the exchange-delta sweep. Round 3's fuse=32 case
-    sat >25 min (compile cliff or tunnel wedge — unresolved then); 32 is
-    included only when compile_bisect.json has PROVEN its compile bounded
-    on this platform (VERDICT r3 #6 wants the {16,32} points for a
-    >=3-point t(k) fit; {1,8,16} alone already give three)."""
-    import json
+    sat >25 min (resolved in round 4: the tunnel wedge, not a compile
+    cliff — see _util.deep_fuse_proven); 32 joins once a bisect artifact
+    has proven its compile bounded. VERDICT r3 #6 wants the {16,32}
+    points for a >=3-point t(k) fit; {1,8,16} alone already give three."""
+    from _util import deep_fuse_proven
 
     base = (1, 8, 16)
-    try:
-        rows = json.loads(
-            (Path(__file__).parent / "compile_bisect.json").read_text()
-        )["rows"]
-        r32 = rows.get("32", {})
-        if "compile_s" in r32 and r32["compile_s"] < 600:
-            return base + (32,)
-    except (OSError, json.JSONDecodeError, KeyError):
-        pass
-    return base
+    return base + (32,) if deep_fuse_proven(32) else base
 
 
 def probe_exchange_delta(smoke: bool, flush, rec: dict, ks=None):

@@ -43,33 +43,68 @@ STEPS = 500  # run_all row 3's chunk: drive compiles the whole solve as one
 KS = (8, 16, 20, 24, 28, 32)
 
 
-def child(k: int, n: int, steps: int, smoke: bool) -> None:
-    if smoke:
-        import jax
+def child(k: int, n: int, steps: int, smoke: bool,
+          topology: str | None = None) -> None:
+    """One compile measurement. ``topology`` set = AOT topology mode: no
+    chip (and no tunnel) involved — the XLA:TPU + Mosaic compilers run
+    locally against a virtual v5e:2x2, with n doubled so the LOCAL shard
+    (and hence the Mosaic kernel program, the suspected cliff) is
+    byte-identical to the flagship 16384^2 1x1 case. This isolates a
+    compiler cliff from a tunnel wedge by construction."""
+    import contextlib
 
-        jax.config.update("jax_platforms", "cpu")
     import jax
 
     from heat_tpu.backends.sharded import make_padded_carry_machinery
     from heat_tpu.config import HeatConfig
-    from heat_tpu.parallel.mesh import build_mesh
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    cfg = HeatConfig(n=n, ntime=steps, dtype="float32", backend="sharded",
-                     mesh_shape=(1, 1), fuse_steps=k)
-    mesh = build_mesh(cfg.ndim, cfg.mesh_shape)
-    _, advance, _ = make_padded_carry_machinery(cfg, mesh)
-    padded = jax.ShapeDtypeStruct(
-        (n + 2 * k, n + 2 * k), "float32",
-        sharding=NamedSharding(mesh, P(*mesh.axis_names)))
-    t0 = time.perf_counter()
-    lowered = advance.lower(padded, steps)
-    t_lower = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    lowered.compile()
-    t_compile = time.perf_counter() - t0
+    if smoke or topology:
+        jax.config.update("jax_platforms", "cpu")
+
+    if topology:
+        import math
+
+        from jax.experimental import topologies
+
+        from heat_tpu.ops.pallas_stencil import force_compiled_kernels
+
+        topo = topologies.get_topology_desc(topology, "tpu")
+        ndev = len(topo.devices)
+        s = math.isqrt(ndev)
+        if s * s != ndev:
+            raise SystemExit(
+                f"--topology {topology} has {ndev} devices; the bisect "
+                f"needs a SQUARE mesh so the local shard stays n x n "
+                f"(the flagship kernel program) — use e.g. v5e:2x2")
+        mesh_shape = (s, s)
+        n_glob = n * s  # local shard stays n x n — the flagship kernel
+        mesh = topologies.make_mesh(topo, mesh_shape, ("x", "y"))
+        ctx = force_compiled_kernels()
+    else:
+        from heat_tpu.parallel.mesh import build_mesh
+
+        mesh_shape = (1, 1)
+        n_glob = n
+        mesh = build_mesh(2, mesh_shape)
+        ctx = contextlib.nullcontext()
+
+    cfg = HeatConfig(n=n_glob, ntime=steps, dtype="float32",
+                     backend="sharded", mesh_shape=mesh_shape, fuse_steps=k)
+    with ctx:
+        _, advance, _ = make_padded_carry_machinery(cfg, mesh)
+        padded = jax.ShapeDtypeStruct(
+            tuple(n_glob + 2 * k * s for s in mesh_shape), "float32",
+            sharding=NamedSharding(mesh, P(*mesh.axis_names)))
+        t0 = time.perf_counter()
+        lowered = advance.lower(padded, steps)
+        t_lower = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        lowered.compile()
+        t_compile = time.perf_counter() - t0
     print(json.dumps({"k": k, "lower_s": t_lower, "compile_s": t_compile,
-                      "platform": jax.default_backend()}), flush=True)
+                      "platform": jax.default_backend(),
+                      "topology": topology}), flush=True)
 
 
 def main() -> None:
@@ -82,21 +117,37 @@ def main() -> None:
     ap.add_argument("--cache", choices=("fresh", "shared"), default="fresh",
                     help="fresh: cold-compile each k in its own cache dir; "
                          "shared: reuse /tmp/jax_cache (warm behavior)")
+    ap.add_argument("--topology", nargs="?", const="v5e:2x2", default=None,
+                    help="AOT topology mode: compile the flagship-shard "
+                         "program locally against a virtual TPU topology — "
+                         "no chip/tunnel involved, isolating compiler "
+                         "cliffs from tunnel wedges")
     ap.add_argument("--ks", default=",".join(str(k) for k in KS))
     args = ap.parse_args()
 
     n = 512 if args.smoke else N
     steps = 32 if args.smoke else STEPS
     if args.child is not None:
-        child(args.child, n, steps, args.smoke)
+        child(args.child, n, steps, args.smoke, topology=args.topology)
         return
 
     from _util import write_atomic
 
     out = Path(__file__).parent / (
-        "compile_bisect_smoke.json" if args.smoke else "compile_bisect.json")
+        "compile_bisect_smoke.json" if args.smoke
+        else "compile_bisect_topology.json" if args.topology
+        else "compile_bisect.json")
     rec = {"ts": time.time(), "n": n, "steps": steps, "cache": args.cache,
+           "topology": args.topology,
            "timeout_s": args.timeout, "rows": {}}
+    try:  # partial re-runs (e.g. one wedged k) merge into the curve
+        old = json.loads(out.read_text())
+        if (old.get("n"), old.get("steps"), old.get("cache"),
+                old.get("topology")) == (n, steps, args.cache,
+                                         args.topology):
+            rec["rows"].update(old.get("rows", {}))
+    except (OSError, json.JSONDecodeError):
+        pass
 
     for k in (int(s) for s in args.ks.split(",")):
         env = dict(os.environ)
@@ -109,6 +160,8 @@ def main() -> None:
         cmd = [sys.executable, __file__, "--child", str(k)]
         if args.smoke:
             cmd.append("--smoke")
+        if args.topology:
+            cmd.extend(["--topology", args.topology])
         t0 = time.time()
         try:
             p = subprocess.run(cmd, timeout=args.timeout, env=env,

@@ -69,6 +69,14 @@ def _census(compiled) -> dict:
     }
 
 
+# NOTE: overlap *schedule* evidence (kernels inside the async
+# collective-permute flight window) is NOT measurable here — this lab's
+# real-advance rows run the 1x1 mesh, where ppermute degenerates and the
+# compiled module has zero collective-permute pairs. The multi-chip
+# schedule census lives in benchmarks/topology_schedule.py (AOT topology
+# compile — works without any attached chip).
+
+
 def variants(axis_names, axis_sizes, bc_value, w):
     import jax
     import jax.numpy as jnp
@@ -174,11 +182,19 @@ def main():
     from heat_tpu.parallel.mesh import build_mesh
 
     steps = 64
-    for exchange in ("seq", "indep"):
+    for exchange in ("seq", "indep", "overlap"):
         for kf in (1, 8):
+            if exchange == "overlap" and kf == 1:
+                continue  # w=1 rim IS the shard edge; nothing to overlap
+            # overlap requires the Pallas kernel; pin it for the other
+            # modes too when comparing against overlap rows on TPU (on
+            # CPU smoke the seq/indep rows keep the default XLA local
+            # kernel — their censuses are the round-3 baseline)
+            lk = "pallas" if exchange == "overlap" else "auto"
             cfg = HeatConfig(n=n, ntime=steps, dtype="float32",
                              backend="sharded", mesh_shape=(1, 1),
-                             fuse_steps=kf, exchange=exchange)
+                             fuse_steps=kf, exchange=exchange,
+                             local_kernel=lk)
             hmesh = build_mesh(cfg.ndim, cfg.mesh_shape)
             seed, advance, crop = make_padded_carry_machinery(cfg, hmesh)
             Tp = seed(jnp.zeros((n, n), jnp.float32))

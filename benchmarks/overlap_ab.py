@@ -23,7 +23,6 @@ Writes benchmarks/overlap_ab.json (atomic, incremental).
 
 from __future__ import annotations
 
-import json
 import sys
 import time
 from pathlib import Path
@@ -35,16 +34,9 @@ from _util import write_atomic  # noqa: E402
 
 
 def _ks() -> tuple[int, ...]:
-    try:
-        rows = json.loads(
-            (Path(__file__).parent / "compile_bisect.json").read_text()
-        )["rows"]
-        r32 = rows.get("32", {})
-        if "compile_s" in r32 and r32["compile_s"] < 600:
-            return (16, 32)
-    except (OSError, json.JSONDecodeError, KeyError):
-        pass
-    return (16,)
+    from _util import deep_fuse_proven
+
+    return (16, 32) if deep_fuse_proven(32) else (16,)
 
 
 def main():

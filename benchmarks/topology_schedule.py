@@ -1,0 +1,153 @@
+"""Multi-chip schedule evidence via AOT topology compile — no chip needed.
+
+The overlap exchange (backends/sharded.py::padded_multi_overlap) claims
+XLA's latency-hiding scheduler will fly the halo collectives behind the
+interior kernel. A 1x1 mesh can't show that (ppermute degenerates), and
+multi-chip hardware isn't attached — but ``jax.experimental.topologies``
+compiles a GENUINE multi-chip TPU executable on a CPU-only host (the
+Mosaic + XLA:TPU compilers ship in libtpu and need no device), so the
+claim is checkable from the compiled module's schedule order:
+
+- async ``collective-permute-start``/``-done`` pairs (TPU lowering of the
+  ppermutes), and
+- how many Mosaic ``custom-call`` kernels are scheduled strictly inside
+  a start->done flight window (>0 = kernel work overlaps the wire time).
+
+Compiled-module text is in schedule order for TPU, so "inside the
+window" is the scheduler's actual decision, not an inference. Measured
+first run (v5e:2x4, 4x2 mesh, 1024^2, fuse 4):
+``indep``: 1 kernel call, 0 in-window (strictly exchange-then-kernel);
+``overlap``: 5 kernel calls (interior + 4 rim bands), interior IN-window.
+
+Run (anywhere, tunnel up or down): ``python benchmarks/topology_schedule.py``
+Writes benchmarks/topology_schedule.json (atomic, incremental).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from _util import write_atomic  # noqa: E402
+
+
+def schedule_census(txt: str) -> dict:
+    """Per-flight-window schedule analysis of a compiled TPU module.
+
+    Windows are matched exactly: each ``collective-permute-done`` names
+    its ``-start`` as an operand, so every (start, done) pair is the real
+    flight window even when windows interleave (start1 start2 done1
+    done2 — the shape a latency-hiding schedule produces). A kernel
+    counts as in-flight iff its line sits strictly inside SOME matched
+    window (kernels between disjoint windows don't count)."""
+    import re
+
+    lines = txt.splitlines()
+    # op DEFINITIONS only (`%name = ... collective-permute-start(...)`):
+    # fusion lines that merely take a start/done as an operand must not
+    # count as windows
+    start_def = re.compile(r"\s*(\S+?)\s*=.*\scollective-permute-start\(")
+    done_def = re.compile(r"\s*\S+\s*=.*\scollective-permute-done\(([^)]*)\)")
+    start_idx = {}
+    for i, ln in enumerate(lines):
+        m = start_def.match(ln)
+        if m:
+            start_idx[m.group(1).lstrip("%")] = i
+    windows = []
+    unmatched = 0
+    for i, ln in enumerate(lines):
+        m = done_def.match(ln)
+        if not m:
+            continue
+        ops = [o.strip().lstrip("%") for o in m.group(1).split(",")]
+        s = next((start_idx[o] for o in ops if o in start_idx), None)
+        if s is None:
+            unmatched += 1
+        else:
+            windows.append((s, i))
+    customs = [i for i, ln in enumerate(lines) if "custom-call" in ln]
+    per_window = [sum(1 for c in customs if s < c < d) for s, d in windows]
+    in_flight = len({c for c in customs
+                     for s, d in windows if s < c < d})
+    return {
+        "async_pairs": len(windows),
+        "unmatched_dones": unmatched,
+        "custom_calls": len(customs),
+        "kernels_in_flight": in_flight,
+        "kernels_in_flight_per_window": per_window,
+        "copies": txt.count(" copy("),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--topology", default="v5e:2x4",
+                    help="TPU topology name for the AOT compile")
+    ap.add_argument("--mesh", default="4x2")
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--fuse", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=16)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # works chipless by design
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from heat_tpu.backends.sharded import make_padded_carry_machinery
+    from heat_tpu.config import HeatConfig
+    from heat_tpu.ops.pallas_stencil import force_compiled_kernels
+    from heat_tpu.parallel.mesh import build_mesh  # noqa: F401 (parity cite)
+
+    mesh_shape = tuple(int(v) for v in args.mesh.split("x"))
+    topo = topologies.get_topology_desc(args.topology, "tpu")
+    mesh = topologies.make_mesh(topo, mesh_shape,
+                                tuple("xyz"[: len(mesh_shape)]))
+
+    out = Path(__file__).parent / "topology_schedule.json"
+    rec = {"ts": time.time(), "topology": args.topology,
+           "mesh": list(mesh_shape), "n": args.n, "fuse": args.fuse,
+           "steps": args.steps, "rows": {}}
+
+    with force_compiled_kernels():
+        for ex in ("seq", "indep", "overlap"):
+            cfg = HeatConfig(n=args.n, ntime=args.steps, dtype="float32",
+                             backend="sharded", mesh_shape=mesh_shape,
+                             fuse_steps=args.fuse, exchange=ex,
+                             local_kernel="pallas")
+            _, advance, _ = make_padded_carry_machinery(cfg, mesh)
+            shape = tuple(args.n + 2 * args.fuse * s for s in mesh_shape)
+            struct = jax.ShapeDtypeStruct(
+                shape, jnp.float32,
+                sharding=NamedSharding(mesh, P(*mesh.axis_names)))
+            t0 = time.perf_counter()
+            try:
+                txt = advance.lower(struct, args.steps).compile().as_text()
+            except Exception as e:  # record, keep going
+                rec["rows"][ex] = {"error": f"{type(e).__name__}: {e}"}
+                print(f"{ex:8s} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:160]}", flush=True)
+                write_atomic(out, rec)
+                continue
+            row = schedule_census(txt)
+            row["compile_s"] = time.perf_counter() - t0
+            rec["rows"][ex] = row
+            print(f"{ex:8s} pairs={row['async_pairs']} "
+                  f"kernels={row['custom_calls']} "
+                  f"in-flight={row['kernels_in_flight']} "
+                  f"(per-window {row['kernels_in_flight_per_window']}) "
+                  f"copies={row['copies']} "
+                  f"[compile {row['compile_s']:.0f}s]", flush=True)
+            write_atomic(out, rec)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -537,10 +537,17 @@ def cmd_bench(args) -> int:
 def cmd_info(_args) -> int:
     import jax
 
+    from . import machine
     from .io.native import native_available
 
     print(f"jax {jax.__version__}, backend={jax.default_backend()}")
     print(f"devices: {jax.devices()}")
+    chip = machine.current()
+    print(f"machine model: {chip.label} — HBM "
+          f"{chip.hbm_bytes_per_s / 1e9:.0f} GB/s, one-pass roofline "
+          f"{chip.roofline_points_per_s('float32'):.3e} f32 pts/s"
+          + ("" if chip.calibrated else
+             " (spec-derived; planner geometry uncalibrated on this chip)"))
     print(f"process {jax.process_index()}/{jax.process_count()}")
     print(f"native fastio: {'available' if native_available() else 'unavailable (numpy fallback)'}")
     return 0

@@ -94,8 +94,37 @@ def _sublane(dtype) -> int:
 def _round_up(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult
 
+_FORCE_COMPILED = False
+
+
 def _interpret() -> bool:
+    if _FORCE_COMPILED:
+        return False
     return jax.default_backend() != "tpu"
+
+
+import contextlib  # noqa: E402
+
+
+@contextlib.contextmanager
+def force_compiled_kernels():
+    """Trace Pallas calls as real Mosaic custom calls even off-TPU.
+
+    For AOT *topology* compiles: ``jax.experimental.topologies`` lets a
+    CPU-only host compile a genuine multi-chip TPU executable (the Mosaic
+    compiler ships with libtpu and needs no attached device), which is
+    how benchmarks/topology_schedule.py extracts real multi-chip TPU
+    schedules — async collective-permute pairs and all — during tunnel
+    outages. Clears jit caches on entry/exit: interpret-mode tracings of
+    the same call signature share cache keys with compiled ones."""
+    global _FORCE_COMPILED
+    jax.clear_caches()
+    _FORCE_COMPILED = True
+    try:
+        yield
+    finally:
+        _FORCE_COMPILED = False
+        jax.clear_caches()
 
 
 # --------------------------------------------------------------------------
