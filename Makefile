@@ -5,6 +5,7 @@ PY ?= python
 
 .PHONY: test bench bench-all bench-smoke chip-check weak-scaling \
         collective-overhead exchange-lab sharded3d-check sweep \
+        overlap-ab compile-bisect topology-schedule topology-validate \
         native run viz clean
 
 test:
@@ -33,6 +34,19 @@ exchange-lab:          # where does the per-exchange cost go (HLO census)
 
 sharded3d-check:       # 512^3 sharded fuse-depth no-regression
 	$(PY) benchmarks/sharded3d_check.py
+
+overlap-ab:            # exchange=overlap vs indep on chip
+	$(PY) benchmarks/overlap_ab.py
+
+compile-bisect:        # fuse-depth compile-time curve (on chip)
+	$(PY) benchmarks/compile_bisect.py
+
+# the chipless labs: AOT topology compile, no tunnel involved
+topology-schedule:     # multi-chip schedule census (overlap evidence)
+	$(PY) benchmarks/topology_schedule.py
+
+topology-validate:     # cross-chip machine-model compile validation
+	$(PY) benchmarks/topology_validate.py
 
 sweep:                 # flap-tolerant full chip queue
 	bash benchmarks/watch_and_sweep.sh

@@ -16,12 +16,15 @@ def write_atomic(out: Path, obj) -> None:
     os.replace(tmp, out)
 
 
-def deep_fuse_proven(k: int = 32, budget_s: float = 600) -> bool:
+def deep_fuse_proven(k: int = 32, budget_s: float = 1500) -> bool:
     """Has a bisect artifact PROVEN the depth-``k`` flagship compile
     bounded? True once either the on-chip bisect or the chipless
-    AOT-topology bisect (round 4: the whole k=8..32 curve measured flat
-    at 5-9 s cold — the round-3 >25-min stall was the tunnel wedge)
-    recorded a sub-budget compile. The ONE gate the chip labs
+    AOT-topology bisect recorded a sub-budget compile of the REAL
+    (Pallas local kernel) program. Round-4 measured truth
+    (compile_bisect_topology.json, local_kernel pinned to pallas):
+    16384-local k=8/16/32 cold-compile in 393/980/665 s — minutes,
+    bounded, inside the 1500 s default — while the 8192-local thin-band
+    k=32 family is a genuine >36-min wedge. The ONE gate the chip labs
     (collective_overhead, overlap_ab) consult before queueing deep-fuse
     rows."""
     here = Path(__file__).parent
@@ -29,7 +32,11 @@ def deep_fuse_proven(k: int = 32, budget_s: float = 600) -> bool:
         try:
             rows = json.loads((here / fname).read_text())["rows"]
             row = rows.get(str(k), {})
-            if "compile_s" in row and row["compile_s"] < budget_s:
+            # rows must prove the PALLAS program: the retracted first
+            # curves measured the XLA path (local_kernel unpinned) and
+            # rows from that era carry no local_kernel field — reject them
+            if (row.get("local_kernel") == "pallas"
+                    and "compile_s" in row and row["compile_s"] < budget_s):
                 return True
         except (OSError, json.JSONDecodeError, KeyError):
             continue

@@ -44,7 +44,7 @@ KS = (8, 16, 20, 24, 28, 32)
 
 
 def child(k: int, n: int, steps: int, smoke: bool,
-          topology: str | None = None) -> None:
+          topology: str | None = None, uncap: bool = False) -> None:
     """One compile measurement. ``topology`` set = AOT topology mode: no
     chip (and no tunnel) involved — the XLA:TPU + Mosaic compilers run
     locally against a virtual v5e:2x2, with n doubled so the LOCAL shard
@@ -89,8 +89,26 @@ def child(k: int, n: int, steps: int, smoke: bool,
         mesh = build_mesh(2, mesh_shape)
         ctx = contextlib.nullcontext()
 
+    # local_kernel MUST be pinned: in topology mode jax.default_backend()
+    # is cpu, so "auto" silently selects the XLA local kernel and the
+    # measurement bisects the wrong program entirely (the first topology
+    # curves in round 4 made exactly this mistake — flat 5-9 s that was
+    # the XLA path, while the real Mosaic compile wedged >30 min)
+    # pin the Pallas kernel in BOTH modes: on-chip "auto" would resolve to
+    # pallas anyway (f32 on TPU), and in topology mode default_backend()
+    # is cpu so "auto" would silently bisect the XLA program (the
+    # round-4 retracted-curve bug); deep_fuse_proven requires the row to
+    # carry local_kernel == "pallas"
+    lk = "pallas"
     cfg = HeatConfig(n=n_glob, ntime=steps, dtype="float32",
-                     backend="sharded", mesh_shape=mesh_shape, fuse_steps=k)
+                     backend="sharded", mesh_shape=mesh_shape, fuse_steps=k,
+                     local_kernel=lk)
+    if uncap:
+        from heat_tpu.ops import pallas_stencil as _ps
+
+        _ps._THIN_DEEP_BAND_CAP_BYTES = 1 << 60
+        for clear in (_ps._plan_2d.cache_clear, _ps._plan_3d.cache_clear):
+            clear()
     with ctx:
         _, advance, _ = make_padded_carry_machinery(cfg, mesh)
         padded = jax.ShapeDtypeStruct(
@@ -102,7 +120,9 @@ def child(k: int, n: int, steps: int, smoke: bool,
         t0 = time.perf_counter()
         lowered.compile()
         t_compile = time.perf_counter() - t0
-    print(json.dumps({"k": k, "lower_s": t_lower, "compile_s": t_compile,
+    print(json.dumps({"k": k, "n_local": n, "lower_s": t_lower,
+                      "compile_s": t_compile, "local_kernel": lk,
+                      "uncapped": uncap,
                       "platform": jax.default_backend(),
                       "topology": topology}), flush=True)
 
@@ -123,20 +143,31 @@ def main() -> None:
                          "no chip/tunnel involved, isolating compiler "
                          "cliffs from tunnel wedges")
     ap.add_argument("--ks", default=",".join(str(k) for k in KS))
+    ap.add_argument("--uncap", action="store_true",
+                    help="disable the planner's thin-band deep-unroll "
+                         "compile cap for this measurement (to put the "
+                         "uncapped wedge on record; expect the row to "
+                         "blow its timeout)")
+    ap.add_argument("--n", type=int, default=None,
+                    help="LOCAL shard extent (default 16384; topology mode "
+                         "scales the global so the local stays n x n). "
+                         "8192 probes the thin-band deep-unroll family")
     args = ap.parse_args()
 
-    n = 512 if args.smoke else N
+    n = args.n or (512 if args.smoke else N)
     steps = 32 if args.smoke else STEPS
     if args.child is not None:
-        child(args.child, n, steps, args.smoke, topology=args.topology)
+        child(args.child, n, steps, args.smoke, topology=args.topology,
+              uncap=args.uncap)
         return
 
     from _util import write_atomic
 
+    suffix = f"_n{n}" if args.n and n != N else ""
     out = Path(__file__).parent / (
         "compile_bisect_smoke.json" if args.smoke
-        else "compile_bisect_topology.json" if args.topology
-        else "compile_bisect.json")
+        else f"compile_bisect_topology{suffix}.json" if args.topology
+        else f"compile_bisect{suffix}.json")
     rec = {"ts": time.time(), "n": n, "steps": steps, "cache": args.cache,
            "topology": args.topology,
            "timeout_s": args.timeout, "rows": {}}
@@ -157,11 +188,16 @@ def main() -> None:
             env["JAX_COMPILATION_CACHE_DIR"] = tmp
         else:
             env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-        cmd = [sys.executable, __file__, "--child", str(k)]
+        cmd = [sys.executable, __file__, "--child", str(k),
+               "--n", str(n)]  # MUST forward: the first n8192 curve forgot
+        # this and silently re-measured the 16384-local program under an
+        # 8192 label (caught in review; artifact deleted)
         if args.smoke:
             cmd.append("--smoke")
         if args.topology:
             cmd.extend(["--topology", args.topology])
+        if args.uncap:
+            cmd.append("--uncap")
         t0 = time.time()
         try:
             p = subprocess.run(cmd, timeout=args.timeout, env=env,

@@ -52,22 +52,24 @@ phase() {  # phase <name> <timeout_s> <cmd...>
   fi
 }
 
-# Round-4 priority order (VERDICT r3 #1): (a) bench rc=0, (b) the k=32
-# compile bisect — it decides whether run_all row 3 (auto fuse=32) and
-# the fuse-32 exchange point are safe — (c) the full results.json refresh
-# with two-point fields, (d) the config-5 kernel A/Bs, (e) exchange
-# census + fuse-cost fit points, then certification and the long tail.
+# Round-4 priority order (VERDICT r3 #1): (a) bench rc=0, (b) the full
+# results.json refresh with two-point fields, (c) the config-5 kernel
+# A/Bs, (d) exchange census + fuse-cost fit points + overlap A/B, then
+# certification. Phase budgets account for the measured cold Mosaic
+# compile times (compile_bisect_topology*.json: flagship kernels are
+# 6-16 MINUTES cold; the persistent compile cache amortizes repeats) —
+# the round-3 "wedge" was mostly this. The on-chip k=32 bisect row
+# (tunnel-side compile overhead closure) runs late: the local AOT
+# topology curve already answered the cliff question.
 phase bench                 700 python bench.py
-phase compile_bisect_32    1000 python benchmarks/compile_bisect.py --ks 32 --timeout 900
-phase run_all              9000 python benchmarks/run_all.py
+phase run_all             14000 python benchmarks/run_all.py --row-timeout 2500
 phase fma_ab               2400 python benchmarks/kernel_lab.py bench2d_rolled_var fma 256,4096,16,128
 phase bf16native_ab        2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16native 256,4096,16,128
 phase bf16fma_ab           2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16fma 256,4096,16,128
 phase f32_rolled_base      2400 python benchmarks/kernel_lab.py bench2d_rolled_var f32 256,4096,16,128
-phase collective_overhead  2700 python benchmarks/collective_overhead.py
-phase exchange_lab         1800 python benchmarks/exchange_lab.py
-phase overlap_ab           2400 python benchmarks/overlap_ab.py
-phase compile_bisect_rest  4000 python benchmarks/compile_bisect.py --ks 8,16,20,24,28 --timeout 700
+phase collective_overhead  3600 python benchmarks/collective_overhead.py
+phase exchange_lab         2400 python benchmarks/exchange_lab.py
+phase overlap_ab           5400 python benchmarks/overlap_ab.py
 phase sharded3d_check      1800 python benchmarks/sharded3d_check.py
 phase check2d_rolled       1800 python benchmarks/kernel_lab.py check2d_rolled
 phase checkthin            1800 python benchmarks/kernel_lab.py checkthin
@@ -76,4 +78,5 @@ phase thin_fma_ab          2400 python benchmarks/kernel_lab.py benchthin 4096 f
 phase 3d_f32_ab            2400 python benchmarks/kernel_lab.py bench3d_rolled_var f32 64,64,8,8
 phase 3d_fma_ab            2400 python benchmarks/kernel_lab.py bench3d_rolled_var fma 64,64,8,8
 phase chip_check           2400 python benchmarks/chip_check.py
+phase compile_bisect_32    2000 python benchmarks/compile_bisect.py --ks 32 --timeout 1800
 echo "=== sweep done at $(date)"

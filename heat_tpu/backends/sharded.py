@@ -401,11 +401,14 @@ def _finalize_carried(cfg: HeatConfig, res, crop, fetch: bool):
     return res
 
 
-# measured-safe auto fuse depth: k=16 compiles in ~1 min at 16384^2 and
-# still lands 98% of the one-pass roofline (fuse_depth_sharded docstring);
-# the k*=32 auto pick is worth ~14% more but is the depth the round-3
-# sweep saw stall >25 min in compile (cause chip-gated — see
-# benchmarks/compile_bisect.py)
+# auto depths above this get the compile guard. Round-4 measured cold
+# Mosaic compile times for the auto-picked kernels (chipless AOT-topology
+# bisect, benchmarks/compile_bisect_topology*.json): flagship-scale
+# fused kernels cost MINUTES cold (16384-local: k=8 393 s, k=16 980 s,
+# k=32 665 s — bounded), and the thin-band deep-unroll family is a
+# genuine cliff (8192-local k=32 wedged >36 min before being killed).
+# Shallow auto depths (<=16) only arise for small shards, whose bands —
+# and compiles — are small.
 _SAFE_FUSE = 16
 
 
@@ -500,11 +503,13 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
     stall unboundedly in compile, so: when the depth was auto-picked and
     exceeds the measured-safe depth, every program drive() will compile is
     compiled under one wall budget (``HEAT_COMPILE_BUDGET_S``, default
-    600 s; 0 disables); on timeout the solve falls back to fuse_steps=16
-    with a loud warning, job-wide (_agree_any_timeout), and the abandoned
-    compile finishes into the persistent cache (a rerun gets k* for free
-    if it does complete). Explicit --fuse-steps is honored unguarded —
-    the user asked for that exact program.
+    1800 s — flagship Mosaic kernels legitimately cold-compile in
+    minutes; 0 disables); on timeout the solve falls back to the
+    seconds-compiling XLA local kernel with a loud warning, job-wide
+    (_agree_any_timeout), and the abandoned Mosaic compile finishes into
+    the persistent cache (a rerun gets the kernel for free if it does
+    complete). Explicit --fuse-steps or --local-kernel pallas is honored
+    unguarded — the user asked for that exact program.
 
     Returns ``(cfg, precompiled, guard_s)``: on success ``precompiled``
     carries the probe's executables for drive(precompiled=...), so the
@@ -521,12 +526,18 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
     t0 = time.perf_counter()
     kf = fuse_depth_sharded(cfg, mesh.devices.shape)
     if (cfg.fuse_steps or kf <= _SAFE_FUSE or remaining <= 0
+            or cfg.local_kernel != "auto" or cfg.dtype == "float64"
             or not _guard_platform_ok()):
+        # nothing to guard: explicit user program (a requested
+        # --local-kernel pallas must never be silently downgraded to xla
+        # — that IS the "wait the compile out" remedy the fallback
+        # warning advertises), shallow auto depth, or the XLA/f64 path
+        # (seconds-fast compiles) already chosen
         return cfg, None, 0.0
     try:
-        budget = float(os.environ.get("HEAT_COMPILE_BUDGET_S", "600"))
+        budget = float(os.environ.get("HEAT_COMPILE_BUDGET_S", "1800"))
     except ValueError:
-        budget = 600.0
+        budget = 1800.0
     pre, timed_out = None, False
     if budget > 0:  # budget<=0 disables the probe, NOT the agreement
         try:
@@ -544,17 +555,23 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
             pre, timed_out = None, True
     if not _agree_any_timeout(timed_out):
         return cfg, pre, time.perf_counter() - t0
-    fallback = max(1, min(_SAFE_FUSE, *(cfg.n // s
-                                        for s in mesh.devices.shape)))
+    # Fallback must be a program whose compile is KNOWN fast. Shallower
+    # Pallas depths are not that: at flagship scale even k=8 cold-compiles
+    # in ~6-16 min (compile_bisect_topology.json), so a k=16 fallback
+    # would bust the very budget that just expired. The XLA local kernel
+    # compiles in seconds at every measured size (same fused exchange
+    # structure, ~5x lower per-step throughput) — a slower solve that
+    # starts now beats a fast one stuck in Mosaic.
     master_print(
-        f"WARNING: auto fuse depth {kf} did not compile within {budget:.0f}s "
-        f"(HEAT_COMPILE_BUDGET_S); falling back to fuse_steps={fallback} "
-        f"(~87% of the k={kf} sustained throughput at flagship scale: "
-        f"k=16 lands 98% of the one-pass roofline vs 112% at k=32). The "
-        f"abandoned compile continues (and lands in the compile cache when "
-        f"JAX_COMPILATION_CACHE_DIR is set) — a rerun may pick {kf} up "
-        f"instantly. Pass --fuse-steps {kf} to wait it out.")
-    return cfg.with_(fuse_steps=fallback), None, time.perf_counter() - t0
+        f"WARNING: auto fuse depth {kf} (Pallas kernel) did not compile "
+        f"within {budget:.0f}s (HEAT_COMPILE_BUDGET_S); falling back to "
+        f"local_kernel='xla' at the same fuse depth — compiles in seconds, "
+        f"~5x lower per-step throughput. The abandoned Mosaic compile "
+        f"continues (and lands in the compile cache when "
+        f"JAX_COMPILATION_CACHE_DIR is set) — a rerun may pick the kernel "
+        f"up instantly. Pass --local-kernel pallas to wait the compile out.")
+    return (cfg.with_(local_kernel="xla"), None,
+            time.perf_counter() - t0)
 
 
 def _solve_padded_carry(cfg: HeatConfig, T0, mesh, fetch: bool,

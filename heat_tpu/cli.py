@@ -546,8 +546,7 @@ def cmd_info(_args) -> int:
     print(f"machine model: {chip.label} — HBM "
           f"{chip.hbm_bytes_per_s / 1e9:.0f} GB/s, one-pass roofline "
           f"{chip.roofline_points_per_s('float32'):.3e} f32 pts/s"
-          + ("" if chip.calibrated else
-             " (spec-derived; planner geometry uncalibrated on this chip)"))
+          + ("" if chip.calibrated else " — spec-derived table"))
     print(f"process {jax.process_index()}/{jax.process_count()}")
     print(f"native fastio: {'available' if native_available() else 'unavailable (numpy fallback)'}")
     return 0

@@ -137,6 +137,26 @@ def _halo_2d(ksteps: int, dtype) -> int:
     return _round_up(max(ksteps, 1), _sublane(dtype))
 
 
+# thin-band deep-unroll compile cap (round 4): the 32-step unrolled thin
+# kernel on a ~10 MiB band (8320-wide rows, the 8192-local shard family)
+# sent Mosaic/LLVM into a >36-min compile, observed live and killed,
+# while narrow bands (5.4 MiB, 4224-wide — the headline 4096^2 shape)
+# compile at k=32 in ~1 min on chip. Above this band size, thin passes
+# chunk at 16 instead of _KMAX_2D. Per-k curves:
+# benchmarks/compile_bisect_topology*.json (the bisect pins
+# local_kernel="pallas" — off-TPU "auto" measures the XLA program).
+_THIN_DEEP_BAND_CAP_BYTES = 6 * 1024 * 1024
+
+
+def _thin_chunk_cap(n_pad: int, dtype_str) -> int:
+    """Max per-pass unroll for the thin-band kernel at this row width —
+    the compile-sanity analog of the chip table's coltiled band cap."""
+    kpad = _halo_2d(_KMAX_2D, dtype_str)
+    tile = _tile_2d(n_pad, kpad)
+    band = (tile + 2 * kpad) * n_pad * 4
+    return 16 if band > _THIN_DEEP_BAND_CAP_BYTES else _KMAX_2D
+
+
 def _tile_2d(n_pad: int, kpad: int) -> int:
     """Row-tile height: a multiple of kpad (so halo blocks index evenly),
     sized to keep the (tile + 2*kpad)-row band near the budget (the band is
@@ -479,7 +499,7 @@ def _plan_2d(shape, dtype_str, ksteps: int):
         bw = (2.0 * tile + 2 * kpad) * item / (tile * k) / chip.hbm_bytes_per_s
         return compute + bw
 
-    k_thin = min(max(ksteps, 1), _KMAX_2D)
+    k_thin = min(max(ksteps, 1), _thin_chunk_cap(n_pad, dtype_str))
     best_col = None
     for k in (4, 8, 16, 32):
         if k > max(ksteps, 1):
@@ -649,9 +669,11 @@ def _multistep(T: jax.Array, r: float, ksteps: int,
     if T.ndim == 2:
         plan = _plan_2d(logical, str(T.dtype), ksteps)
         if plan[0] == "thin":
+            n_pad = _round_up(max(logical[1], 128), 128)
+            cap = _thin_chunk_cap(n_pad, str(T.dtype))
             done = 0
             while done < ksteps:
-                k = min(_KMAX_2D, ksteps - done)
+                k = min(cap, ksteps - done)
                 T = _pallas_2d(T, r=float(r), ksteps=k, bounds=bounds)
                 done += k
             return T

@@ -19,8 +19,8 @@ from heat_tpu.parallel.mesh import build_mesh
 def _flagship_cfg(**kw):
     kw.setdefault("fuse_steps", 0)
     kw.setdefault("ntime", 500)
-    return HeatConfig(n=16384, dtype="float32",
-                      backend="sharded", mesh_shape=(1, 1), **kw)
+    kw.setdefault("dtype", "float32")
+    return HeatConfig(n=16384, backend="sharded", mesh_shape=(1, 1), **kw)
 
 
 @pytest.fixture
@@ -64,10 +64,11 @@ def test_guard_falls_back_on_compile_timeout(mesh, monkeypatch, capsys):
     cfg = _flagship_cfg()
     assert sharded.fuse_depth_sharded(cfg, (1, 1)) == 32  # the cliff depth
     out, pre, guard_s = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
-    assert out.fuse_steps == 16 and pre is None
+    assert out.local_kernel == "xla" and pre is None
+    assert out.fuse_steps == 0  # depth untouched; the KERNEL falls back
     assert guard_s > 0  # the probe's wall cost is reported, not hidden
     msg = capsys.readouterr().out
-    assert "WARNING" in msg and "fuse_steps=16" in msg
+    assert "WARNING" in msg and "local_kernel='xla'" in msg
 
 
 def test_guard_falls_back_when_a_peer_timed_out(mesh, monkeypatch, capsys):
@@ -80,7 +81,7 @@ def test_guard_falls_back_when_a_peer_timed_out(mesh, monkeypatch, capsys):
                         lambda *a, **kw: {500: object()})
     monkeypatch.setattr(sharded, "_agree_any_timeout", lambda t: True)
     out, pre, _ = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
-    assert out.fuse_steps == 16 and pre is None
+    assert out.local_kernel == "xla" and pre is None
 
 
 def test_guard_hands_probe_executables_forward(mesh, monkeypatch):
@@ -104,6 +105,9 @@ def test_guard_hands_probe_executables_forward(mesh, monkeypatch):
     ("explicit fuse_steps is the user's own program",
      {"fuse_steps": 32}, {}),
     ("remaining 0 compiles nothing", {"ntime": 0}, {}),
+    ("xla local kernel compiles in seconds — nothing to guard",
+     {"local_kernel": "xla"}, {}),
+    ("f64 runs the XLA path", {"dtype": "float64"}, {}),
 ])
 def test_guard_stays_out_of_the_way(mesh, monkeypatch, why, cfg_kw, env):
     for k, v in env.items():
@@ -158,7 +162,7 @@ def test_guard_probe_exception_falls_back_and_joins_agreement(
 
     monkeypatch.setattr(sharded, "_agree_any_timeout", agree)
     out, pre, _ = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
-    assert out.fuse_steps == 16 and pre is None
+    assert out.local_kernel == "xla" and pre is None
     assert joined == [True]
     assert "probe failed" in capsys.readouterr().out
 

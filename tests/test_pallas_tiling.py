@@ -138,3 +138,16 @@ def test_plan_pins_match_measured_optima():
     assert ps._plan_3d((512, 512, 512), "float32", 8) == (
         (512, 512, 512), 64, 64, 8)
     assert ps._plan_2d((4096, 4096), "float32", 16) == ("thin", 16)
+
+
+def test_thin_deep_unroll_compile_cap():
+    """Round-4 measured (AOT-topology bisect, Mosaic pinned): the 32-step
+    unrolled thin kernel wedges Mosaic >36 min on ~10 MiB bands
+    (8320-wide), while the 4224-wide headline shape compiles k=32 in
+    ~1 min. Wide thin passes must chunk at 16; narrow ones keep 32."""
+    assert ps._thin_chunk_cap(4224, "float32") == 32   # headline 4096^2
+    assert ps._thin_chunk_cap(8320, "float32") == 16   # the wedge family
+    assert ps._thin_chunk_cap(16512, "float32") == 16
+    # the planner's thin choice reflects the cap (costs stay honest)
+    plan = ps._plan_2d((8192, 8192), "float32", 32)
+    assert plan[0] != "thin" or plan[1] <= 16
