@@ -89,16 +89,12 @@ def child(k: int, n: int, steps: int, smoke: bool,
         mesh = build_mesh(2, mesh_shape)
         ctx = contextlib.nullcontext()
 
-    # local_kernel MUST be pinned: in topology mode jax.default_backend()
-    # is cpu, so "auto" silently selects the XLA local kernel and the
-    # measurement bisects the wrong program entirely (the first topology
-    # curves in round 4 made exactly this mistake — flat 5-9 s that was
-    # the XLA path, while the real Mosaic compile wedged >30 min)
     # pin the Pallas kernel in BOTH modes: on-chip "auto" would resolve to
-    # pallas anyway (f32 on TPU), and in topology mode default_backend()
-    # is cpu so "auto" would silently bisect the XLA program (the
-    # round-4 retracted-curve bug); deep_fuse_proven requires the row to
-    # carry local_kernel == "pallas"
+    # pallas anyway (f32 on TPU), but in topology mode default_backend()
+    # is cpu, so "auto" silently bisects the XLA program — the round-4
+    # retracted-curve bug (flat 5-14 s "curves" that were the XLA path
+    # while the real Mosaic compile wedged >30 min). deep_fuse_proven
+    # requires the row to carry local_kernel == "pallas".
     lk = "pallas"
     cfg = HeatConfig(n=n_glob, ntime=steps, dtype="float32",
                      backend="sharded", mesh_shape=mesh_shape, fuse_steps=k,
@@ -217,7 +213,8 @@ def main() -> None:
             row = {"k": k, "error": f"WEDGED: no compile within "
                    f"{args.timeout}s (killed)"}
         row["wall_s"] = time.time() - t0
-        rec["rows"][str(k)] = row
+        # uncapped wedge-probe rows must not clobber the capped curve
+        rec["rows"][f"{k}_uncapped" if args.uncap else str(k)] = row
         msg = (f"compile k={k}: " +
                (f"lower {row['lower_s']:.1f}s compile {row['compile_s']:.1f}s"
                 if "compile_s" in row else row["error"]))

@@ -191,3 +191,17 @@ def test_error_line_carries_last_good(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "_LAST_GOOD", str(tmp_path / "missing.json"))
     rec2 = json.loads(bench._error_line("backend gone"))
     assert "last_good" not in rec2  # absent cache: plain error line
+
+
+def test_error_line_rejects_mismatched_last_good(tmp_path, monkeypatch):
+    """A stale cache from a DIFFERENT benchmark configuration (other
+    N/STEPS -> other metric string) must not ride along on this metric's
+    error line (advisor r3 finding)."""
+    cache = tmp_path / "last_bench.json"
+    cache.write_text(json.dumps({
+        "metric": "grid_points_per_sec_per_chip_1024x1024_f32_pallas",
+        "value": 9.9e10, "measured_ts": 1785469590.0}))
+    monkeypatch.setattr(bench, "_LAST_GOOD", str(cache))
+    rec = json.loads(bench._error_line("backend gone"))
+    assert rec["value"] == 0.0
+    assert "last_good" not in rec
