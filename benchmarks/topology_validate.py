@@ -64,9 +64,24 @@ def _compile_case(topology, mesh_shape, cfg, steps):
                                            make_padded_carry_machinery)
     from heat_tpu.utils import jnp_dtype
 
-    mesh = topologies.make_mesh(
-        topologies.get_topology_desc(topology, "tpu"), mesh_shape,
-        tuple("xyz"[: len(mesh_shape)]))
+    names = tuple("xyz"[: len(mesh_shape)])
+    topo = topologies.get_topology_desc(topology, "tpu")
+    try:
+        mesh = topologies.make_mesh(topo, mesh_shape, names)
+    except AssertionError:
+        # v4-era topology descriptors expose per-core devices and
+        # mesh_utils insists on megacore (one-device-per-chip)
+        # granularity. A naive reshape placement is fine here: this lab
+        # validates COMPILATION (VMEM/memory verdicts), no wire traffic
+        # ever flows.
+        import math as _math
+
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        need = _math.prod(mesh_shape)
+        mesh = Mesh(_np.asarray(topo.devices[:need]).reshape(mesh_shape),
+                    names)
     kf = fuse_depth_sharded(cfg, mesh_shape)
     _, advance, _ = make_padded_carry_machinery(cfg, mesh)
     struct = jax.ShapeDtypeStruct(

@@ -63,8 +63,9 @@ V5E = ChipModel("v5e", hbm_bytes_per_s=819e9, vpu_ops_per_s=2.2e12,
                 coltiled_band_cap_bytes=10 * _MIB, calibrated=True)
 
 
-def _scaled(name: str, hbm: float, peak_ratio: float,
-            vmem_mib: int = 110) -> ChipModel:
+def _scaled(name: str, hbm: float, peak_ratio: float, vmem_mib: int = 110,
+            fit_mib: int = 88, band_mib: int = 12,
+            coltiled_mib: int = 10) -> ChipModel:
     """Spec-derived model: public HBM number; VPU rates = v5e fitted rates
     x the public peak-compute ratio vs v5e (197 bf16 TFLOP/s)."""
     return ChipModel(
@@ -72,18 +73,23 @@ def _scaled(name: str, hbm: float, peak_ratio: float,
         vpu_ops_per_s=V5E.vpu_ops_per_s * peak_ratio,
         ops_rate_3d=V5E.ops_rate_3d * peak_ratio,
         vmem_limit_bytes=vmem_mib * _MIB,
-        vmem_fit_bytes=(vmem_mib - 22) * _MIB,
-        band_budget_bytes=V5E.band_budget_bytes,
-        coltiled_band_cap_bytes=V5E.coltiled_band_cap_bytes,
+        vmem_fit_bytes=fit_mib * _MIB,
+        band_budget_bytes=band_mib * _MIB,
+        coltiled_band_cap_bytes=coltiled_mib * _MIB,
         calibrated=False)
 
 
 # public specs (jax-ml.github.io/scaling-book chip table): v4 1228 GB/s /
-# 275 bf16 TFLOP/s; v5p 2765 GB/s / 459; v6e (Trillium) 1640 GB/s / 918
+# 275 bf16 TFLOP/s; v5p 2765 GB/s / 459; v6e (Trillium) 1640 GB/s / 918.
+# v4 VMEM is 16 MiB/core (not the 128 MiB of v5e/v5p/v6e) — the first
+# spec table assumed 110 MiB and the AOT compile validator
+# (benchmarks/topology_validate.py) caught it with a real
+# RESOURCE_EXHAUSTED vmem verdict; bands must shrink accordingly.
 _CHIPS = {
     "v5e": V5E,
     "v5p": _scaled("v5p", 2765e9, 459 / 197),
-    "v4": _scaled("v4", 1228e9, 275 / 197),
+    "v4": _scaled("v4", 1228e9, 275 / 197, vmem_mib=14, fit_mib=9,
+                  band_mib=2, coltiled_mib=2),
     "v6e": _scaled("v6e", 1640e9, 918 / 197),
 }
 

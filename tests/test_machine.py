@@ -89,3 +89,20 @@ def test_headline_record_labels_baseline_chip():
     assert rec["baseline_chip"].startswith(machine.current().name)
     assert rec["vs_baseline"] == pytest.approx(
         rec["value"] / machine.current().roofline_points_per_s("float32"))
+
+
+def test_v4_small_vmem_table_plans_small_bands():
+    """v4 has 16 MiB VMEM/core, not v5e's 128 — the AOT compile validator
+    (benchmarks/topology_validate.py) caught the original spec table's
+    110 MiB assumption with a real RESOURCE_EXHAUSTED verdict. The v4
+    entry must keep every planned band inside the real VMEM."""
+    machine.override("TPU v4")
+    chip = machine.current()
+    assert chip.vmem_limit_bytes <= 14 * 1024 * 1024
+    plan = _plan_2d((4096, 4096), "float32", 32)
+    assert plan[0] == "coltiled"
+    _, R, C, kr, kc, k = plan
+    band = (R + 2 * kr) * (C + 2 * kc) * 4  # accumulation dtype
+    assert band <= chip.vmem_fit_bytes
+    plan3 = _plan_3d((512, 512, 512), "float32", 8)
+    assert plan3 is not None  # a plan still exists under 16 MiB
