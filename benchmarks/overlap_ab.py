@@ -34,9 +34,17 @@ from _util import write_atomic  # noqa: E402
 
 
 def _ks() -> tuple[int, ...]:
+    """Depths for the A/B. Default: fuse 16 only — the A/B question (does
+    the interior/rim restructuring win?) is answerable at one depth, and
+    the chipless compile check measured the flagship overlap program at
+    1833 s cold (overlap_compile_check.json: 5 Mosaic kernels vs indep's
+    1), so two depths' worth of cold compiles would blow the chip phase.
+    ``--deep`` adds 32 when a Pallas-pinned bisect proved it bounded."""
     from _util import deep_fuse_proven
 
-    return (16, 32) if deep_fuse_proven(32) else (16,)
+    if "--deep" in sys.argv and deep_fuse_proven(32):
+        return (16, 32)
+    return (16,)
 
 
 def main():
