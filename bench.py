@@ -103,7 +103,9 @@ def measure() -> None:
     # persist compiles across attempts (and across rehearsal runs of this
     # same measurement): a warm cache turns the ~1 min kernel compile into
     # a cache hit, keeping attempts comfortably inside the budget
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+    from heat_tpu.utils import ensure_cache_env
+
+    ensure_cache_env()  # per-user default (ADVICE r4); user env honored
     from heat_tpu import benchmark
 
     # N/STEPS/REPEATS are duplicated here so the supervisor never imports

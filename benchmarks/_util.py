@@ -16,6 +16,23 @@ def write_atomic(out: Path, obj) -> None:
     os.replace(tmp, out)
 
 
+def ensure_cache_env() -> str:
+    """``heat_tpu.utils.cache.ensure_cache_env`` for supervisor processes
+    that must stay jax-free (compile_bisect, run_all parents: importing
+    the heat_tpu package pulls jax in, adding import cost and failure
+    modes to the process whose job is to outlive wedged children). The
+    module file is stdlib-only, so load it by PATH — one source of truth
+    for the cache-dir derivation, no package ``__init__`` executed."""
+    import importlib.util
+
+    src = Path(__file__).parent.parent / "heat_tpu" / "utils" / "cache.py"
+    spec = importlib.util.spec_from_file_location("_heat_cache_standalone",
+                                                  src)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.ensure_cache_env()
+
+
 def deep_fuse_proven(k: int = 32, budget_s: float = 1500) -> bool:
     """Has a bisect artifact PROVEN the depth-``k`` flagship compile
     bounded? True once either the on-chip bisect or the chipless

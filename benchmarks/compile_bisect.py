@@ -132,7 +132,7 @@ def main() -> None:
                     help="seconds per k before the row is declared wedged")
     ap.add_argument("--cache", choices=("fresh", "shared"), default="fresh",
                     help="fresh: cold-compile each k in its own cache dir; "
-                         "shared: reuse /tmp/jax_cache (warm behavior)")
+                         "shared: reuse the persistent cache (warm behavior)")
     ap.add_argument("--topology", nargs="?", const="v5e:2x2", default=None,
                     help="AOT topology mode: compile the flagship-shard "
                          "program locally against a virtual TPU topology — "
@@ -183,7 +183,10 @@ def main() -> None:
             tmp = tempfile.mkdtemp(prefix=f"jax_cache_bisect_k{k}_")
             env["JAX_COMPILATION_CACHE_DIR"] = tmp
         else:
-            env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+            from _util import ensure_cache_env
+            ensure_cache_env()
+            env["JAX_COMPILATION_CACHE_DIR"] = \
+                os.environ["JAX_COMPILATION_CACHE_DIR"]
         cmd = [sys.executable, __file__, "--child", str(k),
                "--n", str(n)]  # MUST forward: the first n8192 curve forgot
         # this and silently re-measured the 16384-local program under an

@@ -21,15 +21,20 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# persistent compile cache: kernel sweeps re-run the same programs across
-# lab sessions; compiles here run tens of seconds to minutes
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-
 import pathlib
 
 if str(pathlib.Path(__file__).resolve().parent.parent) not in sys.path:
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# persistent compile cache: kernel sweeps re-run the same programs across
+# lab sessions; compiles here run tens of seconds to minutes. Honor a
+# user-set JAX_COMPILATION_CACHE_DIR; default per-user (ADVICE r4 —
+# ensure_cache_env also pushes into the live jax config, since jax is
+# already imported here)
+from heat_tpu.utils import ensure_cache_env  # noqa: E402
+
+ensure_cache_env()
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 from heat_tpu import machine  # noqa: E402
 
 # the framework's Mosaic VMEM ceiling for this chip — lab kernels must

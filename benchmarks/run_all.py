@@ -21,13 +21,16 @@ from pathlib import Path
 # per-row subprocess isolation (supervise_rows) re-imports jax in every
 # child; a persistent compile cache keeps that to a cache hit instead of a
 # full recompile — set here so direct invocations get it, not only runs
-# launched via watch_and_sweep.sh
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
-
+# launched via watch_and_sweep.sh. Per-user path (ADVICE r4: a fixed
+# world-shared /tmp path invites collisions/tampering on multi-user hosts);
+# the stdlib-only _util mirror keeps jax out of this supervisor process —
+# children inherit the env var.
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-from _util import write_atomic  # noqa: E402
+from _util import ensure_cache_env, write_atomic  # noqa: E402
+
+ensure_cache_env()
 
 
 def bench_one(name, cfg, repeat=1):

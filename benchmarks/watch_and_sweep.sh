@@ -13,7 +13,10 @@
 # kernel A/Bs (VERDICT #2) first, correctness certification and the
 # long full-table refresh last.
 set -u
-export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}"
+# per-user persistent cache default (ADVICE r4); user env honored. Keep
+# the XDG fallback in sync with heat_tpu/utils/cache.py so launcher and
+# direct invocations share ONE warm cache.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${XDG_CACHE_HOME:-$HOME/.cache}/heat_tpu/jax}"
 export PYTHONPATH="$(cd "$(dirname "$0")/.." && pwd):${PYTHONPATH:-}"
 cd "$(dirname "$0")/.."
 

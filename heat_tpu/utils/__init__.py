@@ -1,1 +1,2 @@
+from .cache import default_cache_dir, ensure_cache_env  # noqa: F401
 from .dtypes import jnp_dtype, ensure_precision  # noqa: F401

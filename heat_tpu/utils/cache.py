@@ -1,0 +1,46 @@
+"""Per-user default for the persistent XLA compile cache.
+
+Flagship Mosaic kernels cold-compile in minutes (benchmarks/
+compile_bisect_topology.json); the persistent cache is what makes reruns
+and guard-abandoned compiles pay forward. A fixed world-shared path like
+``/tmp/jax_cache`` risks permission collisions and cache tampering on
+multi-user hosts (ADVICE r4), so every harness default routes through
+here: a per-user directory, with a user-set ``JAX_COMPILATION_CACHE_DIR``
+always honored.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+
+def default_cache_dir() -> str:
+    """Stable per-user compile-cache path (no I/O, no directory creation —
+    jax creates it on first write)."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    if not os.path.isabs(base):  # ~ unresolvable (no HOME): fall back to
+        # a uid-suffixed tempdir, still collision-free per user
+        uid = getattr(os, "getuid", lambda: "u")()
+        return os.path.join(tempfile.gettempdir(), f"heat_tpu_jax_{uid}")
+    return os.path.join(base, "heat_tpu", "jax")
+
+
+def ensure_cache_env() -> str:
+    """Set ``JAX_COMPILATION_CACHE_DIR`` to the per-user default unless the
+    user already chose one; returns the effective path.
+
+    jax snapshots the env var ONCE at import time — and importing this
+    package pulls jax in transitively, so no caller can reliably run
+    before that snapshot. When jax is already imported and its cache dir
+    is still unset, push the default into the live config too; an env var
+    or ``jax.config.update`` the user already applied is never overridden.
+    Subprocesses inherit the env var either way."""
+    path = os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                                 default_cache_dir())
+    j = sys.modules.get("jax")
+    if j is not None and j.config.jax_compilation_cache_dir is None:
+        j.config.update("jax_compilation_cache_dir", path)
+    return path

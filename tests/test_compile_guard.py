@@ -101,6 +101,85 @@ def test_guard_hands_probe_executables_forward(mesh, monkeypatch):
     assert calls == [(32, 500, True)]
 
 
+def test_guard_timeout_on_overlap_degrades_exchange_too(mesh, monkeypatch,
+                                                        capsys):
+    """VERDICT r4 #1 (reproduced crash): exchange='overlap' is built on the
+    Pallas kernel, so a guard fallback to local_kernel='xla' that leaves
+    exchange='overlap' set hands make_local_multistep a cfg it rejects.
+    The fallback must degrade BOTH knobs — never raise."""
+    monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0.05")
+    monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
+    monkeypatch.setattr(sharded, "_compile_probe",
+                        lambda *a, **kw: time.sleep(30))
+    cfg = _flagship_cfg(exchange="overlap")
+    out, pre, guard_s = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
+    assert out.local_kernel == "xla" and out.exchange == "indep"
+    assert pre is None and guard_s > 0
+    msg = capsys.readouterr().out
+    assert "overlap" in msg and "'indep'" in msg
+    # the degraded cfg must be one make_local_multistep accepts (this is
+    # the exact line the unfixed fallback crashed on)
+    sharded.make_local_multistep(out, ("x", "y"), (1, 1))
+
+
+def test_guard_probe_crash_on_overlap_degrades_exchange_too(
+        mesh, monkeypatch):
+    """Same cross-feature hole via the probe-crash branch (e.g.
+    RESOURCE_EXHAUSTED on the deep unroll)."""
+    monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "5")
+    monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
+
+    def boom(*a, **kw):
+        raise RuntimeError("RESOURCE_EXHAUSTED: vmem")
+
+    monkeypatch.setattr(sharded, "_compile_probe", boom)
+    out, pre, _ = sharded._guard_fuse_compile(
+        _flagship_cfg(exchange="overlap"), mesh, 500)
+    assert out.local_kernel == "xla" and out.exchange == "indep"
+    sharded.make_local_multistep(out, ("x", "y"), (1, 1))
+
+
+def test_guard_timeout_keeps_non_overlap_exchange(mesh, monkeypatch):
+    """The degrade is surgical: seq/indep exchanges run fine on the XLA
+    kernel and must survive the fallback untouched."""
+    monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0.05")
+    monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
+    monkeypatch.setattr(sharded, "_compile_probe",
+                        lambda *a, **kw: time.sleep(30))
+    for exch in ("seq", "indep"):
+        out, _, _ = sharded._guard_fuse_compile(
+            _flagship_cfg(exchange=exch), mesh, 500)
+        assert (out.local_kernel, out.exchange) == ("xla", exch)
+
+
+def test_guarded_overlap_solve_end_to_end_on_timeout(mesh, monkeypatch):
+    """The verdict's repro, at test scale: a guard timeout on an overlap
+    cfg must SOLVE (via the degraded indep+xla program) and match the
+    oracle bitwise — not raise ValueError."""
+    import numpy as np
+
+    cfg = HeatConfig(n=64, ntime=20, heartbeat_every=8, dtype="float32",
+                     backend="sharded", mesh_shape=(1, 1),
+                     exchange="overlap")
+    ref = sharded.solve(cfg.with_(exchange="indep", local_kernel="xla"),
+                        fetch=True)
+    monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0.05")
+    monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
+    monkeypatch.setattr(sharded, "_SAFE_FUSE", 1)  # open the depth gate
+    monkeypatch.setattr(sharded, "_compile_probe",
+                        lambda *a, **kw: time.sleep(30))
+    got = sharded.solve(cfg, fetch=True)
+    np.testing.assert_array_equal(np.asarray(ref.T), np.asarray(got.T))
+
+
+def test_default_budget_clears_measured_flagship_compiles():
+    """The budget must sit ABOVE every measured legitimate cold compile
+    (slowest: 1833 s, benchmarks/overlap_compile_check.json) — otherwise
+    the default-config overlap run defaults into the fallback (VERDICT r4
+    weak #1: the old 1800 s default did exactly that)."""
+    assert float(sharded._DEFAULT_BUDGET_S) > 1833
+
+
 @pytest.mark.parametrize("why,cfg_kw,env", [
     ("explicit fuse_steps is the user's own program",
      {"fuse_steps": 32}, {}),

@@ -77,3 +77,22 @@ def test_merge_survives_corrupt_results_file(tmp_path):
     out.write_text('{"ts": 1, "rows": [{"na')  # truncated by a SIGKILL
     run_all._merge_rows(out, [{"name": "a", "v": 1}])
     assert json.loads(out.read_text())["rows"] == [{"name": "a", "v": 1}]
+
+
+def test_cache_env_util_matches_package(monkeypatch):
+    """benchmarks/_util.ensure_cache_env loads heat_tpu/utils/cache.py by
+    file path (no package __init__, hence no jax import of its own); this
+    pins that both routes derive the SAME per-user path — a fork here
+    splits the warm compile cache and re-pays minutes-long flagship
+    compiles (code-review r5)."""
+    bench_dir = str(Path(__file__).resolve().parent.parent / "benchmarks")
+    if bench_dir not in sys.path:
+        sys.path.insert(0, bench_dir)
+    import _util
+    from heat_tpu.utils import default_cache_dir
+
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    assert _util.ensure_cache_env() == default_cache_dir()
+    # a user-set value is always honored, never overridden
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/custom/cache")
+    assert _util.ensure_cache_env() == "/custom/cache"
