@@ -114,13 +114,49 @@ def child(k: int, n: int, steps: int, smoke: bool,
         lowered = advance.lower(padded, steps)
         t_lower = time.perf_counter() - t0
         t0 = time.perf_counter()
-        lowered.compile()
+        compiled = lowered.compile()
         t_compile = time.perf_counter() - t0
+    # Program fingerprint for the compile-cost curve (VERDICT r4 weak #3:
+    # a non-monotone curve needs a CAUSE): how many Mosaic kernel calls
+    # the program makes and how many DISTINCT kernel bodies Mosaic had to
+    # compile — k=32 chunks into two unroll-16 passes at the thin cap, so
+    # if both passes share one body its compile should NOT cost more than
+    # k=16's single pass.
+    census = {}
+    try:
+        import hashlib
+        import re
+
+        txt = compiled.as_text()
+        # A Mosaic kernel call line carries custom_call_target="tpu_custom
+        # _call" plus its payload (backend_config — BRACE syntax in this
+        # XLA, not the quoted form a first cut assumed, which recorded
+        # mosaic_calls=0 against visibly custom-call-bearing programs).
+        # Distinctness = hash of the line from custom_call_target onward
+        # with SSA ids normalized — best-effort but syntax-insensitive.
+        lines = [ln for ln in txt.splitlines() if "custom-call" in ln]
+        mosaic, method = [], "target-match"
+        for ln in lines:
+            m = re.search(r'custom_call_target="([^"]*)".*', ln)
+            if m and "tpu" in m.group(1):
+                mosaic.append(m.group(0))
+        if not mosaic and lines:  # unexpected printer syntax: fall back
+            # to whole-line hashing and SAY so, rather than recording a
+            # confident-looking zero
+            mosaic, method = list(lines), "line-hash-fallback"
+        norm = [re.sub(r"%[\w.\-]+", "%", c) for c in mosaic]
+        census = {"custom_calls": len(lines),
+                  "mosaic_calls": len(mosaic),
+                  "distinct_kernel_bodies": len(
+                      {hashlib.sha1(c.encode()).hexdigest() for c in norm}),
+                  "census_method": method}
+    except Exception as e:  # census is best-effort; the timing is the row
+        census = {"census_error": f"{type(e).__name__}: {e}"}
     print(json.dumps({"k": k, "n_local": n, "lower_s": t_lower,
                       "compile_s": t_compile, "local_kernel": lk,
                       "uncapped": uncap,
                       "platform": jax.default_backend(),
-                      "topology": topology}), flush=True)
+                      "topology": topology, **census}), flush=True)
 
 
 def main() -> None:

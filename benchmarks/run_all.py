@@ -49,11 +49,11 @@ def bench_one(name, cfg, repeat=1):
     # only — the numpy oracle has no dispatch overhead to cancel and
     # reports null there).
     res = solve(cfg, fetch=False, warm_exec=True, two_point_repeats=2)
-    best = res.timing
+    best, best_guard = res.timing, res.guard
     for _ in range(repeat - 1):
         r = solve(cfg, fetch=False, warm_exec=True, two_point_repeats=2)
         if r.timing.solve_s < best.solve_s:
-            best = r.timing
+            best, best_guard = r.timing, r.guard
     chip = machine.current()
     roofline = chip.roofline_points_per_s(cfg.dtype)
     tp = best.points_per_s_two_point
@@ -74,6 +74,13 @@ def bench_one(name, cfg, repeat=1):
         "devices": len(jax.devices()),
         "platform": jax.default_backend(),
     }
+    if best_guard is not None:
+        # a row measured on the guard's DEGRADED program must say so —
+        # silently recording the ~5x-slower xla fallback as the flagship
+        # rate would poison the official table (VERDICT r4 #8)
+        import dataclasses as _dc
+
+        row["guard"] = _dc.asdict(best_guard)
     tp_note = (f"  two-point {tp:.3e} ({100 * tp / roofline:.1f}%)"
                if tp else "")
     print(f"{name:40s} {row['points_per_s']:.3e} pts/s  "

@@ -92,6 +92,10 @@ def main():
     ap.add_argument("--n", type=int, default=1024)
     ap.add_argument("--fuse", type=int, default=4)
     ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--out", default="topology_schedule.json",
+                    help="output filename (under benchmarks/) — flagship-"
+                         "shape runs must not clobber the toy-scale row")
+    ap.add_argument("--exchanges", default="seq,indep,overlap")
     args = ap.parse_args()
 
     import jax
@@ -111,13 +115,13 @@ def main():
     mesh = topologies.make_mesh(topo, mesh_shape,
                                 tuple("xyz"[: len(mesh_shape)]))
 
-    out = Path(__file__).parent / "topology_schedule.json"
+    out = Path(__file__).parent / args.out
     rec = {"ts": time.time(), "topology": args.topology,
            "mesh": list(mesh_shape), "n": args.n, "fuse": args.fuse,
            "steps": args.steps, "rows": {}}
 
     with force_compiled_kernels():
-        for ex in ("seq", "indep", "overlap"):
+        for ex in args.exchanges.split(","):
             cfg = HeatConfig(n=args.n, ntime=args.steps, dtype="float32",
                              backend="sharded", mesh_shape=mesh_shape,
                              fuse_steps=args.fuse, exchange=ex,

@@ -42,6 +42,10 @@ class SolveResult:
     mesh_shape: Optional[tuple] = None  # decomposition used (sharded backend)
     T_dev: Any = None              # final field on device (jax.Array)
     mesh: Any = None               # jax.sharding.Mesh (sharded backend)
+    guard: Any = None              # sharded.GuardReport when the compile
+                                   # guard probed (probe cost, timeout
+                                   # verdict, orphan disposition) — bench
+                                   # rows must surface a degraded program
 
 
 def register(name: str):

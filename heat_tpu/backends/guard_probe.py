@@ -1,0 +1,115 @@
+"""Killable compile-guard probe (child process).
+
+Round-4's guard ran its probe in a daemon THREAD: a timed-out Mosaic
+compile could not be cancelled (C++ holds the GIL-released core) and kept
+burning a core — possibly >36 min for the thin-band deep-unroll wedge —
+polluting the very bench row the fallback solve was producing (VERDICT r4
+next #8). This child process is the fix: it performs the same
+``_compile_probe`` AOT compiles *chiplessly* via
+``jax.experimental.topologies`` (the Mosaic + XLA:TPU compilers ship in
+libtpu and need no device — the round-4 compile-lab machinery), then
+ships the executables back to the parent through
+``jax.experimental.serialize_executable``. On budget expiry the parent
+SIGKILLs this process group and the orphan compile dies with it.
+
+Spec protocol (argv[1] = JSON file):
+  cfg:        dataclasses.asdict(HeatConfig)
+  mesh_shape: list[int]     — parent mesh axis sizes
+  axis_names: list[str]
+  kf / remaining / padded   — forwarded to _compile_probe
+  platform:   "tpu" | "cpu" — parent's default backend
+  chip:       "v5e" | ...   — machine.classify name (tpu only)
+  out:        path for the pickled {k: serialized-executable} result
+
+Exit codes: 0 = result written; anything else = probe failed (the parent
+falls back to the in-thread probe — e.g. when another process holds the
+libtpu lockfile, a single-resource constraint the thread path never hits).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import pickle
+import sys
+
+
+# device counts the probe knows how to spell as a physical topology; the
+# serialized executable's device assignment must match the parent's
+# device count, not its logical mesh shape
+_TOPO_BY_NDEV = {1: "1x1", 2: "1x2", 4: "2x2", 8: "2x4", 16: "4x4"}
+
+
+def topology_name(chip: str, ndev: int) -> str | None:
+    dims = _TOPO_BY_NDEV.get(ndev)
+    return f"{chip}:{dims}" if dims else None
+
+
+def main() -> int:
+    spec = json.loads(open(sys.argv[1]).read())
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # chipless by construction
+
+    from jax.experimental import serialize_executable
+
+    from ..config import HeatConfig
+    from .sharded import _compile_probe
+
+    cfg = HeatConfig(**spec["cfg"])
+    mesh_shape = tuple(spec["mesh_shape"])
+    axis_names = tuple(spec["axis_names"])
+    ndev = 1
+    for s in mesh_shape:
+        ndev *= s
+
+    if spec["platform"] == "tpu":
+        from jax.experimental import topologies
+
+        from .. import machine
+        from ..ops.pallas_stencil import force_compiled_kernels
+
+        if not os.environ.get("HEAT_CHIP_CALIBRATION"):
+            # this forced-CPU process would otherwise plan with
+            # machine._DEFAULT (v5e) geometry/VMEM ceilings — on a
+            # v5p/v6e parent that compiles a program the parent's planner
+            # would never pick. A calibration env (inherited) wins, as it
+            # does in the parent.
+            machine.override(spec["chip"])
+        name = topology_name(spec["chip"], ndev)
+        if name is None:
+            print(f"no topology spelling for {ndev} devices", file=sys.stderr)
+            return 3
+        topo = topologies.get_topology_desc(name, "tpu")
+        mesh = topologies.make_mesh(topo, mesh_shape, axis_names)
+        ctx = force_compiled_kernels()
+    else:  # cpu parent (tests): same-platform compile, no topology needed
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if len(devs) < ndev:
+            print(f"need {ndev} cpu devices, have {len(devs)} — set "
+                  f"XLA_FLAGS=--xla_force_host_platform_device_count",
+                  file=sys.stderr)
+            return 3
+        mesh = Mesh(np.array(devs[:ndev]).reshape(mesh_shape), axis_names)
+        ctx = contextlib.nullcontext()
+
+    with ctx:
+        pre = _compile_probe(cfg, mesh, spec["kf"], spec["remaining"],
+                             spec["padded"])
+        payloads = {k: serialize_executable.serialize(c)
+                    for k, c in pre.items()}
+
+    tmp = spec["out"] + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(payloads, f)
+    os.replace(tmp, spec["out"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,7 +32,9 @@ BC semantics:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import itertools
 import os
 import threading
 import time
@@ -52,7 +54,8 @@ from ..config import HeatConfig
 from ..ops.pallas_stencil import (_NO_FREEZE, ftcs_multistep_bounded_pallas,
                                   pallas_available)
 from ..ops.stencil import accum_dtype_for, laplacian_interior
-from ..parallel.halo import halo_exchange, halo_exchange_indep, halo_pad
+from ..parallel.halo import (halo_exchange, halo_exchange_indep, halo_pad,
+                             halo_recvs)
 from ..parallel.mesh import build_mesh, validate_divisible
 from ..runtime.logging import master_print
 from ..utils import jnp_dtype
@@ -173,6 +176,13 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
             p = mini_step(p)
         return p
 
+    def _set(out, src, dst_sl, src_sl):
+        # all slicing is static; skip degenerate spans (tiny shards).
+        # Shared by both overlap formulations below.
+        if any(s.stop <= s.start for s in dst_sl):
+            return out
+        return out.at[tuple(dst_sl)].set(src[tuple(src_sl)])
+
     def padded_multi_overlap(padded: jax.Array, wpad: int,
                              ksteps: int) -> jax.Array:
         """``padded_multi`` restructured so the halo exchange can fly
@@ -192,46 +202,142 @@ def make_local_multistep(cfg: HeatConfig, axis_names, axis_sizes):
            field: zero data dependency on the collectives, so XLA's
            latency-hiding scheduler is free to hoist the ppermute starts
            before it and sink the dones after it.
-        2. **Exchange**: the indep ghost-write formulation, unchanged.
-        3. **Rim bands** (owned cells < wpad from a shard face): per face,
-           a 3*wpad-deep input band — fresh ghosts + rim + support —
-           spanning the full extent of the other axes, run through the
-           same bounded kernel with face-offset bounds. Band-edge garbage
-           travels one cell per mini-step and never reaches the kept rim
-           (distance >= wpad >= ksteps), the same invariant as the
-           exchange itself.
+        2. **Exchange**: the indep RECEIVES (halo_recvs) kept as separate
+           per-face slabs — never written into one array on this path. A
+           rim kernel slicing the fully-written array would depend on
+           EVERY collective; round 4 shipped exactly that and the
+           flagship schedule census showed the cost: 1 kernel in flight
+           of 7, 3 of 4 windows empty
+           (benchmarks/topology_schedule_flagship_f16.json).
+        3. **Boundary regions** (round 5, the narrow-dependency rework):
+           the owned rim splits into the 3^nd - 1 regions of cells within
+           wpad of each face subset. A FACE region's input is assembled
+           from pre-exchange data + that one axis's ghost slab only, so
+           its only wire dependency is its own axis's ppermutes — the
+           scheduler can run it inside the other axes' flight windows
+           (the recvs chain is sequential by axis: axis d's sends stitch
+           e<d's fresh corners). Edge/corner regions (3*wpad per nonzero
+           axis) depend on exactly their axes' slabs and are tiny.
+           Band-edge garbage travels one cell per mini-step and never
+           reaches a kept cell (distance >= wpad >= ksteps), the same
+           invariant as the exchange itself. Tiny shards (local < 2*wpad,
+           where a 3*wpad input would cross into the far ghost margin)
+           take the round-4 wide formulation (_overlap_wide) instead.
 
-        Extra compute vs the fused form: the bands re-cover ~8*wpad/L of
-        the block (1.6% at 16384^2, wpad=32) plus 2*nd extra kernel
+        Extra compute vs the fused form: the regions re-cover ~8*wpad/L
+        of the block (1.6% at 16384^2, wpad=32) plus the extra kernel
         launches per block; the win is the exchange latency hidden behind
-        the interior pass. Kept-region writes are disjoint by
-        construction (earlier axes' bands own the corners)."""
+        the interior and prior-axis face passes. Kept-region writes are
+        disjoint and complete by construction (each owned cell's region
+        is determined by its per-axis rim membership)."""
         w = wpad
         nd = padded.ndim
         Lp = padded.shape
-
-        def _set(out, src, dst_sl, src_sl):
-            # all slicing is static; skip degenerate spans (tiny shards)
-            if any(s.stop <= s.start for s in dst_sl):
-                return out
-            return out.at[tuple(dst_sl)].set(src[tuple(src_sl)])
 
         # 1) interior, from the PRE-exchange field
         owned = padded[tuple(slice(w, -w) for _ in range(nd))]
         nofreeze = jnp.asarray([-_NO_FREEZE, _NO_FREEZE] * nd, jnp.int32)
         interior = ftcs_multistep_bounded_pallas(owned, r, ksteps, nofreeze)
-        # 2) the exchange (the collectives the interior overlaps with)
-        padded0 = exchange_fn(
-            padded, axis_names, axis_sizes, bc_value,
-            staged=staged, width=w, periodic=periodic,
-        )
         bounds = _shard_bounds(Lp, w)
-        out = padded0
+
+        if any(Lp[d] - 2 * w < 2 * w for d in range(nd)):
+            # tiny shard (local < 2w): the narrow-dep region inputs below
+            # would reach into the FAR ghost margin (garbage inside the
+            # kept cone) — use the wide formulation: exchange fully, rim
+            # bands slice the written array
+            return _overlap_wide(padded, interior, bounds, w, ksteps)
+
+        # 2) per-face receive slabs — NOT written into one array: a rim
+        # kernel that slices the fully-written array depends on EVERY
+        # collective and can never enter a flight window (round-4 census:
+        # 1 kernel in flight of 7, 3 of 4 windows empty —
+        # topology_schedule_flagship_f16.json). Each region below is
+        # assembled from only the slabs its kept cells read, so a face
+        # band's only wire dependency is its OWN axis's ppermutes and the
+        # scheduler is free to run it inside other axes' windows.
+        recvs = halo_recvs(padded, axis_names, axis_sizes, bc_value,
+                           staged=staged, width=w, periodic=periodic)
+
+        def region_input(sigma):
+            """Region ``sigma`` in {-1,0,+1}^nd: cells within w of the
+            faces sigma marks. Input = pre-exchange data + ONLY those
+            faces' fresh ghost slabs, overwritten in increasing axis
+            order (same last-write-wins corner ownership as
+            apply_recvs)."""
+            src = []
+            for d, s in enumerate(sigma):
+                src.append(slice(w, Lp[d] - w) if s == 0
+                           else slice(0, 3 * w) if s < 0
+                           else slice(Lp[d] - 3 * w, Lp[d]))
+            I = padded[tuple(src)]
+            for d, s in enumerate(sigma):
+                if s == 0:
+                    continue
+                slab = recvs[d][0 if s < 0 else 1]
+                g_sl = []
+                for e, se in enumerate(sigma):
+                    if e == d:
+                        g_sl.append(slice(None))  # slab is w deep on d
+                    elif se == 0:
+                        g_sl.append(slice(w, Lp[e] - w))
+                    elif se < 0:
+                        g_sl.append(slice(0, 3 * w))
+                    else:
+                        g_sl.append(slice(Lp[e] - 3 * w, Lp[e]))
+                dst = [slice(None)] * nd
+                dst[d] = slice(0, w) if s < 0 else slice(2 * w, 3 * w)
+                I = I.at[tuple(dst)].set(slab[tuple(g_sl)])
+            return I
+
+        # output bases on the PRE-exchange array: every owned cell is
+        # overwritten below, and the ghost margins are garbage by contract
+        # (the next exchange rewrites every margin cell before any read)
+        out = padded
         # interior kept: owned cells at distance >= w (padded [2w, Lp-2w))
         out = _set(out, interior,
                    [slice(2 * w, Lp[d] - 2 * w) for d in range(nd)],
                    [slice(w, Lp[d] - 3 * w) for d in range(nd)])
-        # 3) rim bands
+        # 3) all 3^nd - 1 boundary regions: faces (one nonzero — depend on
+        # one axis's wire only), then edges/corners (tiny, multi-axis)
+        for sigma in itertools.product((-1, 0, 1), repeat=nd):
+            if not any(sigma):
+                continue
+            off = [0 if s < 0 else Lp[d] - 3 * w if s > 0 else w
+                   for d, s in enumerate(sigma)]
+            bnd = list(bounds)
+            for d in range(nd):
+                bnd[2 * d] = bnd[2 * d] - off[d]
+                bnd[2 * d + 1] = bnd[2 * d + 1] - off[d]
+            band = ftcs_multistep_bounded_pallas(
+                region_input(sigma), r, ksteps,
+                jnp.stack(bnd).astype(jnp.int32))
+            sl_keep, sl_dst = [], []
+            for d, s in enumerate(sigma):
+                if s == 0:  # clear of every face of this axis
+                    sl_keep.append(slice(w, Lp[d] - 3 * w))
+                    sl_dst.append(slice(2 * w, Lp[d] - 2 * w))
+                else:       # the w-deep owned rim of this face
+                    sl_keep.append(slice(w, 2 * w))
+                    sl_dst.append(slice(w, 2 * w) if s < 0
+                                  else slice(Lp[d] - 2 * w, Lp[d] - w))
+            out = _set(out, band, sl_dst, sl_keep)
+        return out
+
+    def _overlap_wide(padded, interior, bounds, w, ksteps):
+        """Round-4 overlap shape for tiny shards: full exchange, rim
+        bands slice the written array (every band waits on all wires —
+        immaterial at sizes where bands ARE most of the shard)."""
+        nd = padded.ndim
+        Lp = padded.shape
+
+        padded0 = exchange_fn(
+            padded, axis_names, axis_sizes, bc_value,
+            staged=staged, width=w, periodic=periodic,
+        )
+        out = padded0
+        out = _set(out, interior,
+                   [slice(2 * w, Lp[d] - 2 * w) for d in range(nd)],
+                   [slice(w, Lp[d] - 3 * w) for d in range(nd)])
         for d in range(nd):
             for lo in (True, False):
                 off = 0 if lo else Lp[d] - 3 * w
@@ -422,12 +528,40 @@ _SAFE_FUSE = 16
 _DEFAULT_BUDGET_S = "2400"
 
 
+@dataclasses.dataclass
+class GuardReport:
+    """Compile-guard telemetry, attached to ``SolveResult.guard`` whenever
+    the guard probed (VERDICT r4 #8: a timed-out probe's cost — and what
+    became of the abandoned compile — must be visible in the result a
+    bench row consumes, never silently folded away)."""
+    probed: bool = False
+    probe_mode: Optional[str] = None   # "subprocess" | "thread" |
+    #                                    "subprocess->thread" (child failed,
+    #                                    thread took over)
+    timed_out: bool = False
+    budget_s: float = 0.0
+    probe_s: float = 0.0               # wall cost, folded into compile_s
+    orphan: Optional[str] = None       # timeout only: "killed" (subprocess
+    #                                    probe — no compile outlives the
+    #                                    solve) | "left_running" (thread
+    #                                    probe — background compile persists
+    #                                    until it finishes or process exit)
+    deserialize_failed: bool = False   # child compiled in budget but the
+    #                                    executables didn't transfer; solve
+    #                                    proceeds un-degraded and recompiles
+    degraded: Optional[dict] = None    # cfg fields the fallback rewrote
+
+
 def _bounded_compile(fn, budget_s: float):
     """Run ``fn`` (an XLA/Mosaic compile) in a daemon thread with a wall
     budget. Returns (result, None) on success, (None, "timeout") when the
     budget expires — the thread is left running (a C++ compile cannot be
     interrupted from Python; it dies with the process or finishes into
-    the persistent compile cache). fn's exceptions propagate."""
+    the persistent compile cache). fn's exceptions propagate.
+
+    The THREAD probe is the fallback mode: the default subprocess probe
+    (``_subprocess_probe``) is killable, so a timed-out compile can't
+    squat a core under the fallback solve's bench row."""
     box: dict = {}
 
     def run():
@@ -464,15 +598,138 @@ def _compile_probe(cfg: HeatConfig, mesh, kf: int, remaining: int,
     # into every later compile (and race the main thread).
     if padded:
         _, advance, _ = make_padded_carry_machinery(cfg, mesh)
-        shape = tuple(cfg.n + 2 * kf * int(s) for s in mesh.devices.shape)
     else:
         advance = make_advance(cfg, mesh)
-        shape = cfg.shape
-    struct = jax.ShapeDtypeStruct(
-        shape, jnp_dtype(cfg.dtype),
-        sharding=NamedSharding(mesh, P(*mesh.axis_names)))
+    struct = _probe_state_struct(cfg, mesh, kf, padded)
     return {k: advance.lower(struct, k).compile()
             for k in chunk_sizes(cfg, remaining)}
+
+
+def _probe_state_struct(cfg: HeatConfig, mesh, kf: int, padded: bool):
+    """The sharded state ShapeDtypeStruct the probe compiles against —
+    ONE derivation shared by the compile and the subprocess probe's
+    validation execution (they must describe the same program input)."""
+    shape = (tuple(cfg.n + 2 * kf * int(s) for s in mesh.devices.shape)
+             if padded else cfg.shape)
+    return jax.ShapeDtypeStruct(
+        shape, jnp_dtype(cfg.dtype),
+        sharding=NamedSharding(mesh, P(*mesh.axis_names)))
+
+
+def _subprocess_probe(cfg: HeatConfig, mesh, kf: int, remaining: int,
+                      padded: bool, budget_s: float):
+    """Killable probe: run ``_compile_probe`` in a child process
+    (``guard_probe`` module — chipless topology AOT compile for TPU
+    parents, same-platform for CPU test parents) and ship the executables
+    back via ``jax.experimental.serialize_executable``. Returns
+    ``(pre, status)`` with status in {"ok", "timeout", "deserialize-failed",
+    "child-error: ...", "spawn-error: ..."}.
+
+    On timeout the whole child process GROUP is SIGKILLed — unlike the
+    thread probe, no abandoned Mosaic compile outlives the budget (the
+    orphan-capping contract, VERDICT r4 #8). The child inherits
+    ``JAX_COMPILATION_CACHE_DIR``, so a SUCCESSFUL child compile still
+    pays forward to reruns through the persistent cache."""
+    import json
+    import shutil
+    import tempfile
+
+    from .. import machine
+
+    # The child must compile the SAME program drive will run, so the
+    # parent RESOLVES every environment-dependent choice and pins it in
+    # the spec: the child is a forced-CPU process, where "auto" would
+    # silently resolve to the seconds-fast XLA kernel and the guard would
+    # bound the wrong program (the round-4 retracted-curve bug,
+    # benchmarks/compile_bisect.py's lk-pinning note).
+    kernel_ok = pallas_available((cfg.n,) * cfg.ndim, jnp_dtype(cfg.dtype))
+    use_pallas = cfg.local_kernel == "pallas" or (
+        cfg.local_kernel == "auto"
+        and jax.default_backend() == "tpu"
+        and kernel_ok)  # same resolution as make_local_multistep
+    tmpdir = tempfile.mkdtemp(prefix="heat_guard_probe_")
+    spec_path = os.path.join(tmpdir, "spec.json")
+    out_path = os.path.join(tmpdir, "pre.pkl")
+    spec = {"cfg": {**dataclasses.asdict(cfg),
+                    "local_kernel": "pallas" if use_pallas else "xla"},
+            "mesh_shape": list(mesh.devices.shape),
+            "axis_names": list(mesh.axis_names),
+            "kf": kf, "remaining": remaining, "padded": padded,
+            "platform": jax.default_backend(),
+            "chip": machine.current().name,
+            "out": out_path}
+    try:
+        with open(spec_path, "w") as f:
+            json.dump(spec, f)
+        return _run_probe_child(spec_path, out_path, mesh, cfg, kf, padded,
+                                budget_s)
+    finally:
+        # pre.pkl holds serialized flagship-scale executables (tens to
+        # hundreds of MB); a bench sweep must not fill /tmp with them
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _run_probe_child(spec_path: str, out_path: str, mesh, cfg, kf: int,
+                     padded: bool, budget_s: float):
+    import pickle
+    import signal
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "heat_tpu.backends.guard_probe",
+             spec_path],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+            start_new_session=True)  # own group: the kill reaps compiler
+        #                              helper processes too
+    except OSError as e:
+        return None, f"spawn-error: {e}"
+    try:
+        _, err_txt = proc.communicate(timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):  # already gone
+            proc.kill()
+        proc.wait()
+        return None, "timeout"
+    if proc.returncode != 0:
+        tail = (err_txt or "").strip().splitlines()[-3:]
+        return None, "child-error: " + " | ".join(tail)
+    try:
+        from jax.experimental import serialize_executable
+
+        with open(out_path, "rb") as f:
+            payloads = pickle.load(f)
+        devs = list(mesh.devices.flat)
+        pre = {k: serialize_executable.deserialize_and_load(
+                   ser, in_tree, out_tree, execution_devices=devs)
+               for k, (ser, in_tree, out_tree) in payloads.items()}
+        # Deserialization alone is NOT proof the executable runs — a
+        # cross-process AOT transfer can load cleanly and still fail at
+        # dispatch (observed on XLA:CPU: "Function ... not found").
+        # Validate with a real execution on a throwaway buffer so drive
+        # never discovers a broken executable mid-solve with the state
+        # donated into it. Single-process only: the advance is a
+        # COLLECTIVE program, and a validation exec entered only by the
+        # processes whose deserialize succeeded would hang the others
+        # (divergence-safety contract) — multi-host accepts the transfer
+        # structurally and lets drive's first chunk surface any fault.
+        if jax.process_count() == 1:
+            from ..runtime.timing import sync
+
+            struct = _probe_state_struct(cfg, mesh, kf, padded)
+            for fn in pre.values():
+                sync(fn(jnp.zeros(struct.shape, struct.dtype,
+                                  device=struct.sharding)))
+        return pre, "ok"
+    except Exception as e:  # noqa: BLE001 — the child PROVED the compile
+        # fits the budget; failing to transfer the executables must not
+        # degrade the solve, only cost a (bounded) recompile in drive
+        master_print(f"compile guard: probe executables did not transfer "
+                     f"({type(e).__name__}: {e}); drive will recompile")
+        return None, "deserialize-failed"
 
 
 def _agree_any_timeout(timed_out: bool) -> bool:
@@ -522,11 +779,22 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
     complete). Explicit --fuse-steps or --local-kernel pallas is honored
     unguarded — the user asked for that exact program.
 
-    Returns ``(cfg, precompiled, guard_s)``: on success ``precompiled``
+    Returns ``(cfg, precompiled, report)``: on success ``precompiled``
     carries the probe's executables for drive(precompiled=...), so the
-    guard costs zero extra compiles; ``guard_s`` is the probe's wall time
-    (drive folds it into the reported compile/total time — the guard must
-    not make minutes of compile invisible to timing consumers).
+    guard costs zero extra compiles; ``report`` is a :class:`GuardReport`
+    whose ``probe_s`` is the probe's wall time (drive folds it into the
+    reported compile/total time — the guard must not make minutes of
+    compile invisible to timing consumers) and whose ``orphan`` field
+    records what became of an abandoned compile.
+
+    Probe modes (``HEAT_GUARD_PROBE``): ``subprocess`` (default) runs the
+    probe in a killable child (``guard_probe`` module) — on timeout the
+    child's process group is SIGKILLed, so no orphan compile outlives the
+    solve; ``thread`` restores the round-4 in-thread probe (zero-copy
+    executable hand-off, but a timed-out compile keeps burning a core
+    until it finishes). A child that FAILS (not times out — e.g. another
+    process holds the libtpu lockfile) degrades to the thread probe with
+    the remaining budget.
 
     Divergence safety: every gate before the collective agreement derives
     from cfg/mesh/platform — identical across an SPMD job by contract.
@@ -544,29 +812,70 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
         # — that IS the "wait the compile out" remedy the fallback
         # warning advertises), shallow auto depth, or the XLA/f64 path
         # (seconds-fast compiles) already chosen
-        return cfg, None, 0.0
+        return cfg, None, GuardReport()
     try:
         budget = float(os.environ.get("HEAT_COMPILE_BUDGET_S",
                                       _DEFAULT_BUDGET_S))
     except ValueError:
         budget = float(_DEFAULT_BUDGET_S)
+    mode = os.environ.get("HEAT_GUARD_PROBE", "subprocess")
+    if mode != "thread":
+        mode = "subprocess"
+    rep = GuardReport(probe_mode=mode, budget_s=budget)
     pre, timed_out = None, False
     if budget > 0:  # budget<=0 disables the probe, NOT the agreement
-        try:
-            pre, err = _bounded_compile(
-                lambda: _compile_probe(cfg, mesh, kf, remaining, padded),
-                budget)
-            timed_out = err is not None
-        except Exception as e:  # noqa: BLE001 — a probe crash (e.g.
-            # RESOURCE_EXHAUSTED on the deep unroll) means the k* program
-            # is unusable here: fall back rather than let drive hit the
-            # same error, and NEVER skip the agreement below (peers would
-            # hang in the collective)
-            master_print(f"compile guard: probe failed ({type(e).__name__}: "
-                         f"{e}); treating as timeout")
-            pre, timed_out = None, True
-    if not _agree_any_timeout(timed_out):
-        return cfg, pre, time.perf_counter() - t0
+        rep.probed = True  # only now: a budget-0 run never probed, and
+        # its SolveResult must not carry a report claiming it did
+        from ..utils import ensure_cache_env
+
+        # flagship-scale compiles are exactly when the persistent cache
+        # pays: make sure probe children (and the abandoned-thread case)
+        # land their work where a rerun finds it
+        ensure_cache_env()
+        if mode == "subprocess":
+            pre, status = _subprocess_probe(cfg, mesh, kf, remaining,
+                                            padded, budget)
+            if status == "timeout":
+                timed_out, rep.orphan = True, "killed"
+            elif status == "deserialize-failed":
+                rep.deserialize_failed = True  # NOT a timeout: the child
+                # proved the program compiles in budget; solve proceeds
+                # un-degraded and pays one (bounded) recompile in drive
+            elif status != "ok":
+                # environmental child failure (libtpu lockfile held, spawn
+                # error...): degrade to the thread probe with what's left
+                # of the budget rather than inventing a verdict
+                master_print(f"compile guard: subprocess probe failed "
+                             f"({status}); retrying in-thread")
+                rep.probe_mode = "subprocess->thread"
+                budget_left = budget - (time.perf_counter() - t0)
+                if budget_left <= 0:
+                    timed_out, rep.orphan = True, None
+                else:
+                    mode = "thread"
+                    budget = budget_left
+        if mode == "thread" and not timed_out:
+            try:
+                pre, err = _bounded_compile(
+                    lambda: _compile_probe(cfg, mesh, kf, remaining, padded),
+                    budget)
+                if err is not None:
+                    timed_out, rep.orphan = True, "left_running"
+            except Exception as e:  # noqa: BLE001 — a probe crash (e.g.
+                # RESOURCE_EXHAUSTED on the deep unroll) means the k*
+                # program is unusable here: fall back rather than let
+                # drive hit the same error, and NEVER skip the agreement
+                # below (peers would hang in the collective)
+                master_print(f"compile guard: probe failed "
+                             f"({type(e).__name__}: {e}); treating as "
+                             f"timeout")
+                pre, timed_out = None, True
+    # rep.timed_out carries the AGREED verdict (the one that drives the
+    # degrade), which can differ from the local probe's outcome job-wide
+    rep.timed_out = _agree_any_timeout(timed_out)
+    if not rep.timed_out:
+        rep.probe_s = time.perf_counter() - t0
+        return cfg, pre, rep
     # Fallback must be a program whose compile is KNOWN fast. Shallower
     # Pallas depths are not that: at flagship scale even k=8 cold-compiles
     # in ~6-16 min (compile_bisect_topology.json), so a k=16 fallback
@@ -587,22 +896,38 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
         note = (" exchange='overlap' needs that kernel, so the exchange "
                 "falls back to 'indep' as well (owned values bit-identical; "
                 "only the latency-hiding split is lost).")
+    if rep.orphan == "killed":
+        orphan_note = ("The abandoned Mosaic compile was killed with the "
+                       "probe process.")
+    elif rep.orphan == "left_running":
+        orphan_note = (
+            "The abandoned Mosaic compile continues (and lands in the "
+            "compile cache when JAX_COMPILATION_CACHE_DIR is set) — a "
+            "rerun may pick the kernel up instantly.")
+    elif pre is not None or rep.deserialize_failed:
+        # a peer's timeout forced the job-wide fallback but THIS process's
+        # probe compile completed — the local cache is already warm
+        orphan_note = ("This process's own probe compile completed (a "
+                       "peer's timeout forced the job-wide fallback); the "
+                       "local compile cache is warm.")
+    else:  # probe crashed / failed before compiling anything: there is
+        # no background compile and no cache entry to wait for
+        orphan_note = "No probe compile was started."
     master_print(
         f"WARNING: auto fuse depth {kf} (Pallas kernel) did not compile "
-        f"within {budget:.0f}s (HEAT_COMPILE_BUDGET_S); falling back to "
-        f"local_kernel='xla' at the same fuse depth — compiles in seconds, "
-        f"~5x lower per-step throughput.{note} The abandoned Mosaic compile "
-        f"continues (and lands in the compile cache when "
-        f"JAX_COMPILATION_CACHE_DIR is set) — a rerun may pick the kernel "
-        f"up instantly. Pass --local-kernel pallas to wait the compile out.")
-    return (cfg.with_(**degrade), None,
-            time.perf_counter() - t0)
+        f"within {rep.budget_s:.0f}s (HEAT_COMPILE_BUDGET_S); falling back "
+        f"to local_kernel='xla' at the same fuse depth — compiles in "
+        f"seconds, ~5x lower per-step throughput.{note} {orphan_note} "
+        f"Pass --local-kernel pallas to wait the compile out.")
+    rep.degraded = degrade
+    rep.probe_s = time.perf_counter() - t0
+    return cfg.with_(**degrade), None, rep
 
 
 def _solve_padded_carry(cfg: HeatConfig, T0, mesh, fetch: bool,
                         warm_exec: bool, two_point_repeats: int = 0):
     """Default sharded solve: padded-carry state (make_padded_carry_machinery)."""
-    cfg, pre, guard_s = _guard_fuse_compile(cfg, mesh, cfg.ntime, padded=True)
+    cfg, pre, guard = _guard_fuse_compile(cfg, mesh, cfg.ntime, padded=True)
     sharding = NamedSharding(mesh, P(*mesh.axis_names))
     T_owned, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
     # The guard's probe ran BEFORE the field resolved, with
@@ -623,7 +948,9 @@ def _solve_padded_carry(cfg: HeatConfig, T0, mesh, fetch: bool,
     res = drive(cfg.with_(report_sum=False), Tp, advance,
                 start_step=start_step, fetch=False, warm_exec=warm_exec,
                 two_point_repeats=two_point_repeats, precompiled=pre,
-                precompile_s=guard_s)
+                precompile_s=guard.probe_s)
+    res.guard = (guard if guard.probed or guard.degraded else None)  # a
+    # peer-agreed degrade with a local budget of 0 still must be visible
     return _finalize_carried(cfg, res, crop, fetch)
 
 
@@ -768,12 +1095,14 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
         # remaining count that respects checkpoint resume)
         sharding = NamedSharding(mesh, P(*mesh.axis_names))
         T, start_step = resolve_initial_field(cfg, T0, sharding=sharding)
-        cfg, pre, guard_s = _guard_fuse_compile(
+        cfg, pre, guard = _guard_fuse_compile(
             cfg, mesh, cfg.ntime - start_step, padded=False)
         res = drive(cfg, T, make_advance(cfg, mesh), start_step=start_step,
                     fetch=fetch, warm_exec=warm_exec,
                     two_point_repeats=two_point_repeats, precompiled=pre,
-                    precompile_s=guard_s)
+                    precompile_s=guard.probe_s)
+        res.guard = (guard if guard.probed or guard.degraded else None)  # a
+    # peer-agreed degrade with a local budget of 0 still must be visible
     res.mesh_shape = tuple(mesh.devices.shape)
     res.mesh = mesh
     return res

@@ -1,0 +1,224 @@
+"""``heat-tpu calibrate`` — fit this chip's ChipModel from on-device sweeps.
+
+VERDICT r4 #6: the non-v5e rows in ``machine._CHIPS`` scale v5e's *fitted
+VPU* rates by public *peak MXU TFLOP* ratios — a crude proxy the file
+admits to. The day a v5p/v6e is attached, the planner runs on a guess.
+This command closes that gap: a ~minutes-long sweep measures
+
+- ``hbm_bytes_per_s``  — device STREAM (x + 1 over a large buffer: one
+  read + one write per element), overhead-cancelled by the two-point
+  protocol (``runtime/timing.py::two_point_rate``);
+- ``vpu_ops_per_s``    — the 2D thin-band stencil rate at the planner's
+  own geometry, inverted through ``_plan_2d``'s additive cost model;
+- ``ops_rate_3d``      — ditto through ``_plan_3d``'s model at 512^3,
+
+and emits a provenance-stamped JSON the machine table consumes directly
+(``HEAT_CHIP_CALIBRATION=<path>``), so a freshly attached chip goes from
+spec-proxy to fitted without editing code. VMEM ceilings are NOT fitted
+(they are compiler limits, validated separately by
+``benchmarks/topology_validate.py``'s AOT RESOURCE_EXHAUSTED checks) and
+are carried over from the table entry for the detected chip class.
+
+On a non-TPU platform the sweep still runs (tiny shapes, interpret-mode
+kernels) so the harness is testable anywhere, but the output is labeled
+``trustworthy: false`` and ``calibrated`` stays False — interpret-mode
+rates say nothing about any chip.
+
+Reference parity: the reference has no analog (constants live in its
+kernel launch configs, e.g. the fixed 16x16 blocks of
+fortran/cuda_kernel/heat.F90); this is the price of having a planner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Optional
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def measure_hbm(mib: int = 256, repeats: int = 3) -> dict:
+    """STREAM-style device bandwidth: jit(x + 1) moves itemsize bytes in
+    and out per element; the two-point protocol cancels dispatch/sync
+    overhead (decisive on the tunneled platform)."""
+    import jax
+
+    from .runtime.timing import two_point_rate
+
+    jnp = _jnp()
+    n = mib * (1 << 20) // 4
+    x = jnp.zeros((n,), jnp.float32)
+    f = jax.jit(lambda a: a + 1.0, donate_argnums=0)
+    bytes_per_call = 2.0 * n * 4
+    rate, raw = two_point_rate(lambda t: f(t), x, bytes_per_call,
+                               repeats=repeats)
+    return {"hbm_bytes_per_s": rate, "hbm_bytes_per_s_raw": raw,
+            "buffer_mib": mib}
+
+
+def _solve_rate(cfg, repeats: int = 2) -> float:
+    """points/s for ``cfg`` via the framework's own solve path, two-point
+    corrected (falls back to the raw rate below the protocol's noise
+    floor, which two_point_rate handles itself)."""
+    from .backends import solve
+
+    res = solve(cfg, fetch=False, warm_exec=True,
+                two_point_repeats=repeats)
+    return res.timing.points_per_s_two_point or res.timing.points_per_s
+
+
+def _invert_rate(cost_at_rate, t_pp: float,
+                 lo: float = 1e8, hi: float = 1e16) -> Optional[float]:
+    """Find the compute rate at which the (monotone-decreasing-in-rate)
+    cost model predicts the measured t_pp. Bisection against the
+    planner's OWN cost function — no formula copy to drift. None when no
+    rate in [lo, hi] explains the measurement (e.g. measured faster than
+    the model's bandwidth floor: the model is wrong there, don't fit)."""
+    if not (cost_at_rate(hi) < t_pp < cost_at_rate(lo)):
+        return None
+    for _ in range(200):
+        mid = (lo * hi) ** 0.5  # geometric: the range spans 8 decades
+        if cost_at_rate(mid) > t_pp:
+            lo = mid
+        else:
+            hi = mid
+    return (lo * hi) ** 0.5
+
+
+def fit_vpu_2d(t_pp: float, shape, dtype_str: str, ksteps: int,
+               chip_with_hbm) -> Optional[float]:
+    """Fit ``vpu_ops_per_s`` by inverting ``cost_thin_2d`` — the exact
+    function ``_plan_2d`` ranks with — at the chunk depth the planner
+    chose for this shape. None for a coltiled plan (not the calibration
+    target) or an uninvertible measurement."""
+    import dataclasses as dc
+
+    from .ops import pallas_stencil as ps
+
+    plan = ps._plan_2d(tuple(shape), dtype_str, ksteps)
+    if plan is None or plan[0] != "thin":
+        return None
+    kchunk = plan[1]
+    n_pad = ps._round_up(max(shape[1], 128), 128)
+    return _invert_rate(
+        lambda v: ps.cost_thin_2d(
+            n_pad, kchunk, dtype_str,
+            dc.replace(chip_with_hbm, vpu_ops_per_s=v)),
+        t_pp)
+
+
+def fit_ops_3d(t_pp: float, shape, dtype_str: str, ksteps: int,
+               chip_with_hbm) -> Optional[float]:
+    """Fit ``ops_rate_3d`` by inverting ``cost_3d`` (shared with
+    ``_plan_3d``) at its chosen (R, M, k), de-rated by the alignment-
+    padding waste factor exactly as the planner charges it."""
+    import dataclasses as dc
+
+    from .ops import pallas_stencil as ps
+
+    plan = ps._plan_3d(tuple(shape), dtype_str, ksteps)
+    if plan is None:
+        return None
+    (m_pad, mid_pad, _n_pad), R, M, k = plan
+    pad = m_pad * mid_pad / max(shape[0] * shape[1], 1)
+    return _invert_rate(
+        lambda v: ps.cost_3d(R, M, k, dtype_str,
+                             dc.replace(chip_with_hbm, ops_rate_3d=v)) * pad,
+        t_pp)
+
+
+def run(out_path: str, quick: bool = False) -> dict:
+    """The full calibration sweep. Writes ``out_path`` (JSON) and returns
+    the record."""
+    import jax
+
+    from . import machine
+    from .config import HeatConfig
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu"
+    kind = jax.devices()[0].device_kind
+    base = machine.classify(kind) if on_tpu else machine._DEFAULT
+
+    # shapes: flagship-representative on a real chip; tiny everywhere else
+    # (interpret-mode pallas at 4096^2 would take hours on a CPU)
+    n2d = 4096 if on_tpu and not quick else 256
+    n3d = 512 if on_tpu and not quick else 32
+    steps = 256 if on_tpu and not quick else 16
+    hbm_mib = 256 if on_tpu else 8
+
+    rec: dict = {"ts": time.time(), "platform": platform,
+                 "device_kind": kind, "chip_class": base.name,
+                 "trustworthy": bool(on_tpu),
+                 "params": {"n2d": n2d, "n3d": n3d, "steps": steps,
+                            "hbm_mib": hbm_mib}}
+
+    print(f"calibrate: platform={platform} device={kind!r} "
+          f"(chip class {base.label})")
+    stream = measure_hbm(mib=hbm_mib)
+    rec["stream"] = stream
+    hbm = stream["hbm_bytes_per_s"]
+    print(f"  HBM stream: {hbm / 1e9:.1f} GB/s")
+
+    chip_meas = dataclasses.replace(base, hbm_bytes_per_s=float(hbm))
+    k2 = 16
+    cfg2 = HeatConfig(n=n2d, ntime=steps, dtype="float32",
+                      backend="pallas", fuse_steps=k2)
+    rate2 = _solve_rate(cfg2)
+    t_pp2 = 1.0 / rate2
+    vpu = fit_vpu_2d(t_pp2, (n2d, n2d), "float32", k2, chip_meas)
+    rec["sweep_2d"] = {"n": n2d, "fuse": k2, "points_per_s": rate2,
+                       "vpu_ops_per_s_fit": vpu}
+    print(f"  2D {n2d}^2 fuse={k2}: {rate2:.3e} pts/s -> vpu "
+          f"{vpu / 1e12 if vpu else float('nan'):.2f} Tops/s")
+
+    k3 = 8
+    cfg3 = HeatConfig(n=n3d, ndim=3, ntime=steps, dtype="float32",
+                      backend="pallas", fuse_steps=k3)
+    rate3 = _solve_rate(cfg3)
+    ops3 = fit_ops_3d(1.0 / rate3, (n3d,) * 3, "float32", k3, chip_meas)
+    rec["sweep_3d"] = {"n": n3d, "fuse": k3, "points_per_s": rate3,
+                       "ops_rate_3d_fit": ops3}
+    print(f"  3D {n3d}^3 fuse={k3}: {rate3:.3e} pts/s -> ops3d "
+          f"{ops3 / 1e12 if ops3 else float('nan'):.2f} Tops/s")
+
+    fitted = dataclasses.asdict(dataclasses.replace(
+        base,
+        name=base.name if on_tpu else f"{base.name}-proxy",
+        hbm_bytes_per_s=float(hbm),
+        vpu_ops_per_s=float(vpu) if vpu else base.vpu_ops_per_s,
+        ops_rate_3d=float(ops3) if ops3 else base.ops_rate_3d,
+        calibrated=bool(on_tpu and vpu and ops3)))
+    rec["chip_model"] = fitted
+    rec["fit_complete"] = bool(vpu and ops3)
+    if on_tpu:
+        # reproduction check against the shipped table for a KNOWN chip:
+        # the acceptance bar is "reproduces the shipped constants within
+        # tolerance" (VERDICT r4 #6) — report the ratios so drift is a
+        # number, not a feeling
+        rec["vs_table"] = {
+            "hbm_ratio": hbm / base.hbm_bytes_per_s,
+            "vpu_ratio": (vpu / base.vpu_ops_per_s) if vpu else None,
+            "ops3d_ratio": (ops3 / base.ops_rate_3d) if ops3 else None,
+        }
+        print("  vs shipped table: " + ", ".join(
+            f"{k}={v:.2f}x" if v else f"{k}=n/a"
+            for k, v in rec["vs_table"].items()))
+    else:
+        print("  NOT TRUSTWORTHY: interpret-mode rates on a non-TPU "
+              "platform say nothing about any chip (harness check only)")
+
+    with open(str(out_path) + ".tmp", "w") as f:
+        json.dump(rec, f, indent=2, default=float)
+    import os
+
+    os.replace(str(out_path) + ".tmp", out_path)
+    print(f"wrote {out_path}")
+    print(f"use it: HEAT_CHIP_CALIBRATION={out_path} heat-tpu run ...")
+    return rec

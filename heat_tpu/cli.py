@@ -13,6 +13,7 @@ variant choice (which makefile target you compiled) becomes ``--backend`` /
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 from pathlib import Path
@@ -135,6 +136,18 @@ def build_parser() -> argparse.ArgumentParser:
                        help="timesteps per timed call (default 8192 TPU, "
                             "256 elsewhere)")
     bench.add_argument("--repeats", type=int, default=3)
+
+    cal = sub.add_parser(
+        "calibrate",
+        help="fit this chip's planner constants (HBM stream + 2D/3D "
+             "stencil sweeps, minutes on a real chip) and write a "
+             "ChipModel JSON consumable via HEAT_CHIP_CALIBRATION — "
+             "turns the spec-proxy tables for a newly attached chip "
+             "class into measured numbers")
+    cal.add_argument("--out", default="calibration.json")
+    cal.add_argument("--quick", action="store_true",
+                     help="tiny shapes (harness check; rates not "
+                          "representative even on a real chip)")
 
     launch = sub.add_parser(
         "launch",
@@ -264,7 +277,7 @@ def cmd_run(args) -> int:
             master_print(f"wrote {args.out}")
 
     if args.json:
-        master_print(json.dumps({
+        rec = {
             "n": cfg.n, "ndim": cfg.ndim, "ntime": cfg.ntime,
             "backend": cfg.backend, "dtype": cfg.dtype,
             "solve_s": res.timing.solve_s,
@@ -272,7 +285,12 @@ def cmd_run(args) -> int:
             "points_per_s": res.timing.points_per_s,
             "gsum": res.gsum,
             "gsum_dtype": res.gsum_dtype,
-        }))
+        }
+        if res.guard is not None:
+            # the row must say when it measured the DEGRADED program (and
+            # what the probe cost / what became of the orphan compile)
+            rec["guard"] = dataclasses.asdict(res.guard)
+        master_print(json.dumps(rec))
     return 0
 
 
@@ -552,11 +570,18 @@ def cmd_info(_args) -> int:
     return 0
 
 
+def cmd_calibrate(args) -> int:
+    from .calibrate import run as calibrate_run
+
+    rec = calibrate_run(args.out, quick=args.quick)
+    return 0 if rec.get("fit_complete") else 1
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     return {"run": cmd_run, "viz": cmd_viz, "info": cmd_info,
             "launch": cmd_launch, "plan": cmd_plan,
-            "bench": cmd_bench}[args.command](args)
+            "bench": cmd_bench, "calibrate": cmd_calibrate}[args.command](args)
 
 
 if __name__ == "__main__":

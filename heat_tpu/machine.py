@@ -124,13 +124,37 @@ def register_cache(clear: Callable[[], None]) -> None:
     _dependent_caches.append(clear)
 
 
+def from_calibration(path: str) -> ChipModel:
+    """Load a ``heat-tpu calibrate`` record as the chip model. Raises on a
+    malformed file (a typo'd HEAT_CHIP_CALIBRATION must fail loudly, not
+    silently plan on the wrong chip). An untrustworthy record (produced on
+    a non-TPU platform) is accepted but forced ``calibrated=False`` so
+    every consumer labels its numbers."""
+    import json
+
+    with open(path) as f:
+        rec = json.load(f)
+    cm = rec["chip_model"]
+    return ChipModel(**{**cm, "calibrated": bool(cm.get("calibrated")
+                                                 and rec.get("trustworthy"))})
+
+
 def current() -> ChipModel:
     """The chip model for this process's default device (cached: the
-    attached chip cannot change mid-process; ``override`` for tests)."""
+    attached chip cannot change mid-process; ``override`` for tests;
+    ``HEAT_CHIP_CALIBRATION=<json>`` substitutes a ``heat-tpu calibrate``
+    fit — the path from spec-proxy tables to fitted constants on a newly
+    attached chip class)."""
     global _cache
     if _override is not None:
         return classify(_override)
     if _cache is None:
+        import os
+
+        cal = os.environ.get("HEAT_CHIP_CALIBRATION")
+        if cal:
+            _cache = from_calibration(cal)
+            return _cache
         import jax
 
         try:

@@ -166,6 +166,35 @@ def _tile_2d(n_pad: int, kpad: int) -> int:
     return max(kpad, (tile // kpad) * kpad)
 
 
+def cost_thin_2d(n_pad: int, kchunk: int, dtype_str, chip) -> float:
+    """Modeled seconds per point-step for the thin-band kernel at chunk
+    depth ``kchunk`` — additive compute + bandwidth (measured: the two
+    don't overlap enough for max(); see the ops_rate_3d note). THE cost
+    model ``_plan_2d`` ranks with, exposed at module level so
+    ``heat_tpu.calibrate`` inverts the planner's actual model (not a
+    hand-copied formula that drifts)."""
+    item = jnp.dtype(dtype_str).itemsize
+    kpad = _halo_2d(kchunk, dtype_str)
+    tile = _tile_2d(n_pad, kpad)
+    compute = 11.0 * (tile + 2 * kpad) / tile / chip.vpu_ops_per_s
+    bw = (2.0 * tile + 2 * kpad) * item / (tile * kchunk) / chip.hbm_bytes_per_s
+    return compute + bw
+
+
+def cost_3d(R: int, M: int, k: int, dtype_str, chip) -> float:
+    """Modeled seconds per COMPUTED point-step for the (row, mid)-tiled 3D
+    kernel at geometry (R, M, k) — callers apply the alignment-padding
+    waste factor for logical points. Shared by ``_plan_3d`` and
+    ``heat_tpu.calibrate`` (same no-drift contract as cost_thin_2d)."""
+    item = jnp.dtype(dtype_str).itemsize
+    km = _round_up(k, _sublane(dtype_str))
+    band = (R + 2 * k) * (M + 2 * km)
+    tile = R * M
+    compute = 13.0 * band / tile / chip.ops_rate_3d
+    bw = (band + tile) * item / (tile * k) / chip.hbm_bytes_per_s
+    return compute + bw
+
+
 def _make_kernel_2d(r: float, tile: int, kpad: int, n_pad: int, ksteps: int):
     """Kernel body. ``bounds_ref`` is an SMEM (1,4) i32 array
     [row_lo, row_hi, col_lo, col_hi]: cells with index <= lo or >= hi on
@@ -362,16 +391,14 @@ def _plan_3d(shape, dtype_str, ksteps: int):
                 tile = R * M
                 if not _fits_vmem(band * n_pad, tile * n_pad, item):
                     continue
-                compute = 13.0 * band / tile / chip.ops_rate_3d
-                bw = (band + tile) * item / (tile * k) / chip.hbm_bytes_per_s
                 # cost per LOGICAL point: alignment padding is computed then
                 # discarded (R=70 on a 512-row grid pads 9% dead rows)
                 pad = (_round_up(max(m, R), R) * _round_up(max(mid, M), M)
                        / max(m * mid, 1))
-                # ADDITIVE cost (measured: compute and HBM streaming do not
-                # overlap enough for max() — see the ops_rate_3d note); ties
-                # break toward deeper fusion
-                key = ((compute + bw) * pad, band, -k)
+                # ADDITIVE cost (cost_3d; measured: compute and HBM
+                # streaming do not overlap enough for max() — see the
+                # ops_rate_3d note); ties break toward deeper fusion
+                key = (cost_3d(R, M, k, dtype_str, chip) * pad, band, -k)
                 if best is None or key < best[0]:
                     best = (key, R, M, k)
     if best is None:
@@ -490,14 +517,10 @@ def _plan_2d(shape, dtype_str, ksteps: int):
     chip = _chip()
 
     def cost_thin(k):
-        # additive compute+bandwidth, like the 3D model (ops_rate_3d
-        # note): measured thin 4096^2 f32 = 6.2e-12 s/pt-step; additive
-        # predicts 6.16e-12 where max() says 5.63e-12
-        kpad = _halo_2d(k, dtype_str)
-        tile = _tile_2d(n_pad, kpad)
-        compute = 11.0 * (tile + 2 * kpad) / tile / chip.vpu_ops_per_s
-        bw = (2.0 * tile + 2 * kpad) * item / (tile * k) / chip.hbm_bytes_per_s
-        return compute + bw
+        # additive model (cost_thin_2d): measured thin 4096^2 f32 =
+        # 6.2e-12 s/pt-step; additive predicts 6.16e-12 where max() says
+        # 5.63e-12
+        return cost_thin_2d(n_pad, k, dtype_str, chip)
 
     k_thin = min(max(ksteps, 1), _thin_chunk_cap(n_pad, dtype_str))
     best_col = None
@@ -628,19 +651,21 @@ def plan_summary(shape, dtype_str: str, ksteps: int) -> str:
             n_pad = _round_up(max(shape[1], 128), 128)
             tile = _tile_2d(n_pad, kpad)
             return (f"thin-band 2D (rows banded, full-width); tile {tile} "
-                    f"rows, halo {kpad}, fuse {k}, band "
+                    f"rows, halo {kpad}, per-pass chunk {k}, band "
                     f"{(tile + 2 * kpad) * n_pad * 4 / 2**20:.1f} MiB, "
                     f"halo-compute overhead {(tile + 2 * kpad) / tile:.2f}x")
         _, R, C, kr, kc, k = p
         band = (R + 2 * kr) * (C + 2 * kc)
         return (f"col-tiled 2D 3x3-halo; tile {R}x{C}, halo {kr}x{kc}, "
-                f"fuse {k}, band {band * 4 / 2**20:.1f} MiB, halo-compute "
+                f"per-pass chunk {k}, band {band * 4 / 2**20:.1f} MiB, "
+                f"halo-compute "
                 f"overhead {band / (R * C):.2f}x")
     (_, _, n_pad), R, M, k = _plan_3d(shape, dtype_str, min(ksteps, 8))
     km = _round_up(k, _sublane(dtype_str))
     band = (R + 2 * k) * (M + 2 * km)
-    return (f"(row,mid)-tiled 3D 3x3-halo; tile {R}x{M}x{n_pad}, fuse {k}, "
-            f"band {band * n_pad * 4 / 2**20:.1f} MiB, halo-compute "
+    return (f"(row,mid)-tiled 3D 3x3-halo; tile {R}x{M}x{n_pad}, per-pass "
+            f"chunk {k}, band {band * n_pad * 4 / 2**20:.1f} MiB, "
+            f"halo-compute "
             f"overhead {band / (R * M):.2f}x")
 
 

@@ -113,7 +113,7 @@ def halo_exchange(
     return out
 
 
-def halo_exchange_indep(
+def halo_recvs(
     padded: jax.Array,
     axis_names: Sequence[str],
     axis_sizes: Sequence[int],
@@ -121,22 +121,22 @@ def halo_exchange_indep(
     staged: bool = False,
     width: int = 1,
     periodic: bool = False,
-) -> jax.Array:
-    """``halo_exchange`` with all ghost writes made independent.
+) -> dict:
+    """The receive half of the indep exchange: ``{d: (from_prev,
+    from_next)}`` ghost slabs, each spanning the FULL padded extent of the
+    other axes with earlier-axis corner data stitched in.
 
-    The sequential formulation reads axis d's send slabs from the
-    already-ghost-updated array (that is how corner ghosts forward), so
-    each axis's update-slice depends on the previous axis's — XLA can be
-    forced to materialize the intermediate (the round-3 exchange lab
-    measured a full-padded-array copy per exchange in the compiled
-    advance). Here every send slab is built from the ORIGINAL padded
-    array, with earlier-axis corner data stitched in from those axes'
-    received slabs (slab-sized updates, not full-array); the final 2*nd
-    ghost writes then all read from ``padded`` only, so XLA is free to
-    apply them as one in-place pass. Owned values and ghost values are
-    bit-identical to ``halo_exchange`` — pinned by
-    tests/test_sharded.py::test_halo_exchange_indep_bitwise.
-    """
+    Exposed separately from the writes so the overlap exchange can hand
+    each rim kernel ONLY the slab it reads — a rim band that slices the
+    fully-written array depends on every collective and cannot enter any
+    flight window (the round-4 schedule census measured exactly that:
+    1 kernel in flight out of 7, benchmarks/topology_schedule_*.json).
+
+    Dependency chain to note: axis d's SEND slabs stitch axes e<d's fresh
+    ghosts into their margins (corner forwarding), so d's ppermutes start
+    only after e<d's land — the wire windows are sequential by axis; the
+    per-face consumers this function enables are what lets kernels fill
+    the later windows."""
     nd = padded.ndim
     w = width
     bc = jnp.asarray(bc_value, padded.dtype)
@@ -176,13 +176,54 @@ def halo_exchange_indep(
             from_prev = jnp.where(idx == 0, bc, from_prev)
             from_next = jnp.where(idx == size - 1, bc, from_next)
         recvs[d] = (from_prev, from_next)
+    return recvs
 
+
+def apply_recvs(padded: jax.Array, recvs: dict, width: int = 1) -> jax.Array:
+    """Write the received slabs into the ghost margins (the write half of
+    the indep exchange). Write order is increasing axis — later axes own
+    the corners — and every consumer assembling band inputs from ``recvs``
+    directly must reproduce that order (``_overlap_region_input``)."""
+    w = width
+    nd = padded.ndim
     out = padded
-    for d in range(len(axis_names)):
+    for d in sorted(recvs):
         from_prev, from_next = recvs[d]
-        out = out.at[slab(d, slice(0, w))].set(from_prev)
-        out = out.at[slab(d, slice(-w, None))].set(from_next)
+        sl = [slice(None)] * nd
+        sl[d] = slice(0, w)
+        out = out.at[tuple(sl)].set(from_prev)
+        sl[d] = slice(-w, None)
+        out = out.at[tuple(sl)].set(from_next)
     return out
+
+
+def halo_exchange_indep(
+    padded: jax.Array,
+    axis_names: Sequence[str],
+    axis_sizes: Sequence[int],
+    bc_value,
+    staged: bool = False,
+    width: int = 1,
+    periodic: bool = False,
+) -> jax.Array:
+    """``halo_exchange`` with all ghost writes made independent.
+
+    The sequential formulation reads axis d's send slabs from the
+    already-ghost-updated array (that is how corner ghosts forward), so
+    each axis's update-slice depends on the previous axis's — XLA can be
+    forced to materialize the intermediate (the round-3 exchange lab
+    measured a full-padded-array copy per exchange in the compiled
+    advance). Here every send slab is built from the ORIGINAL padded
+    array, with earlier-axis corner data stitched in from those axes'
+    received slabs (slab-sized updates, not full-array); the final 2*nd
+    ghost writes then all read from ``padded`` only, so XLA is free to
+    apply them as one in-place pass. Owned values and ghost values are
+    bit-identical to ``halo_exchange`` — pinned by
+    tests/test_sharded.py::test_halo_exchange_indep_bitwise.
+    """
+    recvs = halo_recvs(padded, axis_names, axis_sizes, bc_value,
+                       staged=staged, width=width, periodic=periodic)
+    return apply_recvs(padded, recvs, width=width)
 
 
 def halo_pad(local: jax.Array, bc_value, width: int = 1) -> jax.Array:

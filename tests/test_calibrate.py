@@ -1,0 +1,96 @@
+"""heat-tpu calibrate (VERDICT r4 #6): fit-the-planner's-own-model.
+
+The command's value rests on two contracts: (1) the fit inverts the SAME
+cost functions the planners rank with (cost_thin_2d / cost_3d are shared,
+so there is no formula copy to drift), and (2) a calibration record
+round-trips into machine.current() via HEAT_CHIP_CALIBRATION with the
+trustworthiness label enforced. Both are pinned here synthetically — no
+device measurement involved; the measurement path itself is exercised by
+the CLI smoke below and `heat-tpu calibrate --quick` in the bench sweep.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from heat_tpu import machine
+from heat_tpu.calibrate import fit_ops_3d, fit_vpu_2d
+from heat_tpu.ops import pallas_stencil as ps
+
+
+@pytest.fixture
+def chip():
+    return machine.V5E
+
+
+def test_fit_vpu_2d_recovers_synthetic_rate(chip):
+    """Generate t_pp FROM the planner's model at a known vpu rate; the
+    fit must recover that rate (to bisection tolerance). This closes the
+    loop measurement -> model -> constants: if someone edits
+    cost_thin_2d's formula, the fit stays consistent BY CONSTRUCTION."""
+    shape, k = (4096, 4096), 16
+    plan = ps._plan_2d(shape, "float32", k)
+    assert plan[0] == "thin"
+    n_pad = ps._round_up(max(shape[1], 128), 128)
+    true_vpu = 1.7e12
+    t_pp = ps.cost_thin_2d(n_pad, plan[1], "float32",
+                           dataclasses.replace(chip, vpu_ops_per_s=true_vpu))
+    got = fit_vpu_2d(t_pp, shape, "float32", k, chip)
+    assert got == pytest.approx(true_vpu, rel=1e-6)
+
+
+def test_fit_ops_3d_recovers_synthetic_rate(chip):
+    shape, k = (512, 512, 512), 8
+    plan = ps._plan_3d(shape, "float32", k)
+    assert plan is not None
+    (m_pad, mid_pad, _), R, M, kc = plan
+    pad = m_pad * mid_pad / (shape[0] * shape[1])
+    true_rate = 3.1e12
+    t_pp = ps.cost_3d(R, M, kc, "float32",
+                      dataclasses.replace(chip, ops_rate_3d=true_rate)) * pad
+    got = fit_ops_3d(t_pp, shape, "float32", k, chip)
+    assert got == pytest.approx(true_rate, rel=1e-6)
+
+
+def test_fit_refuses_impossible_measurement(chip):
+    """A measurement FASTER than the model's bandwidth floor means the
+    model is wrong at that geometry — the fit must refuse, not emit a
+    nonsense rate."""
+    assert fit_vpu_2d(1e-30, (4096, 4096), "float32", 16, chip) is None
+    assert fit_ops_3d(1e-30, (512, 512, 512), "float32", 8, chip) is None
+
+
+def test_calibration_record_round_trips_through_machine(tmp_path, chip):
+    rec = {"trustworthy": True, "platform": "tpu",
+           "chip_model": dataclasses.asdict(dataclasses.replace(
+               chip, vpu_ops_per_s=1.23e12, calibrated=True))}
+    p = tmp_path / "cal.json"
+    p.write_text(json.dumps(rec))
+    cm = machine.from_calibration(str(p))
+    assert cm.vpu_ops_per_s == 1.23e12 and cm.calibrated
+
+    # an untrustworthy record (CPU harness run) must come back labeled
+    rec["trustworthy"] = False
+    p.write_text(json.dumps(rec))
+    assert not machine.from_calibration(str(p)).calibrated
+
+
+def test_calibration_env_feeds_current(tmp_path, chip, monkeypatch):
+    rec = {"trustworthy": True, "platform": "tpu",
+           "chip_model": dataclasses.asdict(dataclasses.replace(
+               chip, hbm_bytes_per_s=9.9e11, calibrated=True))}
+    p = tmp_path / "cal.json"
+    p.write_text(json.dumps(rec))
+    monkeypatch.setenv("HEAT_CHIP_CALIBRATION", str(p))
+    machine._cache = None  # current() caches per process; reset for test
+    try:
+        assert machine.current().hbm_bytes_per_s == 9.9e11
+    finally:
+        machine._cache = None
+
+    # a typo'd path must fail LOUDLY, not plan on the wrong chip
+    monkeypatch.setenv("HEAT_CHIP_CALIBRATION", str(p) + ".nope")
+    with pytest.raises(OSError):
+        machine.current()
+    machine._cache = None

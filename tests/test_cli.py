@@ -107,7 +107,7 @@ def test_cli_plan_subcommand(tmp_cwd, capsys):
     (tmp_cwd / "input.dat").write_text("4096 0.25 0.05 2.0 100 0\n")
     assert main(["plan", "--backend", "pallas", "--dtype", "float32"]) == 0
     out = capsys.readouterr().out
-    assert "thin-band 2D" in out and "fuse 16" in out
+    assert "thin-band 2D" in out and "per-pass chunk 16" in out
 
     assert main(["plan", "--backend", "sharded", "--dtype", "float32",
                  "--mesh", "4x4"]) == 0

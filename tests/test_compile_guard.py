@@ -57,16 +57,20 @@ def test_agree_any_timeout_single_process_is_identity():
 
 
 def test_guard_falls_back_on_compile_timeout(mesh, monkeypatch, capsys):
+    # thread mode: monkeypatching _compile_probe only works in-process
+    monkeypatch.setenv("HEAT_GUARD_PROBE", "thread")
     monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0.05")
     monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
     monkeypatch.setattr(sharded, "_compile_probe",
                         lambda *a, **kw: time.sleep(30))
     cfg = _flagship_cfg()
     assert sharded.fuse_depth_sharded(cfg, (1, 1)) == 32  # the cliff depth
-    out, pre, guard_s = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
+    out, pre, rep = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
     assert out.local_kernel == "xla" and pre is None
     assert out.fuse_steps == 0  # depth untouched; the KERNEL falls back
-    assert guard_s > 0  # the probe's wall cost is reported, not hidden
+    assert rep.probe_s > 0  # the probe's wall cost is reported, not hidden
+    assert rep.timed_out and rep.orphan == "left_running"  # thread probe
+    assert rep.degraded == {"local_kernel": "xla"}
     msg = capsys.readouterr().out
     assert "WARNING" in msg and "local_kernel='xla'" in msg
 
@@ -75,16 +79,19 @@ def test_guard_falls_back_when_a_peer_timed_out(mesh, monkeypatch, capsys):
     """Job-wide agreement: even a LOCALLY successful probe must fall back
     if any peer's timed out — different fuse depths are different SPMD
     programs (mismatched collectives hang the job)."""
+    monkeypatch.setenv("HEAT_GUARD_PROBE", "thread")
     monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "5")
     monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
     monkeypatch.setattr(sharded, "_compile_probe",
                         lambda *a, **kw: {500: object()})
     monkeypatch.setattr(sharded, "_agree_any_timeout", lambda t: True)
-    out, pre, _ = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
+    out, pre, rep = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
     assert out.local_kernel == "xla" and pre is None
+    assert rep.timed_out  # the agreed verdict, not the local outcome
 
 
 def test_guard_hands_probe_executables_forward(mesh, monkeypatch):
+    monkeypatch.setenv("HEAT_GUARD_PROBE", "thread")
     monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "5")
     monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
     fake = {500: object()}
@@ -95,10 +102,11 @@ def test_guard_hands_probe_executables_forward(mesh, monkeypatch):
         return fake
 
     monkeypatch.setattr(sharded, "_compile_probe", probe)
-    out, pre, _ = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
+    out, pre, rep = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
     assert out.fuse_steps == 0      # auto depth survives
     assert pre is fake              # drive never recompiles the probe's work
     assert calls == [(32, 500, True)]
+    assert rep.probed and not rep.timed_out and rep.orphan is None
 
 
 def test_guard_timeout_on_overlap_degrades_exchange_too(mesh, monkeypatch,
@@ -107,14 +115,16 @@ def test_guard_timeout_on_overlap_degrades_exchange_too(mesh, monkeypatch,
     Pallas kernel, so a guard fallback to local_kernel='xla' that leaves
     exchange='overlap' set hands make_local_multistep a cfg it rejects.
     The fallback must degrade BOTH knobs — never raise."""
+    monkeypatch.setenv("HEAT_GUARD_PROBE", "thread")
     monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0.05")
     monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
     monkeypatch.setattr(sharded, "_compile_probe",
                         lambda *a, **kw: time.sleep(30))
     cfg = _flagship_cfg(exchange="overlap")
-    out, pre, guard_s = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
+    out, pre, rep = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
     assert out.local_kernel == "xla" and out.exchange == "indep"
-    assert pre is None and guard_s > 0
+    assert pre is None and rep.probe_s > 0
+    assert rep.degraded == {"local_kernel": "xla", "exchange": "indep"}
     msg = capsys.readouterr().out
     assert "overlap" in msg and "'indep'" in msg
     # the degraded cfg must be one make_local_multistep accepts (this is
@@ -126,6 +136,7 @@ def test_guard_probe_crash_on_overlap_degrades_exchange_too(
         mesh, monkeypatch):
     """Same cross-feature hole via the probe-crash branch (e.g.
     RESOURCE_EXHAUSTED on the deep unroll)."""
+    monkeypatch.setenv("HEAT_GUARD_PROBE", "thread")
     monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "5")
     monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
 
@@ -142,6 +153,7 @@ def test_guard_probe_crash_on_overlap_degrades_exchange_too(
 def test_guard_timeout_keeps_non_overlap_exchange(mesh, monkeypatch):
     """The degrade is surgical: seq/indep exchanges run fine on the XLA
     kernel and must survive the fallback untouched."""
+    monkeypatch.setenv("HEAT_GUARD_PROBE", "thread")
     monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0.05")
     monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
     monkeypatch.setattr(sharded, "_compile_probe",
@@ -163,6 +175,7 @@ def test_guarded_overlap_solve_end_to_end_on_timeout(mesh, monkeypatch):
                      exchange="overlap")
     ref = sharded.solve(cfg.with_(exchange="indep", local_kernel="xla"),
                         fetch=True)
+    monkeypatch.setenv("HEAT_GUARD_PROBE", "thread")
     monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0.05")
     monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
     monkeypatch.setattr(sharded, "_SAFE_FUSE", 1)  # open the depth gate
@@ -196,8 +209,8 @@ def test_guard_stays_out_of_the_way(mesh, monkeypatch, why, cfg_kw, env):
         sharded, "_compile_probe",
         lambda *a, **kw: pytest.fail(f"probe must not run: {why}"))
     cfg = _flagship_cfg(**cfg_kw)
-    assert sharded._guard_fuse_compile(cfg, mesh, cfg.ntime) == (cfg, None,
-                                                                 0.0)
+    out, pre, rep = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
+    assert (out, pre) == (cfg, None) and not rep.probed
 
 
 def test_guard_budget_zero_skips_probe_but_joins_agreement(mesh, monkeypatch):
@@ -226,6 +239,7 @@ def test_guard_probe_exception_falls_back_and_joins_agreement(
         mesh, monkeypatch, capsys):
     """A probe crash (e.g. RESOURCE_EXHAUSTED on the deep unroll) must
     fall back — and still reach the agreement collective."""
+    monkeypatch.setenv("HEAT_GUARD_PROBE", "thread")
     monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "5")
     monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
 
@@ -252,8 +266,8 @@ def test_guard_noop_on_cpu(mesh, monkeypatch):
         sharded, "_compile_probe",
         lambda *a, **kw: pytest.fail("probe must not run on cpu"))
     cfg = _flagship_cfg()
-    assert sharded._guard_fuse_compile(cfg, mesh, cfg.ntime) == (cfg, None,
-                                                                 0.0)
+    out, pre, rep = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
+    assert (out, pre) == (cfg, None) and not rep.probed
 
 
 def test_guard_noop_at_safe_depths(mesh, monkeypatch):
@@ -264,7 +278,8 @@ def test_guard_noop_at_safe_depths(mesh, monkeypatch):
     cfg = HeatConfig(n=512, ntime=100, dtype="float32", backend="sharded",
                      mesh_shape=(1, 1))  # auto k* = sqrt(512/2) = 16
     assert sharded.fuse_depth_sharded(cfg, (1, 1)) <= sharded._SAFE_FUSE
-    assert sharded._guard_fuse_compile(cfg, mesh, 100) == (cfg, None, 0.0)
+    out, pre, rep = sharded._guard_fuse_compile(cfg, mesh, 100)
+    assert (out, pre) == (cfg, None) and not rep.probed
 
 
 @pytest.mark.parametrize("padded", [True, False])
@@ -294,3 +309,84 @@ def test_guarded_solve_uses_probe_executables(mesh, monkeypatch):
     monkeypatch.setattr(sharded, "_SAFE_FUSE", 1)
     got = sharded.solve(cfg, fetch=True)
     np.testing.assert_array_equal(np.asarray(ref.T), np.asarray(got.T))
+
+
+def test_subprocess_probe_timeout_kills_child(mesh, monkeypatch, capsys):
+    """Default (subprocess) mode, real child, sub-second budget: the
+    guard must SIGKILL the probe's process group — no orphan Mosaic
+    compile outlives the solve (VERDICT r4 #8) — and record the kill."""
+    import subprocess
+
+    monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0.2")
+    monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
+    monkeypatch.setattr(sharded, "_SAFE_FUSE", 1)
+    cfg = HeatConfig(n=64, ntime=20, dtype="float32", backend="sharded",
+                     mesh_shape=(1, 1))
+    out, pre, rep = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
+    assert rep.probe_mode == "subprocess"
+    assert rep.timed_out and rep.orphan == "killed" and pre is None
+    assert out.local_kernel == "xla"
+    # no probe child survives the guard (brief retry: process-table
+    # reaping of the SIGKILLed group is asynchronous)
+    for _ in range(20):
+        left = subprocess.run(["pgrep", "-f", "heat_tpu.backends.guard_probe"],
+                              capture_output=True, text=True).stdout.strip()
+        if not left:
+            break
+        time.sleep(0.25)
+    assert left == "", f"orphan probe processes: {left}"
+
+
+def test_subprocess_child_error_degrades_to_thread(mesh, monkeypatch):
+    """An environmental child failure (e.g. libtpu lockfile held by a
+    concurrent lab) must NOT invent a timeout verdict: the guard retries
+    in-thread with the remaining budget."""
+    monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "30")
+    monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
+    monkeypatch.setattr(sharded, "_subprocess_probe",
+                        lambda *a, **kw: (None, "child-error: lockfile"))
+    fake = {500: object()}
+    monkeypatch.setattr(sharded, "_compile_probe", lambda *a, **kw: fake)
+    out, pre, rep = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
+    assert rep.probe_mode == "subprocess->thread"
+    assert pre is fake and not rep.timed_out
+    assert out.local_kernel == "auto"  # un-degraded
+
+
+def test_subprocess_deserialize_failure_keeps_pallas(mesh, monkeypatch):
+    """A child that compiled IN budget but whose executables didn't
+    transfer proves the program is fine: the solve proceeds un-degraded
+    (drive recompiles, bounded) and the report says why compile_s will
+    show a second compile."""
+    monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "30")
+    monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
+    monkeypatch.setattr(sharded, "_subprocess_probe",
+                        lambda *a, **kw: (None, "deserialize-failed"))
+    cfg = _flagship_cfg()
+    out, pre, rep = sharded._guard_fuse_compile(cfg, mesh, 500)
+    assert out is cfg and pre is None
+    assert rep.deserialize_failed and not rep.timed_out
+    assert rep.orphan is None and rep.degraded is None
+
+
+def test_solve_attaches_guard_report(mesh, monkeypatch):
+    """SolveResult.guard must carry the probe's cost and verdict — a
+    bench consumer has to be able to SEE that its row ran the degraded
+    program (VERDICT r4 #8)."""
+    monkeypatch.setenv("HEAT_GUARD_PROBE", "thread")
+    monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0.05")
+    monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
+    monkeypatch.setattr(sharded, "_SAFE_FUSE", 1)
+    monkeypatch.setattr(sharded, "_compile_probe",
+                        lambda *a, **kw: time.sleep(30))
+    cfg = HeatConfig(n=64, ntime=20, dtype="float32", backend="sharded",
+                     mesh_shape=(1, 1))
+    res = sharded.solve(cfg, fetch=False)
+    assert res.guard is not None and res.guard.timed_out
+    assert res.guard.orphan == "left_running"
+    assert res.guard.degraded == {"local_kernel": "xla"}
+    assert res.timing.compile_s >= res.guard.probe_s > 0  # cost visible
+
+    # ... and stays None when the guard never probed
+    res2 = sharded.solve(cfg.with_(local_kernel="xla"), fetch=False)
+    assert res2.guard is None
