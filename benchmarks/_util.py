@@ -58,3 +58,34 @@ def deep_fuse_proven(k: int = 32, budget_s: float = 1500) -> bool:
         except (OSError, json.JSONDecodeError, KeyError):
             continue
     return False
+
+
+def custom_call_census(txt: str, call_marker: str, target_re: str) -> dict:
+    """Census of custom calls in a compiler-IR text dump: total calls,
+    Mosaic (TPU) calls, and distinct payloads after SSA-id normalization.
+
+    ONE implementation for both the post-compile HLO census
+    (compile_bisect: ``call_marker="custom-call"``) and the lowering-IR
+    census (kernel_census: ``"stablehlo.custom_call"``) — the first cut
+    existed twice and one copy silently recorded zeros when the printer
+    syntax didn't match its regex (the round-5 k=8/16 bisect rows).
+    When the target regex matches nothing but call lines exist, falls
+    back to whole-line hashing and SAYS so (``census_method``) instead of
+    recording a confident zero."""
+    import hashlib
+    import re
+
+    lines = [ln for ln in txt.splitlines() if call_marker in ln]
+    mosaic, method = [], "target-match"
+    for ln in lines:
+        m = re.search(target_re, ln)
+        if m and "tpu" in m.group(1):
+            mosaic.append(m.group(0))
+    if not mosaic and lines:
+        mosaic, method = list(lines), "line-hash-fallback"
+    norm = [re.sub(r"%[\w#.\-]+", "%", c) for c in mosaic]
+    return {"custom_calls": len(lines),
+            "mosaic_calls": len(mosaic),
+            "distinct_kernel_bodies": len(
+                {hashlib.sha1(c.encode()).hexdigest() for c in norm}),
+            "census_method": method}

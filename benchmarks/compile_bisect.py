@@ -124,32 +124,16 @@ def child(k: int, n: int, steps: int, smoke: bool,
     # k=16's single pass.
     census = {}
     try:
-        import hashlib
-        import re
+        from _util import custom_call_census
 
-        txt = compiled.as_text()
-        # A Mosaic kernel call line carries custom_call_target="tpu_custom
-        # _call" plus its payload (backend_config — BRACE syntax in this
-        # XLA, not the quoted form a first cut assumed, which recorded
-        # mosaic_calls=0 against visibly custom-call-bearing programs).
-        # Distinctness = hash of the line from custom_call_target onward
-        # with SSA ids normalized — best-effort but syntax-insensitive.
-        lines = [ln for ln in txt.splitlines() if "custom-call" in ln]
-        mosaic, method = [], "target-match"
-        for ln in lines:
-            m = re.search(r'custom_call_target="([^"]*)".*', ln)
-            if m and "tpu" in m.group(1):
-                mosaic.append(m.group(0))
-        if not mosaic and lines:  # unexpected printer syntax: fall back
-            # to whole-line hashing and SAY so, rather than recording a
-            # confident-looking zero
-            mosaic, method = list(lines), "line-hash-fallback"
-        norm = [re.sub(r"%[\w.\-]+", "%", c) for c in mosaic]
-        census = {"custom_calls": len(lines),
-                  "mosaic_calls": len(mosaic),
-                  "distinct_kernel_bodies": len(
-                      {hashlib.sha1(c.encode()).hexdigest() for c in norm}),
-                  "census_method": method}
+        # Mosaic call lines carry custom_call_target="tpu_custom_call"
+        # (backend_config uses BRACE syntax in this XLA — a first cut
+        # assumed the quoted form and recorded mosaic_calls=0 against
+        # visibly custom-call-bearing programs). Shared helper with the
+        # labeled line-hash fallback so a printer-syntax change can never
+        # regress to confident zeros again.
+        census = custom_call_census(compiled.as_text(), "custom-call",
+                                    r'custom_call_target="([^"]*)".*')
     except Exception as e:  # census is best-effort; the timing is the row
         census = {"census_error": f"{type(e).__name__}: {e}"}
     print(json.dumps({"k": k, "n_local": n, "lower_s": t_lower,

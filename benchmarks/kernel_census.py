@@ -1,0 +1,90 @@
+"""Program-structure census for the compile-cost curve — lowering level.
+
+VERDICT r4 weak #3: the 16384-local bisect curve is non-monotone
+(k=8/16/32 cold-compile 393/980/665 s round 4; 780/2038/1133 s in the
+round-5 re-measure — uniformly inflated by host contention, same shape)
+and a curve used to justify ``_SAFE_FUSE`` needs a cause.
+
+This lab characterizes the PRE-BACKEND structure: ``advance.lower(...)``
+emits the StableHLO module in seconds, Mosaic custom calls included.
+Measured round 5: **every k in {8,16,32} lowers to the same structure —
+2 Mosaic calls, 2 distinct payloads** (the fused steady body + the
+500-step remainder body; ``_thin_chunk_cap`` chunking reuses one body
+per pass at this level). The post-compile census of the same k=32
+program records 4 calls over 3 distinct bodies
+(``compile_bisect_topology.json``), so the backend DUPLICATES AND
+SPECIALIZES bodies after lowering — the two censuses are complementary
+views, and only the post-compile one says what Mosaic actually built.
+Consequence for the inversion: pass count cannot explain k=16 costing
+2.6x k=8 (identical lowered structure); the cost difference lives in
+per-body geometry (wpad changes n_pad/tile) and backend specialization.
+
+Run (chipless, seconds per k): ``python benchmarks/kernel_census.py``
+Writes benchmarks/kernel_census.json.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from _util import custom_call_census, write_atomic  # noqa: E402
+
+N_LOCAL = 16384
+KS = (8, 16, 32)
+
+
+def lowered_census(txt: str) -> dict:
+    """Census of the LOWERED (StableHLO) module — pre-backend structure
+    only; see the module docstring for why this differs from (and does
+    not replace) the post-compile census."""
+    return custom_call_census(txt, "stablehlo.custom_call",
+                              r"@([\w.]+).*")
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp  # noqa: F401
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from heat_tpu.backends.sharded import make_padded_carry_machinery
+    from heat_tpu.config import HeatConfig
+    from heat_tpu.ops.pallas_stencil import force_compiled_kernels
+
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    mesh = topologies.make_mesh(topo, (2, 2), ("x", "y"))
+    n_glob = N_LOCAL * 2
+
+    out = Path(__file__).parent / "kernel_census.json"
+    rec = {"ts": time.time(), "n_local": N_LOCAL, "topology": "v5e:2x2",
+           "local_kernel": "pallas", "steps": 500, "rows": {}}
+
+    with force_compiled_kernels():
+        for k in KS:
+            cfg = HeatConfig(n=n_glob, ntime=500, dtype="float32",
+                             backend="sharded", mesh_shape=(2, 2),
+                             fuse_steps=k, local_kernel="pallas")
+            _, advance, _ = make_padded_carry_machinery(cfg, mesh)
+            struct = jax.ShapeDtypeStruct(
+                tuple(n_glob + 2 * k * s for s in (2, 2)), "float32",
+                sharding=NamedSharding(mesh, P("x", "y")))
+            t0 = time.perf_counter()
+            txt = advance.lower(struct, 500).as_text()
+            row = lowered_census(txt)
+            row["lower_s"] = time.perf_counter() - t0
+            rec["rows"][str(k)] = row
+            print(f"k={k}: {row}", flush=True)
+            write_atomic(out, rec)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,9 @@ phase() {  # phase <name> <timeout_s> <cmd...>
 # topology curve already answered the cliff question.
 phase bench                 700 python bench.py
 phase run_all             14000 python benchmarks/run_all.py --row-timeout 2500
+# VERDICT r4 #6 acceptance: on-chip calibrate must reproduce the shipped
+# v5e table within tolerance (the vs_table ratios in the artifact)
+phase calibrate            2400 python -m heat_tpu.cli calibrate --out benchmarks/calibration_v5e.json
 phase fma_ab               2400 python benchmarks/kernel_lab.py bench2d_rolled_var fma 256,4096,16,128
 phase bf16native_ab        2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16native 256,4096,16,128
 phase bf16fma_ab           2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16fma 256,4096,16,128

@@ -627,9 +627,12 @@ def _subprocess_probe(cfg: HeatConfig, mesh, kf: int, remaining: int,
 
     On timeout the whole child process GROUP is SIGKILLed — unlike the
     thread probe, no abandoned Mosaic compile outlives the budget (the
-    orphan-capping contract, VERDICT r4 #8). The child inherits
-    ``JAX_COMPILATION_CACHE_DIR``, so a SUCCESSFUL child compile still
-    pays forward to reruns through the persistent cache."""
+    orphan-capping contract, VERDICT r4 #8). The serialized executables
+    are the ONLY hand-forward mechanism here: topology AOT compiles do
+    not populate the persistent compile cache (observed round 5 — the
+    bisect children's per-k cache dirs come back empty), so a successful
+    child that fails to transfer costs one bounded recompile in drive,
+    and a killed child leaves nothing behind."""
     import json
     import shutil
     import tempfile
@@ -829,8 +832,11 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
         from ..utils import ensure_cache_env
 
         # flagship-scale compiles are exactly when the persistent cache
-        # pays: make sure probe children (and the abandoned-thread case)
-        # land their work where a rerun finds it
+        # pays: the thread probe's (device-target) compiles and drive's
+        # own land where a rerun finds them. NOT the subprocess child's —
+        # topology AOT compiles bypass the persistent cache (see
+        # _subprocess_probe); there the serialized executables carry the
+        # work instead.
         ensure_cache_env()
         if mode == "subprocess":
             pre, status = _subprocess_probe(cfg, mesh, kf, remaining,
