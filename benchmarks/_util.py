@@ -76,12 +76,18 @@ def custom_call_census(txt: str, call_marker: str, target_re: str) -> dict:
     import re
 
     lines = [ln for ln in txt.splitlines() if call_marker in ln]
-    mosaic, method = [], "target-match"
+    mosaic, method, matched_any = [], "target-match", False
     for ln in lines:
         m = re.search(target_re, ln)
-        if m and "tpu" in m.group(1):
-            mosaic.append(m.group(0))
-    if not mosaic and lines:
+        if m:
+            matched_any = True
+            if "tpu" in m.group(1):
+                mosaic.append(m.group(0))
+    if lines and not matched_any:
+        # printer-syntax mismatch (NO line parsed): count via line
+        # hashing and say so. A parse that succeeds but finds zero TPU
+        # targets is a real mosaic_calls=0 (e.g. an xla-local-kernel
+        # program with only host/sharding custom calls) — not a fallback.
         mosaic, method = list(lines), "line-hash-fallback"
     norm = [re.sub(r"%[\w#.\-]+", "%", c) for c in mosaic]
     return {"custom_calls": len(lines),

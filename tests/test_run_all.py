@@ -96,3 +96,49 @@ def test_cache_env_util_matches_package(monkeypatch):
     # a user-set value is always honored, never overridden
     monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/custom/cache")
     assert _util.ensure_cache_env() == "/custom/cache"
+
+
+def test_custom_call_census_fallback_is_labeled():
+    """IR-census regexes silently recorded zeros once (round-5 bisect
+    rows) — the shared helper must flag a printer-syntax mismatch via
+    census_method instead of reporting confident zeros."""
+    bench_dir = str(Path(__file__).resolve().parent.parent / "benchmarks")
+    if bench_dir not in sys.path:
+        sys.path.insert(0, bench_dir)
+    from _util import custom_call_census
+
+    hlo = ('%x = f32[8,8] custom-call(%y), '
+           'custom_call_target="tpu_custom_call", backend_config={p1}\n'
+           '%z = f32[8,8] custom-call(%x), '
+           'custom_call_target="tpu_custom_call", backend_config={p2}\n'
+           '%h = f32[8,8] custom-call(%z), '
+           'custom_call_target="host_thing"\n')
+    r = custom_call_census(hlo, "custom-call",
+                           r'custom_call_target="([^"]*)".*')
+    assert r == {"custom_calls": 3, "mosaic_calls": 2,
+                 "distinct_kernel_bodies": 2,
+                 "census_method": "target-match"}
+
+    # same body called twice -> one distinct body after SSA normalization
+    hlo2 = hlo.replace("{p2}", "{p1}")
+    r2 = custom_call_census(hlo2, "custom-call",
+                            r'custom_call_target="([^"]*)".*')
+    assert r2["distinct_kernel_bodies"] == 1
+
+    # unknown printer syntax (NO line parses): counts via line hashing,
+    # SAYS so
+    weird = "%x = custom-call(%y), tpu_thing_new_syntax\n"
+    r3 = custom_call_census(weird, "custom-call",
+                            r'custom_call_target="([^"]*)".*')
+    assert r3["mosaic_calls"] == 1
+    assert r3["census_method"] == "line-hash-fallback"
+
+    # parses fine but genuinely Mosaic-free (xla-local-kernel program
+    # with only host custom calls): a REAL zero, not a fallback
+    hostonly = ('%x = custom-call(%y), '
+                'custom_call_target="SPMDSharding"\n')
+    r4 = custom_call_census(hostonly, "custom-call",
+                            r'custom_call_target="([^"]*)".*')
+    assert r4 == {"custom_calls": 1, "mosaic_calls": 0,
+                  "distinct_kernel_bodies": 0,
+                  "census_method": "target-match"}
