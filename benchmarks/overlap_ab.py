@@ -47,6 +47,18 @@ def _ks() -> tuple[int, ...]:
     return (16,)
 
 
+# Round-5 note: exchange="overlap" is now the NARROW-DEPENDENCY form
+# (backends/sharded.py::padded_multi_overlap): 3^nd-1 rim regions (9
+# kernel calls in 2D vs the round-4 wide form's 5), each face band
+# depending only on its own axis's ppermutes. Chipless flagship census:
+# every collective flight window now holds 2-4 kernels
+# (topology_schedule_flagship_f32.json, per-window [2,2,4,2], compile
+# 1753 s at 8192-local 2x2 — inside the 2400 s guard budget). On the 1x1
+# mesh HERE the extra region launches make the single-chip bar slightly
+# harder; the ship rule stands: default flips only if overlap >= indep
+# on this measurement.
+
+
 def main():
     smoke = "--smoke" in sys.argv
     if smoke:
