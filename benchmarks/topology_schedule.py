@@ -111,6 +111,13 @@ def main():
     from heat_tpu.parallel.mesh import build_mesh  # noqa: F401 (parity cite)
 
     mesh_shape = tuple(int(v) for v in args.mesh.split("x"))
+    if len(mesh_shape) not in (2, 3):
+        # ndim follows the mesh rank below; a 1-axis mesh would need a
+        # separate field-rank flag this census has never exercised (the
+        # old code also built a rank-1 padded struct for it and crashed
+        # later) — fail clearly at the argument instead
+        ap.error(f"--mesh must be 2-D or 3-D (AxB or AxBxC), got "
+                 f"{args.mesh!r}")
     topo = topologies.get_topology_desc(args.topology, "tpu")
     mesh = topologies.make_mesh(topo, mesh_shape,
                                 tuple("xyz"[: len(mesh_shape)]))
@@ -122,7 +129,10 @@ def main():
 
     with force_compiled_kernels():
         for ex in args.exchanges.split(","):
-            cfg = HeatConfig(n=args.n, ntime=args.steps, dtype="float32",
+            # ndim follows the mesh rank (a 2x2x2 --mesh censuses the 3D
+            # 26-region narrow overlap, 6 flight windows)
+            cfg = HeatConfig(n=args.n, ndim=len(mesh_shape),
+                             ntime=args.steps, dtype="float32",
                              backend="sharded", mesh_shape=mesh_shape,
                              fuse_steps=args.fuse, exchange=ex,
                              local_kernel="pallas")
