@@ -35,15 +35,48 @@ import pickle
 import sys
 
 
-# device counts the probe knows how to spell as a physical topology; the
+# Device counts the probe knows how to spell as a physical topology; the
 # serialized executable's device assignment must match the parent's
-# device count, not its logical mesh shape
-_TOPO_BY_NDEV = {1: "1x1", 2: "1x2", 4: "2x2", 8: "2x4", 16: "4x4"}
+# device count, not its logical mesh shape. The map is PER CHIP, not a
+# flat ndev->layout table, because (validated against the attached
+# libtpu, first on-chip window of round 5):
+#   * v5e/v6e topologies are 2-D, v4/v5p are 3-D — "v5p:2x4" is an
+#     invalid spelling, the old flat table's entry never worked;
+#   * the default chips_per_host_bounds is 2x2x1, so any sub-host
+#     layout ("v5e:1x1" — the single-chip BENCH path) is rejected as
+#     "not divisible" unless the bounds are overridden. The override
+#     must be a plain int list: the PJRT option is typed, and a string
+#     form fails with INVALID_ARGUMENT (observed in sweep_r5.log);
+#   * v4 exposes TWO TensorCore devices per chip here (1x1x1 -> 2
+#     devices), so its entries are keyed by even device counts only.
+def _subhost(*bounds: int) -> dict:
+    return {"chips_per_host_bounds": list(bounds)}
 
 
-def topology_name(chip: str, ndev: int) -> str | None:
-    dims = _TOPO_BY_NDEV.get(ndev)
-    return f"{chip}:{dims}" if dims else None
+# v6e aliases the v5e table (same 2-D spellings and host bounds) so a
+# future spelling fix cannot drift between them
+_V5E_LIKE = {1: ("1x1", _subhost(1, 1, 1)), 2: ("1x2", _subhost(1, 2, 1)),
+             4: ("2x2", {}), 8: ("2x4", {}), 16: ("4x4", {})}
+
+_TOPO_BY_CHIP: dict[str, dict[int, tuple[str, dict]]] = {
+    "v5e": _V5E_LIKE,
+    "v6e": _V5E_LIKE,
+    "v5p": {1: ("1x1x1", _subhost(1, 1, 1)), 2: ("1x2x1", _subhost(1, 2, 1)),
+            4: ("2x2x1", {}), 8: ("2x2x2", {}), 16: ("2x2x4", {})},
+    "v4":  {2: ("1x1x1", _subhost(1, 1, 1)), 4: ("1x2x1", _subhost(1, 2, 1)),
+            8: ("2x2x1", {}), 16: ("2x2x2", {})},
+}
+
+
+def topology_spec(chip: str, ndev: int) -> tuple[str, dict] | None:
+    """(topology_name, get_topology_desc kwargs) for ``ndev`` parent
+    devices on ``chip``, or None when there is no spelling (the child
+    exits 3 and the parent falls back to the in-thread probe)."""
+    entry = _TOPO_BY_CHIP.get(chip, {}).get(ndev)
+    if entry is None:
+        return None
+    name, kwargs = entry
+    return f"{chip}:{name}", kwargs
 
 
 def main() -> int:
@@ -78,11 +111,19 @@ def main() -> int:
             # would never pick. A calibration env (inherited) wins, as it
             # does in the parent.
             machine.override(spec["chip"])
-        name = topology_name(spec["chip"], ndev)
-        if name is None:
-            print(f"no topology spelling for {ndev} devices", file=sys.stderr)
+        topo_spec = topology_spec(spec["chip"], ndev)
+        if topo_spec is None:
+            print(f"no topology spelling for {ndev} {spec['chip']} devices",
+                  file=sys.stderr)
             return 3
-        topo = topologies.get_topology_desc(name, "tpu")
+        name, topo_kwargs = topo_spec
+        topo = topologies.get_topology_desc(name, "tpu", **topo_kwargs)
+        if len(topo.devices) != ndev:
+            # devices-per-chip drifted (libtpu version / chip config) —
+            # an executable built here could not load in the parent
+            print(f"topology {name} has {len(topo.devices)} devices, "
+                  f"parent has {ndev}", file=sys.stderr)
+            return 3
         mesh = topologies.make_mesh(topo, mesh_shape, axis_names)
         ctx = force_compiled_kernels()
     else:  # cpu parent (tests): same-platform compile, no topology needed

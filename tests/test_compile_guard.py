@@ -430,10 +430,48 @@ def test_guard_probe_child_protocol(tmp_path):
     assert isinstance(ser, bytes) and len(ser) > 0
 
 
-def test_guard_probe_topology_name_mapping():
-    from heat_tpu.backends.guard_probe import topology_name
+def test_guard_probe_topology_spec_mapping():
+    from heat_tpu.backends.guard_probe import topology_spec
 
-    assert topology_name("v5e", 1) == "v5e:1x1"
-    assert topology_name("v5e", 4) == "v5e:2x2"
-    assert topology_name("v5p", 8) == "v5p:2x4"
-    assert topology_name("v5e", 3) is None  # no spelling -> child exits 3
+    # single-chip (the BENCH path) needs the sub-host bounds override:
+    # the default chips_per_host_bounds 2x2x1 rejects "v5e:1x1" as not
+    # divisible (observed on the attached libtpu, sweep_r5.log r5)
+    assert topology_spec("v5e", 1) == (
+        "v5e:1x1", {"chips_per_host_bounds": [1, 1, 1]})
+    # full-host layouts use the default bounds
+    assert topology_spec("v5e", 4) == ("v5e:2x2", {})
+    assert topology_spec("v6e", 16) == ("v6e:4x4", {})
+    # v5p/v4 are 3-D spellings ("v5p:2x4" was never valid)
+    assert topology_spec("v5p", 8) == ("v5p:2x2x2", {})
+    assert topology_spec("v5p", 1) == (
+        "v5p:1x1x1", {"chips_per_host_bounds": [1, 1, 1]})
+    # v4 exposes two devices per chip -> odd counts unspellable
+    assert topology_spec("v4", 2) == (
+        "v4:1x1x1", {"chips_per_host_bounds": [1, 1, 1]})
+    assert topology_spec("v4", 1) is None
+    assert topology_spec("v5e", 3) is None  # no spelling -> child exits 3
+    assert topology_spec("unknown-chip", 4) is None
+
+
+def test_guard_probe_topology_specs_construct():
+    """Every spelled topology must actually CONSTRUCT against libtpu —
+    the flat-table bug shipped precisely because the spellings were
+    never validated (the old test pinned two invalid ones). Chipless:
+    get_topology_desc needs only the libtpu compiler, no device."""
+    pytest.importorskip("jax.experimental.topologies")
+    from jax.experimental import topologies
+
+    from heat_tpu.backends.guard_probe import _TOPO_BY_CHIP, topology_spec
+
+    try:
+        topologies.get_topology_desc("v5e:2x2", "tpu")
+    except Exception:
+        pytest.skip("no TPU-capable libtpu on this host")
+
+    for chip, table in _TOPO_BY_CHIP.items():
+        for ndev in table:
+            name, kwargs = topology_spec(chip, ndev)
+            topo = topologies.get_topology_desc(name, "tpu", **kwargs)
+            assert len(topo.devices) == ndev, (
+                f"{name} {kwargs}: {len(topo.devices)} devices, "
+                f"expected {ndev}")
