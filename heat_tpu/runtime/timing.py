@@ -41,6 +41,27 @@ def sync(x: Any) -> Any:
     return x
 
 
+class TwoPointResult(tuple):
+    """(rate_corrected, rate_raw) that also carries ``fell_back`` — True
+    when the noise-floor fallback fired and corrected IS the raw rate.
+    A plain attribute (not a third element) so every existing
+    ``rate, raw = two_point_rate(...)`` unpack keeps working; consumers
+    that must NOT trust an overhead-dominated number (calibrate's HBM
+    probe) read the flag instead of re-deriving it by float equality."""
+
+    fell_back: bool
+
+    def __new__(cls, rate: float, raw: float, fell_back: bool):
+        self = super().__new__(cls, (rate, raw))
+        self.fell_back = fell_back
+        return self
+
+    def __getnewargs__(self):
+        # tuple's default supplies ONE arg (the content tuple) to the
+        # 3-arg __new__ above, breaking pickle/copy (review r5)
+        return (self[0], self[1], self.fell_back)
+
+
 def two_point_rate(call, x, work, repeats: int = 2):
     """(rate_corrected, rate_raw) for ``call`` doing ``work`` units/call.
 
@@ -55,6 +76,7 @@ def two_point_rate(call, x, work, repeats: int = 2):
     Noise floor: when T2-T1 < 20% of T1 the measurement is
     overhead-dominated and per-rep jitter can inflate the corrected rate
     unboundedly — fall back to the raw single-call rate (conservative).
+    The fallback is flagged on the returned ``TwoPointResult.fell_back``.
     """
     x = call(x)  # warm; consumes x when the executable donates its input
     sync(x)
@@ -71,8 +93,8 @@ def two_point_rate(call, x, work, repeats: int = 2):
     raw = work / best1
     diff = best2 - best1
     if diff <= 0.2 * best1:
-        return raw, raw
-    return work / diff, raw
+        return TwoPointResult(raw, raw, fell_back=True)
+    return TwoPointResult(work / diff, raw, fell_back=False)
 
 
 @dataclasses.dataclass

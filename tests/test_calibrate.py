@@ -76,6 +76,41 @@ def test_calibration_record_round_trips_through_machine(tmp_path, chip):
     assert not machine.from_calibration(str(p)).calibrated
 
 
+def test_run_refuses_floor_fallback_hbm(tmp_path, monkeypatch, chip):
+    """When the HBM stream probe hits the two-point noise floor (the
+    tunneled platform's dispatch cost dominating — the failure the first
+    on-chip calibrate of round 5 hit), run() must keep the TABLE's HBM
+    value in the emitted chip model and must NOT stamp it calibrated:
+    writing the ~200x-low raw rate would poison every planner cost model
+    pointed at the record (review r5)."""
+    from heat_tpu import calibrate as cal
+
+    monkeypatch.setattr(cal, "measure_hbm", lambda **kw: {
+        "hbm_bytes_per_s": 4.2e9, "hbm_bytes_per_s_raw": 4.2e9,
+        "floor_fallback": True, "buffer_mib": 8, "passes": 2})
+    # pretend we're on a TPU so the guard (a TPU-only concern) engages;
+    # stub the stencil probes (on_tpu=True would try compiled Pallas on
+    # the CPU backend) — the guard/record logic is what's under test
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(cal, "_solve_rate", lambda cfg, **kw: 1.5e11)
+    monkeypatch.setattr(cal, "fit_vpu_2d", lambda *a, **kw: 1.7e12)
+    monkeypatch.setattr(cal, "fit_ops_3d", lambda *a, **kw: 3.1e12)
+    rec = cal.run(str(tmp_path / "cal.json"), quick=True)
+    assert rec["stream"]["floor_fallback"] is True
+    assert rec["hbm_fitted"] is False
+    assert rec["fit_complete"] is False
+    assert rec["chip_model"]["calibrated"] is False
+    # the poisoned rate must not reach the model; the table value must
+    # (classify() on this CPU host's device_kind falls through to
+    # machine._DEFAULT, whose table value run() keeps on fallback)
+    assert rec["chip_model"]["hbm_bytes_per_s"] != 4.2e9
+    assert rec["chip_model"]["hbm_bytes_per_s"] == pytest.approx(
+        machine.classify(jax.devices()[0].device_kind).hbm_bytes_per_s)
+    assert rec["vs_table"]["hbm_ratio"] is None
+
+
 def test_calibration_env_feeds_current(tmp_path, chip, monkeypatch):
     rec = {"trustworthy": True, "platform": "tpu",
            "chip_model": dataclasses.asdict(dataclasses.replace(

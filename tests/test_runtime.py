@@ -90,16 +90,32 @@ def test_two_point_rate_cancels_fixed_overhead(monkeypatch):
 
     # compute 30 ms/call + 60 ms overhead/measurement:
     # T1 ~ 90 ms, T2 ~ 120 ms -> corrected ~ work/30ms, raw ~ work/90ms
-    corrected, raw = timing_mod.two_point_rate(
+    res = timing_mod.two_point_rate(
         lambda x: (_time.sleep(0.030), x)[1], "x", work=1.0, repeats=2)
+    corrected, raw = res
     assert raw == pytest.approx(1.0 / 0.090, rel=0.25)
     assert corrected == pytest.approx(1.0 / 0.030, rel=0.25)
+    assert res.fell_back is False
 
     # overhead-dominated (compute 1 ms vs 60 ms overhead): the noise
-    # floor must return the raw rate unchanged
-    corrected2, raw2 = timing_mod.two_point_rate(
+    # floor must return the raw rate unchanged AND flag the fallback
+    # explicitly (calibrate refuses to trust a fallen-back HBM rate;
+    # float-equality re-derivation was review-flagged as fragile)
+    res2 = timing_mod.two_point_rate(
         lambda x: (_time.sleep(0.001), x)[1], "x", work=1.0, repeats=2)
+    corrected2, raw2 = res2
     assert corrected2 == raw2
+    assert res2.fell_back is True
+
+    # the result must survive pickle/copy (tuple subclass with a custom
+    # __new__ needs __getnewargs__; guard_probe already ships pickles
+    # across processes)
+    import copy
+    import pickle
+
+    back = pickle.loads(pickle.dumps(res2))
+    assert tuple(back) == tuple(res2) and back.fell_back is True
+    assert copy.copy(res2).fell_back is True
 
 
 def test_two_point_repeats_through_solve():
