@@ -1,0 +1,115 @@
+"""Why did the bf16 kernel A/B variants fail on-chip? (round 5)
+
+The sweep's `bench2d_rolled_var bf16native|bf16fma` rows died with
+`MosaicError: INTERNAL: .../remote_compile: HTTP 500: tpu_compile_helper
+subprocess exit code 1` — an opaque tunnel-helper crash that cannot
+distinguish "Mosaic rejects the kernel" from "the helper fell over".
+This lab answers what it can chiplessly: compile the EXACT lab program
+(same tile, same fori_loop wrapper) through the local AOT topology path
+(`guard_probe.topology_spec` single-chip spelling +
+`force_compiled_kernels`), where failures come back as real XLA errors
+with numbers in them, at TWO scales:
+
+- n2=4096: all four variants COMPILE — Mosaic accepts the bf16-native
+  kernels; the on-chip failure is not a kernel rejection.
+- n2=32768 (flagship): ALL variants RESOURCE_EXHAUSTED at an identical
+  "program 18.00G" — including `f32`, which the same sweep compiled AND
+  ran on the real chip at 1.689e11 pts/s minutes earlier. The flagship
+  rows of this harness are therefore an AOT-path accounting artifact
+  (unfaithful to the committed-buffer on-chip path) and say NOTHING
+  about the bf16 variants specifically; they are recorded with that
+  label so nobody quotes them as evidence.
+
+Net: the bf16native/bf16fma flagship-scale failure remains attributable
+to the axon remote-compile helper or flagship-scale resources, not to
+Mosaic rejecting the kernel; the measurable A/B moves to n2=16384
+on-chip (`kernel_lab.py bench2d_rolled_var --n2 16384 ...`).
+
+Writes benchmarks/bf16_variant_compile_check.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from heat_tpu.backends.guard_probe import topology_spec
+    from heat_tpu.ops.pallas_stencil import force_compiled_kernels
+    from kernel_lab import _round_up, pallas_2d_coltiled_rolled
+
+    name, kw = topology_spec("v5e", 1)
+    topo = topologies.get_topology_desc(name, "tpu", **kw)
+    mesh = topologies.make_mesh(topo, (1,), ("d",))
+    sh = NamedSharding(mesh, P())
+
+    R, C, kr, kc = 256, 4096, 16, 128  # the sweep's A/B tile
+    k = min(kr, kc)
+    steps = 96
+
+    rec: dict = {"ts": time.time(),
+                 "tile": {"R": R, "C": C, "kr": kr, "kc": kc},
+                 "topology": name, "scales": {}}
+
+    for n2 in (4096, 32768):
+        shape = (_round_up(n2, R), _round_up(n2, C))
+        x = jax.ShapeDtypeStruct(shape, jnp.bfloat16, sharding=sh)
+        rows: dict = {}
+        for variant in ("f32", "fma", "bf16native", "bf16fma"):
+
+            def run(Tp, variant=variant, n2=n2):
+                def body(i, t):
+                    return pallas_2d_coltiled_rolled(
+                        t, r=0.25, ksteps=k, R=R, C=C, kr=kr, kc=kc,
+                        logical=(n2, n2), variant=variant)
+
+                return jax.lax.fori_loop(0, steps // k, body, Tp)
+
+            t0 = time.perf_counter()
+            try:
+                with force_compiled_kernels():
+                    compiled = jax.jit(run).lower(x).compile()
+                mem = compiled.memory_analysis()
+                row = {"compiles": True,
+                       "compile_s": time.perf_counter() - t0,
+                       "temp_bytes": getattr(mem, "temp_size_in_bytes",
+                                             None)}
+            except Exception as e:
+                row = {"compiles": False,
+                       "compile_s": time.perf_counter() - t0,
+                       "error_type": type(e).__name__,
+                       "error": str(e)[:600]}
+            rows[variant] = row
+            print(n2, variant, json.dumps(row)[:180], flush=True)
+        scale_rec: dict = {"variants": rows}
+        if n2 == 32768 and not rows["f32"]["compiles"]:
+            scale_rec["unfaithful"] = (
+                "f32 control OOMs here yet compiled+ran on the real chip "
+                "in the same sweep — these flagship AOT rows are a "
+                "harness artifact, NOT evidence about any variant")
+        rec["scales"][str(n2)] = scale_rec
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "bf16_variant_compile_check.json")
+    with open(out + ".tmp", "w") as f:
+        json.dump(rec, f, indent=2)
+    os.replace(out + ".tmp", out)
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()

@@ -146,14 +146,18 @@ def drive(
         sync(T_dev)
     solve_s = time.perf_counter() - t0
 
-    tp_rate = None
+    tp_rate = tp_fell_back = None
     if two_point_repeats and remaining > 0:
         k0 = min(chunk, remaining)
         fn = compiled.get(k0) or (lambda t: advance(t, k0))
         # the copy (not T_dev) is donated into the protocol, so the solve's
         # final state survives the extra executions
-        tp_rate, _ = two_point_rate(fn, jnp.copy(T_dev), cfg.points * k0,
-                                    repeats=two_point_repeats)
+        tp_res = two_point_rate(fn, jnp.copy(T_dev), cfg.points * k0,
+                                repeats=two_point_repeats)
+        tp_rate = tp_res[0]
+        # surfaced so consumers that must not trust an overhead-dominated
+        # rate (calibrate's stencil fits) can refuse it (review r5)
+        tp_fell_back = tp_res.fell_back
 
     # fetch=False skips the final device->host copy (benchmark mode: the
     # copy is seconds for GiB-scale fields on a tunneled link and the caller
@@ -182,7 +186,8 @@ def drive(
     timing = Timing(total_s=time.perf_counter() - t_all0 + precompile_s,
                     compile_s=compile_s,
                     solve_s=solve_s, steps=remaining, points=cfg.points,
-                    points_per_s_two_point=tp_rate)
+                    points_per_s_two_point=tp_rate,
+                    two_point_fell_back=tp_fell_back)
     return SolveResult(cfg=cfg, T=T_host, timing=timing, gsum=gsum,
                        gsum_dtype=gsum_dtype,
                        start_step=start_step, T_dev=T_dev)

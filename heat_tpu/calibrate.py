@@ -78,15 +78,21 @@ def measure_hbm(mib: int = 256, repeats: int = 3, passes: int = 256) -> dict:
             "buffer_mib": mib, "passes": passes}
 
 
-def _solve_rate(cfg, repeats: int = 2) -> float:
-    """points/s for ``cfg`` via the framework's own solve path, two-point
-    corrected (falls back to the raw rate below the protocol's noise
-    floor, which two_point_rate handles itself)."""
+def _solve_rate(cfg, repeats: int = 2) -> tuple[float, bool]:
+    """(points/s, overhead_dominated) for ``cfg`` via the framework's own
+    solve path. ``overhead_dominated`` is True when the two-point
+    correction hit its noise floor (or didn't run) and the rate is the
+    raw dispatch-laden one — a fit from such a rate would bake tunnel
+    dispatch into a chip constant, the same poisoning the HBM floor
+    guard refuses (review r5)."""
     from .backends import solve
 
     res = solve(cfg, fetch=False, warm_exec=True,
                 two_point_repeats=repeats)
-    return res.timing.points_per_s_two_point or res.timing.points_per_s
+    t = res.timing
+    if t.points_per_s_two_point and t.two_point_fell_back is False:
+        return t.points_per_s_two_point, False
+    return (t.points_per_s_two_point or t.points_per_s), True
 
 
 def _invert_rate(cost_at_rate, t_pp: float,
@@ -211,23 +217,29 @@ def run(out_path: str, quick: bool = False) -> dict:
     k2 = 16
     cfg2 = HeatConfig(n=n2d, ntime=steps2, dtype="float32",
                       backend="pallas", fuse_steps=k2)
-    rate2 = _solve_rate(cfg2)
-    t_pp2 = 1.0 / rate2
-    vpu = fit_vpu_2d(t_pp2, (n2d, n2d), "float32", k2, chip_meas)
+    rate2, od2 = _solve_rate(cfg2)
+    # an overhead-dominated rate (two-point floor fallback) must not be
+    # fitted: on the tunnel it bakes ~0.15 s of dispatch into a chip
+    # constant — same refusal as the HBM guard above (review r5)
+    vpu = (None if od2 else
+           fit_vpu_2d(1.0 / rate2, (n2d, n2d), "float32", k2, chip_meas))
     rec["sweep_2d"] = {"n": n2d, "fuse": k2, "points_per_s": rate2,
-                       "vpu_ops_per_s_fit": vpu}
+                       "overhead_dominated": od2, "vpu_ops_per_s_fit": vpu}
     print(f"  2D {n2d}^2 fuse={k2}: {rate2:.3e} pts/s -> vpu "
-          f"{vpu / 1e12 if vpu else float('nan'):.2f} Tops/s")
+          f"{vpu / 1e12 if vpu else float('nan'):.2f} Tops/s"
+          + (" [overhead-dominated, fit refused]" if od2 else ""))
 
     k3 = 8
     cfg3 = HeatConfig(n=n3d, ndim=3, ntime=steps3, dtype="float32",
                       backend="pallas", fuse_steps=k3)
-    rate3 = _solve_rate(cfg3)
-    ops3 = fit_ops_3d(1.0 / rate3, (n3d,) * 3, "float32", k3, chip_meas)
+    rate3, od3 = _solve_rate(cfg3)
+    ops3 = (None if od3 else
+            fit_ops_3d(1.0 / rate3, (n3d,) * 3, "float32", k3, chip_meas))
     rec["sweep_3d"] = {"n": n3d, "fuse": k3, "points_per_s": rate3,
-                       "ops_rate_3d_fit": ops3}
+                       "overhead_dominated": od3, "ops_rate_3d_fit": ops3}
     print(f"  3D {n3d}^3 fuse={k3}: {rate3:.3e} pts/s -> ops3d "
-          f"{ops3 / 1e12 if ops3 else float('nan'):.2f} Tops/s")
+          f"{ops3 / 1e12 if ops3 else float('nan'):.2f} Tops/s"
+          + (" [overhead-dominated, fit refused]" if od3 else ""))
 
     fitted = dataclasses.asdict(dataclasses.replace(
         base,

@@ -111,6 +111,11 @@ class Timing:
     # single-call ``points_per_s`` so the official table and the headline
     # metric share one protocol (VERDICT r2 #9).
     points_per_s_two_point: float | None = None
+    # True when the protocol's noise-floor fallback fired and the
+    # two-point field above is really the raw single-call rate; None when
+    # the protocol didn't run. Consumers fitting models from the rate
+    # (calibrate) must refuse fallen-back values (review r5).
+    two_point_fell_back: bool | None = None
 
     @property
     def per_step_s(self) -> float:

@@ -94,7 +94,8 @@ def test_run_refuses_floor_fallback_hbm(tmp_path, monkeypatch, chip):
     import jax
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    monkeypatch.setattr(cal, "_solve_rate", lambda cfg, **kw: 1.5e11)
+    monkeypatch.setattr(cal, "_solve_rate",
+                        lambda cfg, **kw: (1.5e11, False))
     monkeypatch.setattr(cal, "fit_vpu_2d", lambda *a, **kw: 1.7e12)
     monkeypatch.setattr(cal, "fit_ops_3d", lambda *a, **kw: 3.1e12)
     rec = cal.run(str(tmp_path / "cal.json"), quick=True)
@@ -109,6 +110,30 @@ def test_run_refuses_floor_fallback_hbm(tmp_path, monkeypatch, chip):
     assert rec["chip_model"]["hbm_bytes_per_s"] == pytest.approx(
         machine.classify(jax.devices()[0].device_kind).hbm_bytes_per_s)
     assert rec["vs_table"]["hbm_ratio"] is None
+
+
+def test_run_refuses_overhead_dominated_stencil_fit(tmp_path, monkeypatch):
+    """The stencil-probe twin of the HBM floor guard: a rate whose
+    two-point correction fell back to the raw dispatch-laden value must
+    not be inverted into vpu/ops3d constants (review r5)."""
+    from heat_tpu import calibrate as cal
+
+    monkeypatch.setattr(cal, "measure_hbm", lambda **kw: {
+        "hbm_bytes_per_s": 8.1e11, "hbm_bytes_per_s_raw": 7.9e11,
+        "floor_fallback": False, "buffer_mib": 8, "passes": 2})
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(cal, "_solve_rate",
+                        lambda cfg, **kw: (2.8e10, True))  # fell back
+    rec = cal.run(str(tmp_path / "cal.json"), quick=True)
+    assert rec["sweep_2d"]["overhead_dominated"] is True
+    assert rec["sweep_2d"]["vpu_ops_per_s_fit"] is None
+    assert rec["sweep_3d"]["ops_rate_3d_fit"] is None
+    assert rec["fit_complete"] is False
+    assert rec["chip_model"]["calibrated"] is False
+    # the un-fitted table rates must remain in the emitted model
+    assert rec["chip_model"]["vpu_ops_per_s"] == machine._DEFAULT.vpu_ops_per_s
 
 
 def test_calibration_env_feeds_current(tmp_path, chip, monkeypatch):

@@ -127,7 +127,11 @@ def test_two_point_repeats_through_solve():
     timed = solve(cfg, two_point_repeats=1)
     np.testing.assert_array_equal(plain.T, timed.T)
     assert plain.timing.points_per_s_two_point is None
+    assert plain.timing.two_point_fell_back is None
     assert timed.timing.points_per_s_two_point > 0
+    # when the protocol ran, the fallback verdict must be recorded either
+    # way — calibrate refuses to fit overhead-dominated rates (review r5)
+    assert timed.timing.two_point_fell_back in (True, False)
 
 
 def test_two_point_repeats_sharded_padded_carry():
