@@ -1,0 +1,57 @@
+#!/bin/bash
+# Round-5 phase 2: chip work DISCOVERED during the first up-window —
+# items that did not exist when watch_and_sweep.sh was parked:
+#   * calibrate with the fixed probes (the 08:52 run was pre-fix and
+#     dispatch-floor-poisoned; its artifact was deleted, not shipped)
+#   * the n2=16384 bf16-variant A/B (flagship-scale compiles of
+#     bf16native/bf16fma die in the remote-compile helper; 16384 fits
+#     and answers the half-byte hypothesis with a measurement)
+# Waits for the main sweep to exit first — ONE chip, ONE queue.
+set -u
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${XDG_CACHE_HOME:-$HOME/.cache}/heat_tpu/jax}"
+export PYTHONPATH="$(cd "$(dirname "$0")/.." && pwd):${PYTHONPATH:-}"
+cd "$(dirname "$0")/.."
+
+while pgrep -f "watch_and_sweep.sh" > /dev/null 2>&1; do
+  sleep 120
+done
+
+DEADLINE=$(( $(date +%s) + ${BUDGET_S:-14400} ))
+
+probe() { timeout 120 python -c "import jax; assert jax.devices()" 2>/dev/null; }
+
+wait_up() {
+  until probe; do
+    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+      echo "=== extras budget exhausted waiting at $(date)"; exit 1
+    fi
+    echo "tunnel down at $(date); waiting"
+    sleep 300
+  done
+}
+
+phase() {
+  local name=$1 to=$2; shift 2
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "=== extras budget exhausted before $name"; exit 1
+  fi
+  wait_up
+  local remaining=$(( DEADLINE - $(date +%s) ))
+  if [ "$remaining" -lt 120 ]; then
+    echo "=== extras budget exhausted before $name"; exit 1
+  fi
+  [ "$to" -gt "$remaining" ] && to=$remaining
+  echo "=== $name start $(date) (timeout ${to}s)"
+  if timeout "$to" "$@"; then
+    echo "=== $name OK $(date)"
+  else
+    echo "=== $name FAILED rc=$? $(date)"
+  fi
+}
+
+phase calibrate_fixed   2400 python -m heat_tpu.cli calibrate --out benchmarks/calibration_v5e.json
+phase var16k_f32        2400 python benchmarks/kernel_lab.py bench2d_rolled_var f32 256,4096,16,128 --n2 16384
+phase var16k_bf16native 2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16native 256,4096,16,128 --n2 16384
+phase var16k_bf16fma    2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16fma 256,4096,16,128 --n2 16384
+phase var16k_fma        2400 python benchmarks/kernel_lab.py bench2d_rolled_var fma 256,4096,16,128 --n2 16384
+echo "=== extras done at $(date)"
