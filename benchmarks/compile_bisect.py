@@ -2,9 +2,13 @@
 
 Round-3 open question (VERDICT r3 weak #3, memory `fuse32-compile-cliff`):
 the 16384^2 sharded fuse=32 case sat >25 min without completing — Mosaic
-compile cliff, or the tunnel wedge that hit at the same time? The auto
-depth planner (`fuse_depth_sharded`) picks k*=32 for exactly that config,
-so if it IS a compile cliff, the DEFAULT flagship run stalls.
+compile cliff, or the tunnel wedge that hit at the same time? When this
+lab was written the auto depth planner picked k*=32 for exactly that
+config, so if it IS a compile cliff, the DEFAULT flagship run stalled.
+(Round 5 capped the auto depth at the kernel's per-pass chunk — the
+flagship default is now k=16, 471 s measured live — so k=32 rows here
+describe the EXPLICIT --fuse-steps 32 program; the curve remains the
+guard-budget evidence for every depth a user can request.)
 
 This lab answers it directly: for k in {8, 16, 20, 24, 28, 32} it times
 `advance.lower(...).compile()` of the real padded-carry flagship program

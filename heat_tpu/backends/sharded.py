@@ -51,7 +51,8 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from ..config import HeatConfig
-from ..ops.pallas_stencil import (_NO_FREEZE, ftcs_multistep_bounded_pallas,
+from ..ops.pallas_stencil import (_KMAX_2D, _NO_FREEZE,
+                                  ftcs_multistep_bounded_pallas,
                                   pallas_available)
 from ..ops.stencil import accum_dtype_for, laplacian_interior
 from ..parallel.halo import (halo_exchange, halo_exchange_indep, halo_pad,
@@ -507,14 +508,22 @@ def _finalize_carried(cfg: HeatConfig, res, crop, fetch: bool):
     return res
 
 
-# auto depths above this get the compile guard. Round-4 measured cold
-# Mosaic compile times for the auto-picked kernels (chipless AOT-topology
-# bisect, benchmarks/compile_bisect_topology*.json): flagship-scale
-# fused kernels cost MINUTES cold (16384-local: k=8 393 s, k=16 980 s,
-# k=32 665 s — bounded), and the thin-band deep-unroll family is a
-# genuine cliff (8192-local k=32 wedged >36 min before being killed).
-# Shallow auto depths (<=16) only arise for small shards, whose bands —
-# and compiles — are small.
+# auto depths at or above this get the compile guard. Round-4 measured
+# cold Mosaic compile times for the auto-picked kernels (chipless
+# AOT-topology bisect, benchmarks/compile_bisect_topology*.json):
+# flagship-scale fused kernels cost MINUTES cold (16384-local: k=8
+# 393 s, k=16 980 s, k=32 665 s — bounded), and the thin-band
+# deep-unroll family is a genuine cliff (8192-local k=32 wedged >36 min
+# before being killed). Round 5 capped the auto 2D depth at the
+# kernel's per-pass chunk (16 at flagship width — the measured rate
+# optimum), which makes k=16 the DEFAULT flagship program; its cold
+# compile measured 471 s live on-chip (sweep_r5.log 09:21), so the
+# guard now keys on the BAND-WIDTH signal, not depth alone: it engages
+# whenever the shard is wide (the kernel chunk cap binds — including
+# anisotropic meshes whose smallest axis drives kf below 16 while the
+# band stays flagship-wide) or the depth exceeds this. On success the
+# probe's executables are handed to drive(), so guarding costs no extra
+# compile.
 _SAFE_FUSE = 16
 
 # Default probe wall budget. Sized ABOVE every measured cold compile of a
@@ -807,14 +816,26 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
     entered would hang the job."""
     t0 = time.perf_counter()
     kf = fuse_depth_sharded(cfg, mesh.devices.shape)
-    if (cfg.fuse_steps or kf <= _SAFE_FUSE or remaining <= 0
+    # a kf <= _SAFE_FUSE program only costs minutes to compile when the
+    # shard's band is WIDE (the kernel chunk cap binding is exactly that
+    # signal — the 471 s flagship k=16 compile lives in the >6 MiB band
+    # family). Depth alone is NOT the signal in either direction: a
+    # small shard whose sqrt-form lands on 16 compiles in seconds and
+    # must not pay subprocess-probe startup, while an anisotropic mesh
+    # (e.g. 16384^2 over 128x1: 128-row shards drive kf to 8, 16448-wide
+    # bands drive compile to the measured 393 s k=8 family) must be
+    # guarded despite its shallow depth (review r5)
+    wide2d = (cfg.ndim == 2
+              and _auto_chunk_2d(cfg, mesh.devices.shape) < _KMAX_2D)
+    if (cfg.fuse_steps or (kf <= _SAFE_FUSE and not wide2d)
+            or remaining <= 0
             or cfg.local_kernel != "auto" or cfg.dtype == "float64"
             or not _guard_platform_ok()):
         # nothing to guard: explicit user program (a requested
         # --local-kernel pallas must never be silently downgraded to xla
         # — that IS the "wait the compile out" remedy the fallback
-        # warning advertises), shallow auto depth, or the XLA/f64 path
-        # (seconds-fast compiles) already chosen
+        # warning advertises), shallow-AND-narrow auto program, or the
+        # XLA/f64 path (seconds-fast compiles) already chosen
         return cfg, None, GuardReport()
     try:
         budget = float(os.environ.get("HEAT_COMPILE_BUDGET_S",
@@ -889,7 +910,13 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
     # compiles in seconds at every measured size (same fused exchange
     # structure, ~5x lower per-step throughput) — a slower solve that
     # starts now beats a fast one stuck in Mosaic.
-    degrade = {"local_kernel": "xla"}
+    # Pin the probed depth too: the xla kernel is exempt from the
+    # round-5 per-pass chunk cap, so leaving fuse_steps=0 would silently
+    # recompute a DIFFERENT depth (flagship: 32 vs the probed 16) and
+    # the "same fuse depth" the warning promises — and the exchange
+    # cadence/ghost widths any telemetry shows — would not match the
+    # program that runs (review r5).
+    degrade = {"local_kernel": "xla", "fuse_steps": kf}
     note = ""
     if cfg.exchange == "overlap":
         # overlap is BUILT on the Pallas bounded-multistep kernel
@@ -969,27 +996,56 @@ def fuse_depth_sharded(cfg: HeatConfig, axis_sizes) -> int:
     per-exchange overhead (~1/k per step — on the default padded-carry
     path that is the collective dispatch + the exchange breaking kernel
     fusion, no longer a pad+crop copy) against redundant margin work
-    growing as ~2*d*k/L — minimized at k* = sqrt(L/d). Measured on
-    16384^2 f32 single-chip, 1000-step sweep ON the padded-carry path
-    (k* clamps to 32): k=8 -> 94% of the one-pass roofline, k=16 -> 98%,
-    k=32 -> 112% (the official 500-step results.json row records 113.8%)
-    — so the exchange-count term still dominates at 2D scale and the
-    sqrt form stands as measured.
+    growing as ~2*d*k/L — minimized at k* = sqrt(L/d), then capped at
+    the local KERNEL's per-pass chunk depth in BOTH ranks: fusing deeper
+    than the kernel consumes per pass saves only collective dispatches
+    (the HBM passes don't amortize further) while still paying 2*d*k
+    margin compute on wider ghosts.
 
-    The cap is rank-dependent: 2D clamps at _KMAX_2D (=32, measured
-    optimal above); 3D clamps at the 3D kernel's own per-pass chunk depth
-    _KMAX_3D (=8) — exchanging wider than the kernel consumes per pass
-    pays 2*d*k margin compute on three axes while the extra collective
-    savings past k=8 are marginal (for realistic 3D shards sqrt(L/d) <= 8
-    anyway: 512^3 over 2x2x2 gives k*=9->8)."""
-    from ..ops.pallas_stencil import _KMAX_2D, _KMAX_3D
+    The 2D cap is round-5 MEASURED, not just modeled: the round-2 sweep
+    that crowned k=32 (k=8/16/32 -> 94/98/112% roofline) predates the
+    round-4 ``_thin_chunk_cap``, which executes k=32 as two 16-deep
+    passes at flagship width; with that cap in place the on-chip 4-point
+    curve (benchmarks/collective_overhead.json, 2026-08-01) inverts the
+    optimum: k=16 -> 1.571e11, k=32 -> 1.399e11 (12% loss) at 16384^2
+    f32. 3D clamps at _KMAX_3D (=8) for the same reason (for realistic
+    3D shards sqrt(L/d) <= 8 anyway: 512^3 over 2x2x2 gives k*=9->8).
+    An EXPLICIT fuse_steps is honored either way (capped only by the
+    local extent) — the A/B labs must be able to pin any depth — and a
+    CONFIGURED xla local kernel has no per-pass chunk, so its auto depth
+    keeps the plain sqrt form (including dtype float64, which can never
+    run the Pallas kernel and always resolves to xla). The cap keys on
+    the configured kernel deliberately: local_kernel='auto' keeps the
+    cap even on hosts where auto resolves to xla at runtime (CPU tests,
+    the 8-device dryrun), so chipless runs exercise the same exchange
+    structure the TPU default compiles — structural fidelity over a
+    perf optimum no one measures off-chip."""
+    from ..ops.pallas_stencil import _KMAX_3D
 
     kmax = _KMAX_2D if cfg.ndim == 2 else _KMAX_3D
     local_min = min(cfg.n // s for s in axis_sizes)
     want = cfg.fuse_steps
     if not want:
         want = max(1, min(kmax, round((local_min / cfg.ndim) ** 0.5)))
+        if (cfg.ndim == 2 and cfg.local_kernel != "xla"
+                and cfg.dtype != "float64"):
+            want = min(want, _auto_chunk_2d(cfg, axis_sizes))
     return max(1, min(want, local_min))
+
+
+def _auto_chunk_2d(cfg: HeatConfig, axis_sizes) -> int:
+    """Per-pass chunk depth of the 2D kernel the planner will SELECT for
+    this shard, evaluated at the ghost-PADDED shape the kernel actually
+    sees (deepest candidate ghost allowance — near the band threshold
+    the unpadded width under-reports: local 4864 reads cap=32 unpadded
+    but the (4864+64)-wide runtime array chunks at 16). ONE shared
+    derivation for the fuse chooser (depth cap) and the compile guard
+    (wide-band signal) so the two cannot disagree (review r5)."""
+    from ..ops.pallas_stencil import effective_chunk_2d
+
+    rows = cfg.n // axis_sizes[0] + 2 * _KMAX_2D
+    cols = cfg.n // axis_sizes[-1] + 2 * _KMAX_2D
+    return effective_chunk_2d((rows, cols), cfg.dtype)
 
 
 def _chunked_advance(mesh, step, kf: int):

@@ -157,6 +157,21 @@ def _thin_chunk_cap(n_pad: int, dtype_str) -> int:
     return 16 if band > _THIN_DEEP_BAND_CAP_BYTES else _KMAX_2D
 
 
+def effective_chunk_2d(shape, dtype_str, ksteps: int | None = None) -> int:
+    """Per-pass chunk depth of the kernel ``_plan_2d`` SELECTS at a
+    LOGICAL runtime shape — the shape the kernel will actually see,
+    ghosts included. The one derivation callers outside the planner (the
+    sharded fuse chooser, the compile guard) may use: re-deriving the
+    padding recipe in another module is how the round-5 near-threshold
+    bug happened (cap computed on the unpadded width while the kernel
+    ran on the ghost-padded one), and hardcoding the THIN cap would pin
+    the exchange depth to the wrong kernel when the planner picks the
+    coltiled body (its plan carries its own kchunk)."""
+    plan = _plan_2d(tuple(shape), dtype_str,
+                    _KMAX_2D if ksteps is None else ksteps)
+    return plan[1] if plan[0] == "thin" else plan[-1]
+
+
 def _tile_2d(n_pad: int, kpad: int) -> int:
     """Row-tile height: a multiple of kpad (so halo blocks index evenly),
     sized to keep the (tile + 2*kpad)-row band near the budget (the band is

@@ -68,9 +68,23 @@ def test_fuse_depth_capped_by_local_extent():
     assert fuse_depth_sharded(cfg, (8, 1)) == round((4 / 2) ** 0.5)
     assert fuse_depth_sharded(cfg, (2, 2)) == round((16 / 2) ** 0.5)
     assert fuse_depth_sharded(cfg.with_(fuse_steps=3), (2, 2)) == 3
-    # large local blocks clamp at the kernel fusion cap (measured best)
-    big = cfg.with_(n=16384)
-    assert fuse_depth_sharded(big, (1, 1)) == 32
+    # large local blocks clamp at the kernel's PER-PASS chunk depth (16
+    # at flagship width under _thin_chunk_cap): the round-5 on-chip curve
+    # measured k=16 12% faster than k=32 once k=32 executes as two
+    # 16-deep passes (collective_overhead.json 2026-08-01)
+    big = cfg.with_(n=16384, dtype="float32")
+    assert fuse_depth_sharded(big, (1, 1)) == 16
+    # ... while the xla local kernel (no per-pass chunk) keeps sqrt form
+    assert fuse_depth_sharded(big.with_(local_kernel="xla"), (1, 1)) == 32
+    # ... as does f64 (BASE's dtype), which always resolves to xla
+    assert fuse_depth_sharded(big.with_(dtype="float64"), (1, 1)) == 32
+    # and an explicit 32 is still honored
+    assert fuse_depth_sharded(big.with_(fuse_steps=32), (1, 1)) == 32
+    # near the band threshold the cap must be judged at the GHOST-PADDED
+    # width the kernel actually sees: local 4864 reads 32 unpadded but
+    # the (4864+2*32)-wide runtime band crosses the cap -> 16 (review r5)
+    near = cfg.with_(n=4864, dtype="float32")
+    assert fuse_depth_sharded(near, (1, 1)) == 16
 
 
 def test_sharded_staged_comm_matches_direct():
@@ -230,9 +244,12 @@ def test_fuse_depth_rank_aware_caps():
     # sqrt(512/3) ~ 13 would exceed the 3D kernel's chunk depth of 8
     assert fuse_depth_sharded(cfg3, (1, 1, 1)) == _KMAX_3D
     assert fuse_depth_sharded(cfg3, (2, 2, 2)) <= _KMAX_3D
-    # 2D keeps its measured optimum (16384^2: k* clamps to 32)
+    # 2D clamps at the thin-band per-pass chunk depth at flagship width
+    # (round-5 measured optimum; _KMAX_2D=32 remains the EXPLICIT cap)
     cfg2 = HeatConfig(n=16384, ndim=2, dtype="float32", backend="sharded")
-    assert fuse_depth_sharded(cfg2, (1, 1)) == _KMAX_2D
+    assert fuse_depth_sharded(cfg2, (1, 1)) == 16
+    assert fuse_depth_sharded(cfg2.with_(fuse_steps=_KMAX_2D),
+                              (1, 1)) == _KMAX_2D
     # explicit requests are honored (capped only by the local extent)
     assert fuse_depth_sharded(cfg3.with_(fuse_steps=16), (1, 1, 1)) == 16
     # tiny local extents still clamp
