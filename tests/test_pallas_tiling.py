@@ -151,3 +151,19 @@ def test_thin_deep_unroll_compile_cap():
     # the planner's thin choice reflects the cap (costs stay honest)
     plan = ps._plan_2d((8192, 8192), "float32", 32)
     assert plan[0] != "thin" or plan[1] <= 16
+
+
+def test_effective_chunk_is_plan_aware():
+    """effective_chunk_2d must report the chunk of the kernel _plan_2d
+    SELECTS, not hardcode the thin cap: at the bf16-flagship ghosted
+    shape the planner picks the coltiled body and the exchange depth
+    must follow ITS kchunk (review r5)."""
+    shape = (32832, 32832)  # 32768 + 2*32 ghosts
+    plan = ps._plan_2d(shape, "bfloat16", 32)
+    assert plan[0] == "coltiled"
+    assert ps.effective_chunk_2d(shape, "bfloat16") == plan[-1] == 16
+    # thin selections return the thin chunk (narrow: uncapped)
+    assert ps.effective_chunk_2d((4160, 4160), "float32") == 32
+    # anisotropic wide-band: 128-row shard of 16384^2 (the guard's
+    # wide-band signal for shallow-depth meshes)
+    assert ps.effective_chunk_2d((192, 16448), "float32") == 16
