@@ -57,9 +57,10 @@ phase() {
 # (the main sweep's attempt hit its 5400 s cap at rc=124 while the
 # 1-core host was shared with test suites — NOTE overlap_ab.py has no
 # row-resume: the retry re-runs the indep row too, cheap only via the
-# warm compile cache, and a retry killed before its first write clobbers
-# the prior partial artifact); row3 captures the fuse-optimum lift; the
-# var16k A/Bs are BASELINE evidence.
+# warm compile cache, and its FIRST row write replaces the whole
+# artifact — a retry that lands one row has already dropped the prior
+# run's rows, and only a full completion restores them); row3 captures
+# the fuse-optimum lift; the var16k A/Bs are BASELINE evidence.
 phase calibrate_fixed   2400 python -m heat_tpu.cli calibrate --out benchmarks/calibration_v5e.json
 phase overlap_ab_retry  7200 python benchmarks/overlap_ab.py
 # round-5 fuse-optimum change: auto depth at 16384^2 is now k=16 (the

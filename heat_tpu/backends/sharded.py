@@ -508,22 +508,20 @@ def _finalize_carried(cfg: HeatConfig, res, crop, fetch: bool):
     return res
 
 
-# auto depths at or above this get the compile guard. Round-4 measured
-# cold Mosaic compile times for the auto-picked kernels (chipless
+# auto depths above this get the compile guard. Round-4 measured cold
+# Mosaic compile times for the auto-picked kernels (chipless
 # AOT-topology bisect, benchmarks/compile_bisect_topology*.json):
 # flagship-scale fused kernels cost MINUTES cold (16384-local: k=8
-# 393 s, k=16 980 s, k=32 665 s — bounded), and the thin-band
-# deep-unroll family is a genuine cliff (8192-local k=32 wedged >36 min
-# before being killed). Round 5 capped the auto 2D depth at the
-# kernel's per-pass chunk (16 at flagship width — the measured rate
-# optimum), which makes k=16 the DEFAULT flagship program; its cold
-# compile measured 471 s live on-chip (sweep_r5.log 09:21), so the
-# guard now keys on the BAND-WIDTH signal, not depth alone: it engages
-# whenever the shard is wide (the kernel chunk cap binds — including
-# anisotropic meshes whose smallest axis drives kf below 16 while the
-# band stays flagship-wide) or the depth exceeds this. On success the
-# probe's executables are handed to drive(), so guarding costs no extra
-# compile.
+# 393 s, k=16 980-2038 s on the TOPOLOGY path vs 471 s live, k=32
+# 665 s), and the thin-band deep-unroll family is a genuine cliff
+# (8192-local k=32 wedged >36 min before being killed). Round 5 capped
+# the auto 2D depth at the kernel's per-pass chunk (16 at flagship
+# width — the measured rate optimum), which removes the wedge family
+# from the auto path's reach entirely: every auto program now cold-
+# compiles in bounded minutes on the live path, so depths <= 16 stay
+# unguarded (the probe's topology-path compile of the k=16 flagship
+# costs >2000 s — 4x the live compile it would bound; see
+# _guard_fuse_compile).
 _SAFE_FUSE = 16
 
 # Default probe wall budget. Sized ABOVE every measured cold compile of a
@@ -816,26 +814,27 @@ def _guard_fuse_compile(cfg: HeatConfig, mesh, remaining: int,
     entered would hang the job."""
     t0 = time.perf_counter()
     kf = fuse_depth_sharded(cfg, mesh.devices.shape)
-    # a kf <= _SAFE_FUSE program only costs minutes to compile when the
-    # shard's band is WIDE (the kernel chunk cap binding is exactly that
-    # signal — the 471 s flagship k=16 compile lives in the >6 MiB band
-    # family). Depth alone is NOT the signal in either direction: a
-    # small shard whose sqrt-form lands on 16 compiles in seconds and
-    # must not pay subprocess-probe startup, while an anisotropic mesh
-    # (e.g. 16384^2 over 128x1: 128-row shards drive kf to 8, 16448-wide
-    # bands drive compile to the measured 393 s k=8 family) must be
-    # guarded despite its shallow depth (review r5)
-    wide2d = (cfg.ndim == 2
-              and _auto_chunk_2d(cfg, mesh.devices.shape) < _KMAX_2D)
-    if (cfg.fuse_steps or (kf <= _SAFE_FUSE and not wide2d)
-            or remaining <= 0
+    # Trigger stays kf > _SAFE_FUSE (round-4 form) DELIBERATELY, after a
+    # round-5 detour through guarding kf == 16: the round-5 per-pass
+    # chunk cap means the auto path can no longer reach the >36-min
+    # wedge family at all — wide shards cap at k=16, whose LIVE cold
+    # compile measured a bounded 471 s (sweep_r5.log 09:21) — while the
+    # subprocess probe's topology-path compile of that same program
+    # measured >2000 s (the k=16 compile anomaly, live-path cache
+    # entries do NOT serve the topology child). Guarding k=16 therefore
+    # costs ~4x the compile it bounds and risks timing the default
+    # flagship into the degraded kernel; bounded-minutes compiles are
+    # not the stall the guard exists for. Auto depths > 16 only arise
+    # for narrow shards (chunk cap 32, small bands, fast compiles) and
+    # keep the guard as belt-and-braces.
+    if (cfg.fuse_steps or kf <= _SAFE_FUSE or remaining <= 0
             or cfg.local_kernel != "auto" or cfg.dtype == "float64"
             or not _guard_platform_ok()):
         # nothing to guard: explicit user program (a requested
         # --local-kernel pallas must never be silently downgraded to xla
         # — that IS the "wait the compile out" remedy the fallback
-        # warning advertises), shallow-AND-narrow auto program, or the
-        # XLA/f64 path (seconds-fast compiles) already chosen
+        # warning advertises), capped auto depth, or the XLA/f64 path
+        # (seconds-fast compiles) already chosen
         return cfg, None, GuardReport()
     try:
         budget = float(os.environ.get("HEAT_COMPILE_BUDGET_S",
@@ -1038,9 +1037,11 @@ def _auto_chunk_2d(cfg: HeatConfig, axis_sizes) -> int:
     this shard, evaluated at the ghost-PADDED shape the kernel actually
     sees (deepest candidate ghost allowance — near the band threshold
     the unpadded width under-reports: local 4864 reads cap=32 unpadded
-    but the (4864+64)-wide runtime array chunks at 16). ONE shared
-    derivation for the fuse chooser (depth cap) and the compile guard
-    (wide-band signal) so the two cannot disagree (review r5)."""
+    but the (4864+64)-wide runtime array chunks at 16). Sole consumer:
+    ``fuse_depth_sharded``'s depth cap. (A round-5 interim also fed a
+    guard wide-band signal from here; the guard reverted to depth-only
+    gating once the probe's topology-compile cost was measured — see
+    ``_guard_fuse_compile``.)"""
     from ..ops.pallas_stencil import effective_chunk_2d
 
     rows = cfg.n // axis_sizes[0] + 2 * _KMAX_2D

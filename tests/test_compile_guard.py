@@ -17,10 +17,15 @@ from heat_tpu.parallel.mesh import build_mesh
 
 
 def _flagship_cfg(**kw):
+    # n=4096: the auto depth is 32 (narrow shard, chunk cap 32), which
+    # is the class the guard still covers after round 5 capped wide
+    # shards at k=16 and left depths <= 16 unguarded (the 16384^2
+    # flagship's k=16 live compile is a bounded 471 s; probing it via
+    # the topology child costs >2000 s — see _guard_fuse_compile)
     kw.setdefault("fuse_steps", 0)
     kw.setdefault("ntime", 500)
     kw.setdefault("dtype", "float32")
-    return HeatConfig(n=16384, backend="sharded", mesh_shape=(1, 1), **kw)
+    return HeatConfig(n=4096, backend="sharded", mesh_shape=(1, 1), **kw)
 
 
 @pytest.fixture
@@ -64,20 +69,16 @@ def test_guard_falls_back_on_compile_timeout(mesh, monkeypatch, capsys):
     monkeypatch.setattr(sharded, "_compile_probe",
                         lambda *a, **kw: time.sleep(30))
     cfg = _flagship_cfg()
-    # round-5 depth cap: the auto flagship program is now k=16 (measured
-    # rate optimum) and the guard engages AT _SAFE_FUSE — its 471 s
-    # measured cold compile still needs bounding
-    assert sharded.fuse_depth_sharded(cfg, (1, 1)) == sharded._SAFE_FUSE
+    assert sharded.fuse_depth_sharded(cfg, (1, 1)) == 32
     out, pre, rep = sharded._guard_fuse_compile(cfg, mesh, cfg.ntime)
     assert out.local_kernel == "xla" and pre is None
     # the probed depth is PINNED into the fallback: the xla kernel is
-    # exempt from the chunk cap, so fuse_steps=0 would silently
-    # recompute a different (deeper) depth than the warning promises
-    assert out.fuse_steps == sharded._SAFE_FUSE
+    # exempt from the chunk cap, so fuse_steps=0 could silently
+    # recompute a different depth than the warning promises
+    assert out.fuse_steps == 32
     assert rep.probe_s > 0  # the probe's wall cost is reported, not hidden
     assert rep.timed_out and rep.orphan == "left_running"  # thread probe
-    assert rep.degraded == {"local_kernel": "xla",
-                            "fuse_steps": sharded._SAFE_FUSE}
+    assert rep.degraded == {"local_kernel": "xla", "fuse_steps": 32}
     msg = capsys.readouterr().out
     assert "WARNING" in msg and "local_kernel='xla'" in msg
 
@@ -112,7 +113,7 @@ def test_guard_hands_probe_executables_forward(mesh, monkeypatch):
     out, pre, rep = sharded._guard_fuse_compile(_flagship_cfg(), mesh, 500)
     assert out.fuse_steps == 0      # auto depth survives
     assert pre is fake              # drive never recompiles the probe's work
-    assert calls == [(sharded._SAFE_FUSE, 500, True)]  # r5 auto depth: 16
+    assert calls == [(32, 500, True)]
     assert rep.probed and not rep.timed_out and rep.orphan is None
 
 
@@ -132,7 +133,7 @@ def test_guard_timeout_on_overlap_degrades_exchange_too(mesh, monkeypatch,
     assert out.local_kernel == "xla" and out.exchange == "indep"
     assert pre is None and rep.probe_s > 0
     assert rep.degraded == {"local_kernel": "xla", "exchange": "indep",
-                            "fuse_steps": 16}
+                            "fuse_steps": 32}
     msg = capsys.readouterr().out
     assert "overlap" in msg and "'indep'" in msg
     # the degraded cfg must be one make_local_multistep accepts (this is
@@ -279,13 +280,13 @@ def test_guard_noop_on_cpu(mesh, monkeypatch):
 
 
 def test_guard_noop_at_safe_depths(mesh, monkeypatch):
-    # round 5: the guard engages at _SAFE_FUSE only for WIDE shards (the
-    # 471 s flagship k=16 compile family); depths below it, and narrow
-    # shards whose sqrt-form lands exactly on 16, skip the probe
+    # depths <= _SAFE_FUSE never probe (round 5: the chunk cap bounds
+    # every such program's live compile; the probe would cost more than
+    # the compile — see test_guard_skips_capped_flagship_depths)
     monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
     monkeypatch.setattr(
         sharded, "_compile_probe",
-        lambda *a, **kw: pytest.fail("narrow/shallow needs no guard"))
+        lambda *a, **kw: pytest.fail("k<=16 needs no guard"))
     shallow = HeatConfig(n=128, ntime=100, dtype="float32",
                          backend="sharded", mesh_shape=(1, 1))  # k* = 8
     assert sharded.fuse_depth_sharded(shallow, (1, 1)) < sharded._SAFE_FUSE
@@ -294,41 +295,55 @@ def test_guard_noop_at_safe_depths(mesh, monkeypatch):
 
     narrow16 = HeatConfig(n=512, ntime=100, dtype="float32",
                           backend="sharded", mesh_shape=(1, 1))
-    # auto k* = sqrt(512/2) = 16 — ON the boundary, but a 512-wide band
-    # compiles in seconds: no probe (review r5)
+    # auto k* = sqrt(512/2) = 16 — ON the boundary: no probe
     assert sharded.fuse_depth_sharded(narrow16, (1, 1)) == sharded._SAFE_FUSE
     out, pre, rep = sharded._guard_fuse_compile(narrow16, mesh, 100)
     assert (out, pre) == (narrow16, None) and not rep.probed
 
 
-def test_guard_engages_on_wide_shallow_shard(monkeypatch):
-    """Anisotropic hole (review r5): a 128x1 mesh over 16384^2 gives
-    128-row shards (kf = sqrt(128/2) = 8) with 16448-wide bands — the
-    measured 393 s k=8 wide-band compile family. Depth-only gating
-    skipped the guard here; the band-width signal must engage it. (A
-    128-device mesh can't be built on the 8-device CPU conftest; the
-    guard reads only mesh.devices.shape and the probe is patched, so a
-    stub mesh exercises the real gating logic.)"""
+def test_guard_skips_capped_flagship_depths(monkeypatch):
+    """Round-5 policy: the chunk cap removes the wedge family from the
+    auto path (wide shards cap at k=16, live cold compile a bounded
+    471 s), and the subprocess probe's topology-path compile of that
+    same program costs >2000 s (measured; live cache entries do not
+    serve the topology child) — so depths <= 16 must NOT probe: the
+    guard would cost 4x the compile it bounds and could time the
+    default flagship into the degraded kernel. (Stub mesh: the guard
+    reads only mesh.devices.shape and the probe is patched.)"""
     monkeypatch.setenv("HEAT_GUARD_PROBE", "thread")
     monkeypatch.setenv("HEAT_COMPILE_BUDGET_S", "0.05")
     monkeypatch.setattr(sharded, "_guard_platform_ok", lambda: True)
-    monkeypatch.setattr(sharded, "_compile_probe",
-                        lambda *a, **kw: time.sleep(30))
-    cfg = HeatConfig(n=16384, ntime=100, dtype="float32", backend="sharded",
-                     mesh_shape=(128, 1))
-    kf = sharded.fuse_depth_sharded(cfg, (128, 1))
-    assert kf < sharded._SAFE_FUSE            # shallow ...
-    assert sharded._auto_chunk_2d(cfg, (128, 1)) < 32  # ... but wide
+    monkeypatch.setattr(
+        sharded, "_compile_probe",
+        lambda *a, **kw: pytest.fail("capped depths must not probe"))
 
     class _Devices:
-        shape = (128, 1)
+        shape = (1, 1)
 
     class _StubMesh:
         devices = _Devices()
 
-    out, pre, rep = sharded._guard_fuse_compile(cfg, _StubMesh(), 100)
-    assert rep.probed and rep.timed_out       # the guard DID engage
-    assert out.local_kernel == "xla" and pre is None
+    # the 16384^2 flagship: auto depth capped at 16 -> unguarded
+    flagship = HeatConfig(n=16384, ntime=100, dtype="float32",
+                          backend="sharded", mesh_shape=(1, 1))
+    assert sharded.fuse_depth_sharded(flagship, (1, 1)) == 16
+    out, pre, rep = sharded._guard_fuse_compile(flagship, _StubMesh(), 100)
+    assert (out, pre) == (flagship, None) and not rep.probed
+
+    # anisotropic wide-shallow (128-row shards of 16384^2, kf=8): also
+    # unguarded — its k=8 live compile is the bounded 393 s family, not
+    # the wedge
+    class _Devices128:
+        shape = (128, 1)
+
+    class _StubMesh128:
+        devices = _Devices128()
+
+    aniso = HeatConfig(n=16384, ntime=100, dtype="float32",
+                       backend="sharded", mesh_shape=(128, 1))
+    assert sharded.fuse_depth_sharded(aniso, (128, 1)) < 16
+    out, pre, rep = sharded._guard_fuse_compile(aniso, _StubMesh128(), 100)
+    assert (out, pre) == (aniso, None) and not rep.probed
 
 
 @pytest.mark.parametrize("padded", [True, False])

@@ -164,6 +164,6 @@ def test_effective_chunk_is_plan_aware():
     assert ps.effective_chunk_2d(shape, "bfloat16") == plan[-1] == 16
     # thin selections return the thin chunk (narrow: uncapped)
     assert ps.effective_chunk_2d((4160, 4160), "float32") == 32
-    # anisotropic wide-band: 128-row shard of 16384^2 (the guard's
-    # wide-band signal for shallow-depth meshes)
+    # anisotropic wide-band: 128-row shard of 16384^2 (consumed by the
+    # fuse-depth chunk cap; the kernel still chunks at 16 at this width)
     assert ps.effective_chunk_2d((192, 16448), "float32") == 16
