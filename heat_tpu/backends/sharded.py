@@ -635,11 +635,16 @@ def _subprocess_probe(cfg: HeatConfig, mesh, kf: int, remaining: int,
     On timeout the whole child process GROUP is SIGKILLed — unlike the
     thread probe, no abandoned Mosaic compile outlives the budget (the
     orphan-capping contract, VERDICT r4 #8). The serialized executables
-    are the ONLY hand-forward mechanism here: topology AOT compiles do
-    not populate the persistent compile cache (observed round 5 — the
-    bisect children's per-k cache dirs come back empty), so a successful
-    child that fails to transfer costs one bounded recompile in drive,
-    and a killed child leaves nothing behind."""
+    are the only RELIABLE hand-forward mechanism here: for
+    Mosaic-kernel programs, topology AOT compiles neither write the
+    persistent compile cache (bisect children's per-k cache dirs come
+    back empty) nor get served by live-written entries (re-verified
+    round 5 against a sweep-warmed cache — the pinned-kernel child
+    recompiled from scratch); a topology-compiled plain-XLA program was
+    observed to land an entry, but the probe exists precisely for the
+    Mosaic family. So a successful child that fails to transfer costs
+    one bounded recompile in drive, and a killed child leaves nothing
+    behind."""
     import json
     import shutil
     import tempfile
