@@ -18,8 +18,15 @@ done
 
 # budget must FUND the full queue: phase caps below sum to ~21,700s, so
 # a 14,400s default silently clamped/skipped the tail phases in exactly
-# the slow-host scenario the retry exists for (review r5)
+# the slow-host scenario the retry exists for (review r5). The HARD_END
+# wall-clock cap exists because this queue starts whenever the main
+# sweep exits — possibly very late: the round's driver reclaims the
+# chip for its final bench around 20:27 UTC, and a phase still holding
+# the chip then would fail the round's official capture. 19:40 leaves
+# ~45 min of margin.
+HARD_END=${HARD_END:-1785613200}  # 2026-08-01 19:40 UTC
 DEADLINE=$(( $(date +%s) + ${BUDGET_S:-23000} ))
+[ "$DEADLINE" -gt "$HARD_END" ] && DEADLINE=$HARD_END
 
 probe() { timeout 120 python -c "import jax; assert jax.devices()" 2>/dev/null; }
 
