@@ -78,4 +78,13 @@ phase var16k_f32        2400 python benchmarks/kernel_lab.py bench2d_rolled_var 
 phase var16k_bf16native 2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16native 256,4096,16,128 --n2 16384
 phase var16k_bf16fma    2400 python benchmarks/kernel_lab.py bench2d_rolled_var bf16fma 256,4096,16,128 --n2 16384
 phase var16k_fma        2400 python benchmarks/kernel_lab.py bench2d_rolled_var fma 256,4096,16,128 --n2 16384
+# Certification phases the MAIN sweep will have dropped if its budget
+# expired waiting out the outage — best-effort here, clamped by
+# HARD_END; chip_check refreshes the hardware numeric certification
+# artifact (round-2 vintage otherwise).
+phase sharded3d_check   1800 python benchmarks/sharded3d_check.py
+phase check2d_rolled    1800 python benchmarks/kernel_lab.py check2d_rolled
+phase checkthin         1800 python benchmarks/kernel_lab.py checkthin
+phase check3d_rolled    1800 python benchmarks/kernel_lab.py check3d_rolled
+phase chip_check        2400 python benchmarks/chip_check.py
 echo "=== extras done at $(date)"
