@@ -1,0 +1,73 @@
+#!/bin/bash
+# Round-5 final-session phase 3: surplus-window work. Today's host is
+# ~5x faster at Mosaic compiles than Aug 1 (chip_check 62 s vs ~20 min;
+# 16384-class kernel compiles ~82 s vs 471 s), so the window funds
+# exploration the round never had room for:
+#   1. full official-table refresh — rows 1/2/4/5 were measured Aug 1
+#      BEFORE the fuse-cap change that lifted bench +8.5% and row 3
+#      +12%; a same-code same-host table beats a mixed-vintage one.
+#   2. thin-band BAND-SIZE A/B at the headline shape: _tile_2d hard-caps
+#      the band at 256 rows, but the VMEM budget at 4096^2 admits ~700
+#      and the cost model says bigger is strictly better (lower halo
+#      overhead + fewer passes). If 512/768 measures faster, the cap is
+#      costing headline points and becomes a planner change.
+#   3. bf16native at n2=4096 ON-CHIP: completes the size bracket of the
+#      remote-compile-helper failure (16384 fails, AOT-topology 4096
+#      compiles — does the helper accept 4096?).
+#   4. 3D geometry A/B around the shipped (64,64,8,8) plan + fma variant
+#      (the old queue's 3d_f32_ab/3d_fma_ab, dropped on Aug 1).
+#   5. thin rolledfma A/B (old thin_fma_ab phase).
+#   6. one more live k=32 compile sample (instability population).
+# Waits for extras_r5b to exit first — ONE chip, ONE queue.
+set -u
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${XDG_CACHE_HOME:-$HOME/.cache}/heat_tpu/jax}"
+export PYTHONPATH="$(cd "$(dirname "$0")/.." && pwd):${PYTHONPATH:-}"
+cd "$(dirname "$0")/.."
+
+while pgrep -f "extras_r5b.sh" > /dev/null 2>&1; do
+  sleep 60
+done
+
+HARD_END=${HARD_END:-1785722400}  # 2026-08-03 02:00 UTC
+DEADLINE=$(( $(date +%s) + ${BUDGET_S:-30000} ))
+[ "$DEADLINE" -gt "$HARD_END" ] && DEADLINE=$HARD_END
+
+probe() { timeout 120 python -c "import jax; assert jax.devices()" 2>/dev/null; }
+
+wait_up() {
+  until probe; do
+    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+      echo "=== extras_r5c budget exhausted waiting at $(date)"; exit 1
+    fi
+    echo "tunnel down at $(date); waiting"
+    sleep 300
+  done
+}
+
+phase() {
+  local name=$1 to=$2; shift 2
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "=== budget exhausted before $name"; exit 1
+  fi
+  wait_up
+  local remaining=$(( DEADLINE - $(date +%s) ))
+  if [ "$remaining" -lt 120 ]; then
+    echo "=== budget exhausted before $name"; exit 1
+  fi
+  [ "$to" -gt "$remaining" ] && to=$remaining
+  echo "=== $name start $(date) (timeout ${to}s)"
+  if timeout "$to" "$@"; then
+    echo "=== $name OK $(date)"
+  else
+    echo "=== $name FAILED rc=$? $(date)"
+  fi
+}
+
+phase run_all_refresh  7200 python benchmarks/run_all.py --row-timeout 2500
+phase thin_band_ab     3600 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolled,512,16 rolled,768,16 rolled,384,16 rolled,512,8
+phase bf16n_4096_probe 1200 python benchmarks/kernel_lab.py bench2d_rolled_var bf16native 256,4096,16,128 --n2 4096
+phase 3d_geom_ab       3600 python benchmarks/kernel_lab.py bench3d_rolled_var f32 64,64,8,8 128,64,8,8 64,128,8,8 96,96,8,8
+phase 3d_fma_ab        1800 python benchmarks/kernel_lab.py bench3d_rolled_var fma 64,64,8,8
+phase thin_fma_ab      1800 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolledfma,256,16
+phase compile_bisect32 2000 python benchmarks/compile_bisect.py --ks 32 --timeout 1800
+echo "=== extras_r5c done at $(date)"
