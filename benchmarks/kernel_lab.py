@@ -48,6 +48,34 @@ def _roof(dtype) -> float:
     return machine.current().roofline_points_per_s(dtype)
 
 
+# The terminal-side libtpu's Mosaic backend does not implement
+# tpu.dynamic_rotate on sub-32-bit vectors: "not implemented: Rotate with
+# non-32-bit data" (first surfaced with a readable message 2026-08-02 —
+# the remote-compile helper used to collapse it to an opaque "HTTP 500:
+# tpu_compile_helper subprocess exit code 1", which round 5 initially
+# triaged as a helper/scale failure). The venv's OWN libtpu (0.0.34, the
+# chipless AOT path bf16_variant_compile_check.py drives) DOES compile
+# the same kernels — a backend version skew, not a kernel bug. The
+# bf16native/bf16fma variants roll in bf16 BY DESIGN (that is the
+# half-byte-traffic hypothesis under test), so on backends with this
+# limitation they are expected-unsupported: checks report and continue,
+# and any OTHER failure still fails the run.
+_BF16_ROTATE_UNSUPPORTED = "Rotate with non-32-bit data"
+
+
+def _expected_unsupported(e: BaseException) -> bool:
+    return _BF16_ROTATE_UNSUPPORTED in str(e)
+
+
+def _failure_tag(e: BaseException) -> str:
+    """One classification for every bench's except block — the honest
+    label for the known backend limitation, the raw error otherwise."""
+    if _expected_unsupported(e):
+        return ("UNSUPPORTED (Mosaic dynamic_rotate is 32-bit-only on "
+                "this backend)")
+    return f"FAILED {type(e).__name__}: {str(e)[:200]}"
+
+
 def _round_up(x, m):
     return ((x + m - 1) // m) * m
 
@@ -278,7 +306,14 @@ def check_3d_rolled():
     m, mid, n = 40, 24, 300
     T = rng.uniform(1, 2, (m, mid, n)).astype(np.float32)
     r = 0.15
-    k = km = 4
+    # km=8, not 4: the mid-axis halo block is the second-to-last dim of
+    # its BlockSpec, and the TPU Pallas lowering requires the last two
+    # block dims divisible by (8, 128) — a sub-sublane km only ever
+    # worked in interpret mode (the shipped planner sublane-aligns km via
+    # _round_up(k, _sublane); this toy geometry predates that rule and
+    # failed its first real on-chip run, 2026-08-02). k=4 on the leading
+    # axis is legal and stays, so the check still covers k != km.
+    k, km = 4, 8
     R, M = 8, 8
     m_pad = _round_up(m, R)
     mid_pad = _round_up(mid, M)
@@ -332,8 +367,8 @@ def bench_3d_rolled(configs, n3=512, steps=240, variant="f32"):
                   f"raw {pts_raw / roof * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
-            print(f"rolled {variant} R={R:4d} M={M:4d} k={k} km={km}: FAILED "
-                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+            print(f"rolled {variant} R={R:4d} M={M:4d} k={k} km={km}: "
+                  f"{_failure_tag(e)}", flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -456,9 +491,16 @@ def check_thin2d_variants():
         n_pad = _round_up(n, 128)
         Tp = jnp.pad(jnp.asarray(T), ((0, m_pad - m), (0, n_pad - n)))
         for ks in (1, 6):
-            out = pallas_thin2d_variant(Tp, r=0.2, ksteps=ks, tile=tile,
-                                        kpad=kpad, variant=variant,
-                                        logical=(m, n))[:m, :n]
+            try:
+                out = pallas_thin2d_variant(Tp, r=0.2, ksteps=ks, tile=tile,
+                                            kpad=kpad, variant=variant,
+                                            logical=(m, n))[:m, :n]
+            except Exception as e:
+                if _expected_unsupported(e):
+                    print(f"thin2d {variant}: EXPECTED-UNSUPPORTED on this "
+                          f"backend (Mosaic dynamic_rotate is 32-bit-only)")
+                    break
+                raise
             ref = ref_steps(jnp.asarray(T), 0.2, ks)
             err = float(jnp.abs(out.astype(jnp.float32)
                                 - ref.astype(jnp.float32)).max())
@@ -505,8 +547,8 @@ def bench_thin2d_variants(n2, dtype, configs, steps=64):
                   f"{pts_raw / roof * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
-            print(f"{variant:10s} tile={tile:4d} kpad={kpad}: FAILED "
-                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+            print(f"{variant:10s} tile={tile:4d} kpad={kpad}: "
+                  f"{_failure_tag(e)}", flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -735,9 +777,17 @@ def check_2d_coltiled_rolled():
         n_pad = _round_up(n, C)
         Tp = jnp.pad(jnp.asarray(T), ((0, m_pad - m), (0, n_pad - n)))
         for ks in (1, 5, 16):
-            out = pallas_2d_coltiled_rolled(
-                Tp, r=r, ksteps=ks, R=R, C=C, kr=kr, kc=kc,
-                logical=(m, n), variant=variant)[:m, :n]
+            try:
+                out = pallas_2d_coltiled_rolled(
+                    Tp, r=r, ksteps=ks, R=R, C=C, kr=kr, kc=kc,
+                    logical=(m, n), variant=variant)[:m, :n]
+            except Exception as e:
+                if _expected_unsupported(e):
+                    print(f"2d coltiled-rolled {np.dtype(dt).name} "
+                          f"{variant}: EXPECTED-UNSUPPORTED on this "
+                          f"backend (Mosaic dynamic_rotate is 32-bit-only)")
+                    break
+                raise
             ref = ref_steps(jnp.asarray(T), r, ks)
             err = float(jnp.abs(out.astype(jnp.float32)
                                 - ref.astype(jnp.float32)).max())
@@ -787,7 +837,7 @@ def bench_2d_rolled(configs, n2=32768, dtype="bfloat16", steps=96,
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
             print(f"rolled {variant} R={R:4d} C={C:6d} kr={kr} kc={kc}: "
-                  f"FAILED {type(e).__name__}: {str(e)[:200]}", flush=True)
+                  f"{_failure_tag(e)}", flush=True)
 
 
 def check_2d_coltiled():
@@ -849,8 +899,8 @@ def bench_2d(configs, n2=32768, dtype="bfloat16", steps=96):
                   f"{pts_raw / roof * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
-            print(f"R={R:4d} C={C:6d} kr={kr} kc={kc}: FAILED "
-                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+            print(f"R={R:4d} C={C:6d} kr={kr} kc={kc}: {_failure_tag(e)}",
+                  flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -907,8 +957,7 @@ def bench_framework(cases):
                   f"{pts_raw:.3e} = {pts_raw / roof * 100:.0f}%) [compile "
                   f"{compile_s:.0f}s]", flush=True)
         except Exception as e:
-            print(f"{label:28s} plan={plan}: FAILED {type(e).__name__}: "
-                  f"{str(e)[:200]}", flush=True)
+            print(f"{label:28s} plan={plan}: {_failure_tag(e)}", flush=True)
 
 
 FRAMEWORK_CASES = {
@@ -992,8 +1041,8 @@ def bench_3d(configs):
                   f"raw {pts_raw / roof * 100:.0f}%)"
                   f"  [compile {compile_s:.0f}s]", flush=True)
         except Exception as e:
-            print(f"R={R:4d} M={M:4d} k={k} km={km}: FAILED "
-                  f"{type(e).__name__}: {str(e)[:200]}", flush=True)
+            print(f"R={R:4d} M={M:4d} k={k} km={km}: {_failure_tag(e)}",
+                  flush=True)
 
 
 if __name__ == "__main__":
