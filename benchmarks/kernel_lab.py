@@ -516,6 +516,11 @@ def bench_thin2d_variants(n2, dtype, configs, steps=64):
     made = {}
     for variant, tile, kpad in configs:
         k = kpad
+        if steps < k:  # a zero-iteration fori_loop would "measure" an
+            print(f"{variant:10s} tile={tile:4d} kpad={kpad}: SKIPPED "
+                  f"(steps {steps} < kpad {kpad} -> zero passes)",
+                  flush=True)  # empty program as 0 pts/s — fail loudly
+            continue
         m_pad = _round_up(n2, tile)
         n_pad = _round_up(n2, 128)
         shape = (m_pad, n_pad)
@@ -1109,12 +1114,32 @@ if __name__ == "__main__":
     elif exp == "checkthin":
         check_thin2d_variants()
     elif exp == "benchthin":
-        # args: n dtype then variant,tile,kpad triples
-        n2 = int(sys.argv[2])
-        dtype = sys.argv[3]
+        # args: n dtype then variant,tile,kpad triples; optional --steps N.
+        # The 64-step default is sized for the flagship 32768^2 extent —
+        # at 4096^2 it is ~6 ms of device work against the tunnel's
+        # ~150 ms dispatch floor and measures the floor, not the kernel
+        # (observed 2026-08-02: the SHIPPED tile read 8% of roofline).
+        # Small-extent A/Bs must raise it (e.g. --steps 2048 ~ 0.2 s).
+        argv = sys.argv[2:]
+        steps = 64
+        usage = ("usage: kernel_lab.py benchthin N {float32|bfloat16} "
+                 "[variant,tile,kpad ...] [--steps N]")
+        if "--steps" in argv:
+            i = argv.index("--steps")
+            try:
+                steps = int(argv[i + 1])
+            except (IndexError, ValueError):
+                sys.exit(usage)
+            if steps <= 0:
+                sys.exit(usage)
+            argv = argv[:i] + argv[i + 2:]
+        if len(argv) < 2:
+            sys.exit(usage)
+        n2 = int(argv[0])
+        dtype = argv[1]
         cfgs = [(a.split(",")[0], int(a.split(",")[1]), int(a.split(",")[2]))
-                for a in sys.argv[4:]]
-        bench_thin2d_variants(n2, dtype, cfgs)
+                for a in argv[2:]]
+        bench_thin2d_variants(n2, dtype, cfgs, steps=steps)
     elif exp == "framework":
         keys = sys.argv[2:] or list(FRAMEWORK_CASES)
         bench_framework([FRAMEWORK_CASES[k] for k in keys])

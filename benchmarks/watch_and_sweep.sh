@@ -80,7 +80,7 @@ phase sharded3d_check      1800 python benchmarks/sharded3d_check.py
 phase check2d_rolled       1800 python benchmarks/kernel_lab.py check2d_rolled
 phase checkthin            1800 python benchmarks/kernel_lab.py checkthin
 phase check3d_rolled       1800 python benchmarks/kernel_lab.py check3d_rolled
-phase thin_fma_ab          2400 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolledfma,256,16
+phase thin_fma_ab          2400 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolledfma,256,16 --steps 2048
 phase 3d_f32_ab            2400 python benchmarks/kernel_lab.py bench3d_rolled_var f32 64,64,8,8
 phase 3d_fma_ab            2400 python benchmarks/kernel_lab.py bench3d_rolled_var fma 64,64,8,8
 phase chip_check           2400 python benchmarks/chip_check.py
