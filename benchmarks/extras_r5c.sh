@@ -24,11 +24,21 @@ export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${XDG_CACHE_HOME:
 export PYTHONPATH="$(cd "$(dirname "$0")/.." && pwd):${PYTHONPATH:-}"
 cd "$(dirname "$0")/.."
 
-while pgrep -f "extras_r5b.sh" > /dev/null 2>&1; do
+HARD_END=${HARD_END:-1785722400}  # 2026-08-03 02:00 UTC
+
+# Wait for the r5b queue, BOUNDED by HARD_END, and match the actual runner
+# invocation only ("bash .*extras_r5b.sh") — a bare -f "extras_r5b.sh"
+# matches any command line containing the string (an editor, `less`, a
+# stale orphan) and this loop used to run before any deadline existed, so
+# the queue could block forever (ADVICE r5).
+while pgrep -f "bash .*extras_r5b\.sh" > /dev/null 2>&1; do
+  if [ "$(date +%s)" -ge "$HARD_END" ]; then
+    echo "=== extras_r5c gave up waiting for extras_r5b at $(date)"
+    exit 1
+  fi
   sleep 60
 done
 
-HARD_END=${HARD_END:-1785722400}  # 2026-08-03 02:00 UTC
 DEADLINE=$(( $(date +%s) + ${BUDGET_S:-30000} ))
 [ "$DEADLINE" -gt "$HARD_END" ] && DEADLINE=$HARD_END
 
@@ -64,10 +74,15 @@ phase() {
 }
 
 phase run_all_refresh  7200 python benchmarks/run_all.py --row-timeout 2500
-phase thin_band_ab     3600 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolled,512,16 rolled,768,16 rolled,384,16 rolled,512,8
+# --steps 2048: benchthin's 64-step default is sized for 32768^2; at
+# 4096^2 it is ~6 ms of device work against the ~150 ms tunnel dispatch
+# floor and measures the floor, not the band size (the committed
+# sweep_r5c.log rows read 6-8% of roofline for exactly this reason —
+# see the annotation there; ADVICE r5).
+phase thin_band_ab     3600 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolled,512,16 rolled,768,16 rolled,384,16 rolled,512,8 --steps 2048
 phase bf16n_4096_probe 1200 python benchmarks/kernel_lab.py bench2d_rolled_var bf16native 256,4096,16,128 --n2 4096
 phase 3d_geom_ab       3600 python benchmarks/kernel_lab.py bench3d_rolled_var f32 64,64,8,8 128,64,8,8 64,128,8,8 96,96,8,8
 phase 3d_fma_ab        1800 python benchmarks/kernel_lab.py bench3d_rolled_var fma 64,64,8,8
-phase thin_fma_ab      1800 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolledfma,256,16
+phase thin_fma_ab      1800 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolledfma,256,16 --steps 2048
 phase compile_bisect32 2000 python benchmarks/compile_bisect.py --ks 32 --timeout 1800
 echo "=== extras_r5c done at $(date)"

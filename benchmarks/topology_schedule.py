@@ -52,7 +52,7 @@ def schedule_census(txt: str) -> dict:
     # fusion lines that merely take a start/done as an operand must not
     # count as windows
     start_def = re.compile(r"\s*(\S+?)\s*=.*\scollective-permute-start\(")
-    done_def = re.compile(r"\s*\S+\s*=.*\scollective-permute-done\(([^)]*)\)")
+    done_def = re.compile(r"\s*\S+\s*=.*\scollective-permute-done\((.*)")
     start_idx = {}
     for i, ln in enumerate(lines):
         m = start_def.match(ln)
@@ -64,7 +64,17 @@ def schedule_census(txt: str) -> dict:
         m = done_def.match(ln)
         if not m:
             continue
-        ops = [o.strip().lstrip("%") for o in m.group(1).split(",")]
+        # Printer-robust operand parse (the custom_call_census lesson: a
+        # regex tuned to one HLO printer silently records zeros on the
+        # next). Newer printers annotate the operand with its full
+        # tuple type — "done((f32[4,40]{1,0:T(4,128)S(1)}, ...)
+        # %collective-permute-start.1)" — so a [^)]* capture eats layout
+        # tokens, never the name. SSA names are the only %-prefixed
+        # tokens on the line; older printers spell operands bare, so
+        # fall back to the comma-split form when no %-token appears.
+        ops = [o.lstrip("%") for o in re.findall(r"%[\w.\-#]+", m.group(1))]
+        if not ops:
+            ops = [o.strip() for o in m.group(1).rstrip(")").split(",")]
         s = next((start_idx[o] for o in ops if o in start_idx), None)
         if s is None:
             unmatched += 1

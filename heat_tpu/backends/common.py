@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import HeatConfig
-from ..runtime import checkpoint, debug
+from ..runtime import async_io, checkpoint, debug
 from ..runtime.logging import master_print
 from ..runtime.timing import Timing, sync, two_point_rate
 from . import SolveResult
@@ -85,6 +85,15 @@ def drive(
 ) -> SolveResult:
     """Run ``advance(T, k)`` (jitted, static k, donated T) to ``cfg.ntime``.
 
+    Host-visible events (checkpoints, numerics flags) run through the
+    asynchronous I/O pipeline by default (``cfg.async_io``,
+    runtime/async_io.py): a checkpoint boundary costs one on-device buffer
+    copy and stepping resumes immediately, with the D2H transfer and
+    atomic-rename write in a bounded-queue background writer —
+    backpressure (queue depth 2), drain on every exit path (no snapshot
+    silently dropped), writer errors surfaced at the next boundary.
+    ``--async-io off`` restores the inline sync->fetch->save stall.
+
     ``two_point_repeats > 0`` additionally measures the overhead-corrected
     two-point rate (``timing.two_point_rate`` — the headline benchmark's
     protocol) on a COPY of the final state, so the solve result is
@@ -126,25 +135,83 @@ def drive(
 
     t0 = time.perf_counter()
     step = start_step
-    with debug.maybe_profile(cfg.profile_dir):
-        while step < cfg.ntime:
-            k = min(chunk, cfg.ntime - step)
-            fn = compiled.get(k)
-            T_dev = fn(T_dev) if fn is not None else advance(T_dev, k)
-            step += k
-            if cfg.check_numerics:
-                debug.check_finite(T_dev, step)
-            if cfg.heartbeat_every and step % cfg.heartbeat_every == 0:
-                master_print(" time_it:", step)  # fortran/serial/heat.f90:62
-            if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
-                sync(T_dev)
-                T_ck = to_host(T_dev)
-                if T_ck is not None:
-                    checkpoint.save(cfg, T_ck, step)
-                else:  # multi-host: each process persists its own shards
-                    checkpoint.save_shards(cfg, T_dev, step)
-        sync(T_dev)
+    # Async I/O pipeline (the default): checkpoint boundaries cost one
+    # on-device buffer copy — the D2H fetch and atomic disk write happen in
+    # a bounded-queue background writer while the device keeps stepping —
+    # and check_numerics becomes a device-side flag posted at each boundary
+    # and fetched at the NEXT one (by which point it computed behind the
+    # following chunk). --async-io off restores the reference-shaped
+    # sync(T_dev) -> fetch -> save stall below, unchanged.
+    async_on = cfg.use_async_io() and bool(cfg.checkpoint_every
+                                           or cfg.check_numerics)
+    writer = (async_io.SnapshotWriter()
+              if async_on and cfg.checkpoint_every else None)
+    pending_flag = None  # (device scalar, step) from the previous boundary
+
+    def _submit_snapshot(T_snap, at_step: int) -> None:
+        check = cfg.check_numerics
+
+        def job():
+            T_ck = to_host(T_snap)  # D2H lands HERE, in the writer thread
+            if check:
+                # sync mode checks the chunk before saving its boundary;
+                # async detects one boundary late (pending_flag), so the
+                # writer re-validates the snapshot it is about to persist —
+                # a non-finite field never reaches disk on either path
+                debug.check_finite(T_ck if T_ck is not None else T_snap,
+                                   at_step, label="checkpoint snapshot")
+            if T_ck is not None:
+                checkpoint.save(cfg, T_ck, at_step)
+            else:  # multi-host: each process persists its own shards
+                checkpoint.save_shards(cfg, T_snap, at_step)
+
+        writer.submit(job)
+
+    try:
+        with debug.maybe_profile(cfg.profile_dir):
+            while step < cfg.ntime:
+                k = min(chunk, cfg.ntime - step)
+                fn = compiled.get(k)
+                T_dev = fn(T_dev) if fn is not None else advance(T_dev, k)
+                step += k
+                if cfg.check_numerics:
+                    if async_on:
+                        if pending_flag is not None:
+                            debug.raise_if_flagged(*pending_flag)
+                        pending_flag = (debug.finite_flag(T_dev), step)
+                    else:
+                        debug.check_finite(T_dev, step)
+                if cfg.heartbeat_every and step % cfg.heartbeat_every == 0:
+                    master_print(" time_it:", step)  # fortran/serial/heat.f90:62
+                if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
+                    if writer is not None:
+                        _submit_snapshot(async_io.device_snapshot(T_dev),
+                                         step)
+                    else:
+                        sync(T_dev)
+                        T_ck = to_host(T_dev)
+                        if T_ck is not None:
+                            checkpoint.save(cfg, T_ck, step)
+                        else:
+                            checkpoint.save_shards(cfg, T_dev, step)
+            if pending_flag is not None:
+                debug.raise_if_flagged(*pending_flag)
+                pending_flag = None
+            sync(T_dev)
+    except BaseException:
+        # drain-on-exception: every queued snapshot still lands on disk (a
+        # blow-up's last good boundary is exactly the state a resume
+        # needs); a writer error is logged but never masks the solve error
+        if writer is not None:
+            writer.drain(raise_errors=False)
+        raise
     solve_s = time.perf_counter() - t0
+    if writer is not None:
+        # post-solve flush, deliberately OUTSIDE solve_s: the device has
+        # finished stepping, so the remaining writes overlap nothing —
+        # they land in io_wait_s and the wall total. Backpressure waits
+        # inside the loop above DO sit in solve_s (they stall stepping).
+        writer.drain()
 
     tp_rate = tp_fell_back = None
     if two_point_repeats and remaining > 0:
@@ -187,7 +254,9 @@ def drive(
                     compile_s=compile_s,
                     solve_s=solve_s, steps=remaining, points=cfg.points,
                     points_per_s_two_point=tp_rate,
-                    two_point_fell_back=tp_fell_back)
+                    two_point_fell_back=tp_fell_back,
+                    overlap_s=writer.hidden_s if writer is not None else None,
+                    io_wait_s=writer.wait_s if writer is not None else None)
     return SolveResult(cfg=cfg, T=T_host, timing=timing, gsum=gsum,
                        gsum_dtype=gsum_dtype,
                        start_step=start_step, T_dev=T_dev)

@@ -46,9 +46,29 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 try:  # JAX >= 0.4.35 exposes shard_map at top level
-    from jax import shard_map  # type: ignore[attr-defined]
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+# The replication-check kwarg was renamed across JAX releases (check_rep ->
+# check_vma). Resolve the spelling THIS jax accepts once, so the call sites
+# below stay on the current name and older installs (0.4.x: the CPU test
+# matrix) don't lose the whole sharded backend to a TypeError.
+import inspect as _inspect
+
+try:
+    _shmap_params = _inspect.signature(_shard_map).parameters
+except (TypeError, ValueError):  # pragma: no cover — opaque callable
+    _shmap_params = {"check_vma": None}
+if "check_vma" in _shmap_params:
+    shard_map = _shard_map
+else:
+    _legacy_kw = "check_rep" if "check_rep" in _shmap_params else None
+
+    def shard_map(*args, check_vma=None, **kw):
+        if check_vma is not None and _legacy_kw is not None:
+            kw[_legacy_kw] = check_vma
+        return _shard_map(*args, **kw)
 
 from ..config import HeatConfig
 from ..ops.pallas_stencil import (_KMAX_2D, _NO_FREEZE,
@@ -1147,13 +1167,28 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
     if len(flat) > 32:
         master_print(f"  ... ({len(flat) - 32} more shards)")
 
+    if cfg.checkpoint_every:
+        # announce the I/O contract up front, like the mesh decomposition:
+        # on a multi-host job the async writer persists each process's own
+        # shards (checkpoint.save_shards) from a device-side snapshot while
+        # stepping continues — same snapshot-and-continue contract as the
+        # single-host global dump
+        master_print("checkpoint I/O: "
+                     + ("async snapshot-and-continue (bounded queue depth "
+                        "2; --async-io off for the sync fallback)"
+                        if cfg.use_async_io() else "sync (--async-io off)"))
     if cfg.parity_order:
         res = _solve_parity(cfg, T0, mesh, fetch, warm_exec)
     elif not cfg.checkpoint_every and not cfg.check_numerics and cfg.ntime:
         # default fast path: padded-carry state (no per-exchange pad+crop
-        # copies). Checkpoint/numerics runs keep the owned-state path —
-        # their mid-run host visits (snapshot dumps, finite checks) need
-        # the owned field, which padded state only yields via a crop.
+        # copies). Checkpoint/numerics runs keep the owned-state path:
+        # their boundary events need the OWNED field (a padded-state
+        # snapshot would persist garbage ghost margins), which padded
+        # state only yields via a crop. The events themselves no longer
+        # stall that path — drive's async pipeline snapshots on device
+        # and resumes stepping (runtime/async_io.py) — so what the owned
+        # path still pays vs this one is only the per-exchange pad+crop
+        # copies, not the D2H+disk wall time.
         res = _solve_padded_carry(cfg, T0, mesh, fetch, warm_exec,
                                   two_point_repeats)
     else:

@@ -84,6 +84,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "commented-out MPI_Reduce, made real)")
     run.add_argument("--checkpoint-every", type=int)
     run.add_argument("--checkpoint-dir")
+    run.add_argument("--async-io", dest="async_io",
+                     choices=["on", "off", "auto"],
+                     help="checkpoint/numerics I/O pipeline: on = "
+                          "snapshot-and-continue (device-side copy at the "
+                          "boundary; D2H + disk write in a background "
+                          "writer, bounded queue), off = sync fallback "
+                          "(device idles through fetch + write), auto "
+                          "(default) = on")
     run.add_argument("--profile", dest="profile_dir", metavar="DIR",
                      help="write a jax.profiler trace of the solve to DIR")
     run.add_argument("--check-numerics", action="store_true",
@@ -170,8 +178,8 @@ def _apply_overrides(cfg: HeatConfig, args) -> HeatConfig:
     over = {}
     for field in ("backend", "dtype", "ic", "bc", "ndim", "comm", "exchange",
                   "fuse_steps", "local_kernel", "heartbeat_every",
-                  "checkpoint_every", "checkpoint_dir", "profile_dir",
-                  "write_int"):
+                  "checkpoint_every", "checkpoint_dir", "async_io",
+                  "profile_dir", "write_int"):
         v = getattr(args, field, None)
         if v is not None:
             over[field] = v
@@ -286,6 +294,11 @@ def cmd_run(args) -> int:
             "gsum": res.gsum,
             "gsum_dtype": res.gsum_dtype,
         }
+        if res.timing.overlap_s is not None:
+            # async pipeline ran: how much I/O wall time compute hid, and
+            # what the driver still paid (backpressure + final drain)
+            rec["overlap_s"] = res.timing.overlap_s
+            rec["io_wait_s"] = res.timing.io_wait_s
         if res.guard is not None:
             # the row must say when it measured the DEGRADED program (and
             # what the probe cost / what became of the orphan compile)

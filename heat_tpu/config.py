@@ -26,6 +26,7 @@ _BACKENDS = ("serial", "xla", "pallas", "sharded")
 _BCS = ("edges", "ghost", "periodic")
 _ICS = ("hat", "hat_half", "hat_small", "uniform", "zero")
 _COMMS = ("direct", "staged")
+_ASYNC_IO = ("on", "off", "auto")
 _EXCHANGES = ("seq", "indep", "overlap")
 _LOCAL_KERNELS = ("auto", "xla", "pallas")
 
@@ -81,6 +82,15 @@ class HeatConfig:
                                 # commented-out MPI_Reduce, mpi+cuda/heat.F90:266-273)
     checkpoint_every: int = 0   # periodic snapshot interval (0 = off)
     checkpoint_dir: str = "checkpoints"
+    async_io: str = "auto"      # checkpoint/numerics I/O pipeline: "on" =
+                                # snapshot-and-continue (one device-side
+                                # buffer copy at the boundary; D2H + disk
+                                # write in a background thread, bounded
+                                # queue), "off" = the reference-shaped
+                                # sync path (device idles through fetch +
+                                # write), "auto" = on (the hook for a
+                                # future platform heuristic; see
+                                # use_async_io)
     profile_dir: Optional[str] = None  # jax.profiler trace output dir
     check_numerics: bool = False  # per-chunk NaN/Inf detection (debug mode)
     fuse_steps: int = 0         # pallas temporal blocking: FTCS steps fused
@@ -119,6 +129,9 @@ class HeatConfig:
             raise ValueError(f"sigma out of range: {self.sigma}")
         if self.fuse_steps < 0:
             raise ValueError(f"fuse_steps must be >= 0, got {self.fuse_steps}")
+        if self.async_io not in _ASYNC_IO:
+            raise ValueError(
+                f"async_io must be one of {_ASYNC_IO}, got {self.async_io!r}")
 
     # --- derived quantities (fortran/serial/heat.f90:15-17,59) -------------
     @property
@@ -148,6 +161,21 @@ class HeatConfig:
     @property
     def points(self) -> int:
         return self.n**self.ndim
+
+    def use_async_io(self) -> bool:
+        """Resolve the ``async_io`` knob to a verdict for this run.
+
+        "auto" resolves to ON: the on-loop cost of the async pipeline is
+        one device-side buffer copy per boundary (microseconds at HBM
+        bandwidth) against the seconds-scale D2H+write it takes off the
+        critical path, so there is no measured regime where sync wins.
+        The tri-state exists so a platform heuristic can demote auto later
+        without repurposing the explicit values: "off" stays the
+        bit-faithful reference-shaped fallback (and the A/B baseline for
+        benchmarks/ckpt_overlap.py), "on" stays a user promise. The serial
+        backend ignores the knob (host-resident field — there is no D2H to
+        hide)."""
+        return self.async_io != "off"
 
     def with_(self, **kw) -> "HeatConfig":
         return dataclasses.replace(self, **kw)

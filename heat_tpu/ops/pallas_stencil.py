@@ -54,6 +54,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):  # pre-rename JAX (<= 0.4.x) spells
+    pltpu.CompilerParams = pltpu.TPUCompilerParams  # it TPUCompilerParams
+
 from .. import machine
 from .stencil import (accum_dtype_for, ftcs_step_edges, ftcs_step_ghost,
                       ftcs_step_periodic)

@@ -1,0 +1,151 @@
+"""Asynchronous checkpoint/telemetry pipeline: D2H + disk off the hot loop.
+
+The reference stops the device for every host-visible event: its solution
+dumps sit inline in the timed region (fortran/serial/heat.f90:77-83), and
+our drive loop inherited that shape — ``sync(T_dev)`` -> full D2H fetch ->
+synchronous ``checkpoint.save`` at every checkpoint boundary, seconds of
+idle device per snapshot for GiB-scale fields on a tunneled link.
+
+This module is the off-critical-path half of the rework
+(``backends.common.drive`` is the on-loop half): at a boundary the driver
+takes ONE on-device buffer copy (donation-safe — the live field is donated
+into the next chunk while the copy stays pinned for the writer) and resumes
+stepping immediately; a background thread performs the device->host
+transfer (``np.asarray`` on the snapshot blocks only the writer) and the
+atomic-rename disk write.
+
+Contract:
+
+- **Bounded queue** (default depth 2): a slow sink applies BACKPRESSURE —
+  ``submit`` blocks the driver when the queue is full — rather than
+  accumulating unbounded device snapshots (each is a full field buffer;
+  two in flight is the memory ceiling).
+- **No snapshot is ever silently dropped**: ``drain`` flushes every queued
+  snapshot before returning, and the driver calls it on BOTH the normal and
+  the exception exit path (``drive``'s try/except).
+- **Writer failures surface, promptly**: the first sink exception is
+  re-raised on the next ``submit`` (the solve must not step for hours
+  against a dead disk) and again at ``drain``; queued snapshots after a
+  failed one are still attempted (independent files).
+- **Accounting**: ``busy_s`` (writer wall time in fetch+write), ``wait_s``
+  (driver wall time blocked on the pipeline: backpressure + drain), and
+  ``hidden_s = max(0, busy_s - wait_s)`` — the I/O wall time genuinely
+  overlapped with compute, reported as ``Timing.overlap_s``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+from .logging import master_print
+
+# Default queue depth: each entry pins one full-field device buffer, so the
+# depth is a device-memory bound, not a tuning knob — 2 keeps one snapshot
+# transferring while one more waits, which is all the pipelining a single
+# writer thread can use.
+DEFAULT_DEPTH = 2
+
+
+class SnapshotWriter:
+    """Background writer for device snapshots with a bounded queue.
+
+    ``submit(job)`` enqueues a zero-arg callable (closing over the device
+    snapshot) and returns as soon as there is queue room; the worker thread
+    runs jobs in FIFO order. Start is lazy (a solve with no checkpoint
+    boundary never spawns a thread); the thread is a daemon so a crashed
+    driver that never drains cannot hang interpreter exit.
+    """
+
+    def __init__(self, depth: int = DEFAULT_DEPTH):
+        self._q: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue(
+            maxsize=max(1, depth))
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self.busy_s = 0.0     # writer wall time spent in D2H + disk write
+        self.wait_s = 0.0     # driver wall time blocked on the pipeline
+        self.submitted = 0
+        self.completed = 0    # jobs RUN (successfully or not) — drained
+
+    @property
+    def hidden_s(self) -> float:
+        """I/O wall time hidden behind compute (``Timing.overlap_s``)."""
+        return max(0.0, self.busy_s - self.wait_s)
+
+    def _worker(self) -> None:
+        while True:
+            job = self._q.get()
+            try:
+                if job is None:  # drain sentinel
+                    return
+                t0 = time.perf_counter()
+                try:
+                    job()
+                except BaseException as e:  # noqa: BLE001 — surfaced at the
+                    # next submit/drain; later snapshots still attempted
+                    if self._exc is None:
+                        self._exc = e
+                finally:
+                    self.busy_s += time.perf_counter() - t0
+                    self.completed += 1
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def submit(self, job: Callable[[], None]) -> None:
+        """Enqueue a snapshot job; blocks when the queue is full
+        (backpressure — bounded memory beats a snapshot pileup). Re-raises
+        the first pending writer error instead of queueing behind it."""
+        self._raise_pending()
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._worker, daemon=True, name="heat-snapshot-writer")
+            self._thread.start()
+        t0 = time.perf_counter()
+        self._q.put(job)
+        self.wait_s += time.perf_counter() - t0
+        self.submitted += 1
+
+    def drain(self, raise_errors: bool = True) -> None:
+        """Flush every queued snapshot and stop the worker.
+
+        ``raise_errors=False`` is the exception-exit form: snapshots still
+        flush (nothing dropped) but a writer error is only logged — it must
+        not mask the solve error already propagating."""
+        t0 = time.perf_counter()
+        if self._thread is not None:
+            self._q.put(None)          # after all queued jobs: FIFO drain
+            self._thread.join()
+            self._thread = None
+        self.wait_s += time.perf_counter() - t0
+        if raise_errors:
+            self._raise_pending()
+        elif self._exc is not None:
+            master_print(f"async checkpoint writer error (suppressed while "
+                         f"another error propagates): "
+                         f"{type(self._exc).__name__}: {self._exc}")
+
+
+def device_snapshot(T):
+    """One on-device buffer copy of the live field.
+
+    This is the whole on-loop cost of an async checkpoint: the copy is a
+    device-side memcpy (HBM bandwidth, microseconds-to-milliseconds) that
+    detaches the snapshot from the donation chain — the live buffer is
+    donated into the next ``advance`` call while the copy stays pinned
+    until the writer's ``np.asarray`` fetches and releases it. Works on
+    sharded global arrays too (jitted identity, SPMD-uniform: every
+    process copies its own shards)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if isinstance(T, jax.Array):
+        return jnp.copy(T)
+    return np.array(T)

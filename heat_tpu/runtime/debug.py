@@ -27,13 +27,18 @@ def maybe_profile(trace_dir: Optional[str]):
         yield
 
 
-def check_finite(T, step: int, label: str = "field") -> None:
-    """Raise with step context if the field has NaN/Inf (device or host array).
+def finite_flag(T):
+    """All-finite reduction WITHOUT blocking the host on it.
 
-    Device arrays reduce ON DEVICE (``jnp.isfinite(...).all()``): in a
-    multi-host job the global field spans other processes and
-    ``np.asarray`` on it raises RuntimeError — the reduction's replicated
-    scalar is always fetchable, and a scalar fetch is tunnel-cheap.
+    Device arrays reduce ON DEVICE (``jnp.isfinite(...).all()``) and the
+    replicated scalar is returned still-on-device: the caller holds it and
+    fetches at the NEXT chunk boundary (``raise_if_flagged``), by which
+    point the device has computed it behind the following chunk's work —
+    the numerics leg of the async I/O pipeline. The on-device reduction
+    also keeps the multi-host contract: the global field can span other
+    processes, where ``np.asarray`` on it raises RuntimeError — the
+    reduction's replicated scalar is always fetchable, and a scalar fetch
+    is tunnel-cheap. Host arrays reduce eagerly (nothing to overlap).
     """
     import numpy as np
 
@@ -41,11 +46,23 @@ def check_finite(T, step: int, label: str = "field") -> None:
     import jax.numpy as jnp
 
     if isinstance(T, jax.Array) and not isinstance(T, jax.core.Tracer):
-        ok = bool(jnp.isfinite(T).all())
-    else:
-        ok = bool(np.isfinite(np.asarray(T).astype(np.float32)).all())
-    if not ok:
+        return jnp.isfinite(T).all()
+    return np.isfinite(np.asarray(T).astype(np.float32)).all()
+
+
+def raise_if_flagged(flag, step: int, label: str = "field") -> None:
+    """Fetch a ``finite_flag`` result (one scalar) and raise with the step
+    context the flag was computed at."""
+    if not bool(flag):
         raise FloatingPointError(
             f"non-finite values in {label} at step {step} — check the CFL "
             f"bound sigma <= 1/(2*ndim) and the fuse/halo configuration"
         )
+
+
+def check_finite(T, step: int, label: str = "field") -> None:
+    """Synchronous form: compute the flag and block on it immediately
+    (the ``--async-io off`` drive path and every per-step host caller;
+    ``--async-io on`` splits this into ``finite_flag`` at the boundary +
+    ``raise_if_flagged`` one boundary later)."""
+    raise_if_flagged(finite_flag(T), step, label)

@@ -116,6 +116,16 @@ class Timing:
     # the protocol didn't run. Consumers fitting models from the rate
     # (calibrate) must refuse fallen-back values (review r5).
     two_point_fell_back: bool | None = None
+    # Async I/O pipeline accounting (None when no async writer ran).
+    # overlap_s: checkpoint D2H+disk wall time hidden behind compute (the
+    # writer's busy time minus any time the stepping loop spent blocked on
+    # it) — under the old inline-save shape this whole quantity sat in
+    # solve_s as device idle. io_wait_s: what the driver DID pay — queue
+    # backpressure inside the loop (lands in solve_s: it stalls stepping)
+    # plus the post-solve drain (lands in total_s only: the device is done
+    # stepping; the remaining flush overlaps nothing).
+    overlap_s: float | None = None
+    io_wait_s: float | None = None
 
     @property
     def per_step_s(self) -> float:
@@ -136,4 +146,7 @@ class Timing:
         ]
         if self.compile_s:
             lines.insert(2, f"compile time: {self.compile_s:.6f}")
+        if self.overlap_s is not None:
+            lines.append(f"async I/O overlap: {self.overlap_s:.6f} hidden, "
+                         f"{self.io_wait_s or 0.0:.6f} blocked")
         return lines

@@ -21,8 +21,12 @@ def test_check_finite_raises_with_step_context():
         check_finite(bad, step=7)
 
 
+@pytest.mark.filterwarnings("ignore:overflow encountered:RuntimeWarning")
 def test_unstable_sigma_detected_by_check_numerics():
-    """sigma far above the FTCS bound blows up; debug mode names the step."""
+    """sigma far above the FTCS bound blows up; debug mode names the step.
+    (The numpy overflow RuntimeWarning on the serial path is the blow-up
+    MECHANISM under test, not noise worth a dirty ``pytest -q`` —
+    VERDICT r5 #8 hygiene.)"""
     cfg = HeatConfig(n=32, ntime=200, sigma=2.0, dtype="float32",
                      backend="xla", check_numerics=True, heartbeat_every=10)
     with pytest.raises(FloatingPointError):
@@ -141,3 +145,155 @@ def test_two_point_repeats_sharded_padded_carry():
     assert res.timing.points_per_s_two_point > 0
     ref = solve(cfg.with_(backend="serial", mesh_shape=None))
     np.testing.assert_array_equal(res.T, ref.T)
+
+
+# --- async checkpoint/telemetry pipeline (ISSUE 1) -------------------------
+
+
+def test_snapshot_writer_backpressure_is_bounded():
+    """A slow sink must apply backpressure (submit blocks on a full queue)
+    rather than queue snapshots unboundedly — each entry pins a full-field
+    device buffer."""
+    import threading
+    import time as _time
+
+    from heat_tpu.runtime.async_io import SnapshotWriter
+
+    w = SnapshotWriter(depth=1)
+    in_flight = 0
+    max_in_flight = 0
+    lock = threading.Lock()
+
+    def job():
+        nonlocal in_flight, max_in_flight
+        with lock:
+            in_flight += 1
+            max_in_flight = max(max_in_flight, in_flight)
+        _time.sleep(0.05)
+        with lock:
+            in_flight -= 1
+
+    t0 = _time.perf_counter()
+    for _ in range(4):
+        w.submit(job)
+    w.drain()
+    wall = _time.perf_counter() - t0
+    assert w.completed == 4            # nothing dropped
+    assert max_in_flight == 1          # one writer thread, FIFO
+    assert w.wait_s > 0.05             # submits genuinely blocked
+    assert wall >= 4 * 0.05            # the sink really ran serially
+
+
+def test_snapshot_writer_error_surfaces_and_later_jobs_still_run():
+    import threading
+
+    from heat_tpu.runtime.async_io import SnapshotWriter
+
+    ran = []
+    gate = threading.Event()
+
+    def bad():
+        raise OSError("disk gone")
+
+    w = SnapshotWriter(depth=2)
+    w.submit(lambda: gate.wait(5))    # hold the worker so the error can't
+    w.submit(bad)                     # surface before everything is queued
+    w.submit(lambda: ran.append(1))
+    gate.set()
+    with pytest.raises(OSError, match="disk gone"):
+        w.drain()
+    assert ran == [1]                 # queued-after-failure still attempted
+    # the suppressed form must flush too, without raising
+    w2 = SnapshotWriter()
+    w2.submit(bad)
+    w2.drain(raise_errors=False)
+
+
+def test_async_checkpoints_bit_identical_to_sync(tmp_path):
+    """The pipeline must change WHEN the write happens, never WHAT is
+    written: same files, same bytes-level arrays, and a resume from an
+    async-written checkpoint matches the sync path bit-for-bit."""
+    from heat_tpu.runtime import checkpoint
+
+    da, ds = tmp_path / "async", tmp_path / "sync"
+    cfg = HeatConfig(n=32, ntime=20, dtype="float64", backend="xla",
+                     checkpoint_every=5)
+    ra = solve(cfg.with_(checkpoint_dir=str(da)))
+    rs = solve(cfg.with_(checkpoint_dir=str(ds), async_io="off"))
+    assert ra.timing.overlap_s is not None     # the pipeline really ran
+    assert rs.timing.overlap_s is None         # and sync really didn't
+    np.testing.assert_array_equal(ra.T, rs.T)
+    names_a = sorted(p.name for p in da.glob("*.npz"))
+    names_s = sorted(p.name for p in ds.glob("*.npz"))
+    assert names_a == names_s and len(names_a) == 4
+    for name in names_a:
+        Ta, sa = checkpoint.load(da / name, cfg)
+        Ts, ss = checkpoint.load(ds / name, cfg)
+        assert sa == ss
+        np.testing.assert_array_equal(Ta, Ts)
+    # resume from the async-written step-20 checkpoint == sync resume
+    ra2 = solve(cfg.with_(checkpoint_dir=str(da), ntime=30))
+    rs2 = solve(cfg.with_(checkpoint_dir=str(ds), ntime=30, async_io="off"))
+    assert ra2.start_step == rs2.start_step == 20
+    np.testing.assert_array_equal(ra2.T, rs2.T)
+
+
+def test_async_drain_on_exception_keeps_every_good_snapshot(tmp_path):
+    """A blow-up mid-solve must still land every finite boundary snapshot
+    on disk (the last good one is exactly the state a resume needs), and
+    the writer's own validation must reject the non-finite one — no NaN
+    field is ever persisted on either I/O path."""
+    import re
+
+    from heat_tpu.runtime import checkpoint
+
+    d = tmp_path / "ck"
+    cfg = HeatConfig(n=32, ntime=200, sigma=2.0, dtype="float32",
+                     backend="xla", checkpoint_every=10,
+                     checkpoint_dir=str(d), check_numerics=True)
+    with pytest.raises(FloatingPointError, match="step") as ei:
+        solve(cfg)
+    bad_step = int(re.search(r"at step (\d+)", str(ei.value)).group(1))
+    steps_on_disk = sorted(
+        int(p.stem.replace("heat_step", "")) for p in d.glob("heat_step*.npz"))
+    assert steps_on_disk == list(range(10, bad_step, 10))
+    for s in steps_on_disk:  # drained files are whole and finite
+        T, _ = checkpoint.load(d / f"heat_step{s:08d}.npz", cfg)
+        assert np.isfinite(T).all()
+
+
+def test_async_writer_failure_fails_the_solve(tmp_path, monkeypatch):
+    """A dead sink must stop the run (at the next boundary or the final
+    drain), never let it step for hours writing nothing."""
+    from heat_tpu.runtime import checkpoint
+
+    def broken(cfg, T, step):
+        raise OSError("sink is dead")
+
+    monkeypatch.setattr(checkpoint, "save", broken)
+    cfg = HeatConfig(n=32, ntime=20, dtype="float32", backend="xla",
+                     checkpoint_every=5, checkpoint_dir=str(tmp_path / "ck"))
+    with pytest.raises(OSError, match="sink is dead"):
+        solve(cfg)
+
+
+def test_async_io_off_is_the_sync_path(tmp_path):
+    """--async-io off must not spin up a writer (overlap telemetry absent)
+    while producing the same checkpoints."""
+    cfg = HeatConfig(n=24, ntime=8, dtype="float32", backend="xla",
+                     checkpoint_every=4, async_io="off",
+                     checkpoint_dir=str(tmp_path / "ck"))
+    res = solve(cfg)
+    assert res.timing.overlap_s is None
+    assert len(list((tmp_path / "ck").glob("*.npz"))) == 2
+
+
+def test_async_io_knob_validation_and_cli():
+    with pytest.raises(ValueError, match="async_io"):
+        HeatConfig(async_io="maybe")
+    from heat_tpu.cli import build_parser
+
+    args = build_parser().parse_args(["run", "--async-io", "off"])
+    assert args.async_io == "off"
+    assert HeatConfig().use_async_io() is True          # auto resolves on
+    assert HeatConfig(async_io="off").use_async_io() is False

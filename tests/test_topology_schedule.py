@@ -11,7 +11,20 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from heat_tpu.backends.sharded import make_padded_carry_machinery
+# Topology-AOT executables can't round-trip the persistent compile cache
+# (read side: libtpu's "DeserializeLoadedExecutable not implemented"; write
+# side: a CompileOnlyPyClient's Executable isn't serializable); jax
+# surfaces each failed read/write as a UserWarning per compile and then
+# compiles normally — harmless here, and consistent with the measured fact
+# that Mosaic topology compiles bypass the persistent cache entirely
+# (TROUBLESHOOTING.md "Compiles"). Filter exactly those messages so
+# `pytest -q` stays clean (VERDICT r5 #8); any OTHER warning still
+# surfaces.
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:Error (reading|writing) persistent compilation cache entry"
+    ":UserWarning")
+
+from heat_tpu.backends.sharded import make_padded_carry_machinery  # noqa: E402
 from heat_tpu.config import HeatConfig
 from heat_tpu.ops.pallas_stencil import force_compiled_kernels
 
