@@ -85,4 +85,10 @@ phase 3d_geom_ab       3600 python benchmarks/kernel_lab.py bench3d_rolled_var f
 phase 3d_fma_ab        1800 python benchmarks/kernel_lab.py bench3d_rolled_var fma 64,64,8,8
 phase thin_fma_ab      1800 python benchmarks/kernel_lab.py benchthin 4096 float32 rolled,256,16 rolledfma,256,16 --steps 2048
 phase compile_bisect32 2000 python benchmarks/compile_bisect.py --ks 32 --timeout 1800
+# Crash-recovery A/B (ISSUE 2): uninterrupted vs crash-at-50% launch,
+# reporting supervisor restart overhead + bit-identity of the final field.
+# CPU-world benchmark (spawns its own 2-process virtual world) — needs no
+# chip, so it runs even when the tunnel is down; keep it last so chip
+# phases get the budget first.
+phase recovery_lab     1200 env JAX_PLATFORMS=cpu python benchmarks/recovery_lab.py
 echo "=== extras_r5c done at $(date)"

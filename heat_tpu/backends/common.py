@@ -20,10 +20,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import HeatConfig
-from ..runtime import async_io, checkpoint, debug
+from ..runtime import async_io, checkpoint, debug, faults
 from ..runtime.logging import master_print
 from ..runtime.timing import Timing, sync, two_point_rate
 from . import SolveResult
+
+
+# --on-nan rollback: how many times the same flagged step may be retried
+# before the blow-up is declared deterministic (a genuine CFL violation
+# reproduces identically; a soft-error/injected NaN does not).
+_MAX_ROLLBACKS_PER_STEP = 2
 
 
 def _addressable(x) -> bool:
@@ -146,7 +152,24 @@ def drive(
                                            or cfg.check_numerics)
     writer = (async_io.SnapshotWriter()
               if async_on and cfg.checkpoint_every else None)
-    pending_flag = None  # (device scalar, step) from the previous boundary
+    # pending boundary flag from the async numerics leg:
+    # (device scalar, step, snapshot-or-None, deferred-checkpoint?)
+    pending_flag = None
+    # Fault-injection plan (runtime/faults.py): None in every normal run —
+    # the loop below then touches nothing fault-related beyond one
+    # ``is not None`` test per boundary.
+    plan = faults.plan_for(cfg)
+    # --on-nan rollback: hold one device snapshot of the newest boundary
+    # whose finite flag PASSED; a flagged boundary restores it and re-steps
+    # instead of aborting. Deterministic blow-ups re-flag at the same step
+    # and abort after _MAX_ROLLBACKS_PER_STEP — only transient faults
+    # (soft-error bit flips, injected NaN) actually recover. Costs one
+    # device-side copy per boundary, paid ONLY when the mode is on.
+    rollback = cfg.on_nan == "rollback" and cfg.check_numerics
+    # seed with the starting state so even a first-chunk transient recovers
+    last_good = ((async_io.device_snapshot(T_dev), step) if rollback
+                 else None)      # (snapshot, step), verified finite
+    rollbacks_at: dict = {}      # step -> rollbacks consumed there
 
     def _submit_snapshot(T_snap, at_step: int) -> None:
         check = cfg.check_numerics
@@ -167,36 +190,103 @@ def drive(
 
         writer.submit(job)
 
+    def _try_rollback(bad_step: int) -> bool:
+        """Restore the last verified-finite boundary after a flagged one;
+        False -> no rollback possible/allowed, the caller re-raises."""
+        nonlocal T_dev, step
+        if not rollback or last_good is None:
+            return False
+        n = rollbacks_at.get(bad_step, 0)
+        if n >= _MAX_ROLLBACKS_PER_STEP:
+            master_print(f"on-nan rollback: step {bad_step} flagged again "
+                         f"after {n} rollbacks — deterministic blow-up, "
+                         f"aborting")
+            return False
+        rollbacks_at[bad_step] = n + 1
+        snap, good = last_good
+        master_print(f"on-nan rollback: non-finite field at step {bad_step}; "
+                     f"rolling back to verified boundary {good} "
+                     f"(attempt {n + 1}/{_MAX_ROLLBACKS_PER_STEP})")
+        # copy the snapshot back in: the restored buffer is donated into the
+        # next advance, but last_good must stay restorable for a second try
+        T_dev = async_io.device_snapshot(snap)
+        step = good
+        return True
+
+    def _settle_pending() -> bool:
+        """Async mode: judge the boundary flag posted one chunk ago. True ->
+        it flagged and we rolled back (caller continues stepping). On a
+        pass, promotes the boundary snapshot to last_good and performs its
+        deferred checkpoint submit (rollback mode defers persistence until
+        the flag verdict so a NaN snapshot never races the writer)."""
+        nonlocal pending_flag, last_good
+        flag, fstep, snap, is_ckpt = pending_flag
+        pending_flag = None
+        try:
+            debug.raise_if_flagged(flag, fstep)
+        except FloatingPointError:
+            if _try_rollback(fstep):
+                return True
+            raise
+        if rollback:
+            last_good = (snap, fstep)
+            if is_ckpt:
+                _submit_snapshot(snap, fstep)
+        return False
+
     try:
         with debug.maybe_profile(cfg.profile_dir):
-            while step < cfg.ntime:
-                k = min(chunk, cfg.ntime - step)
-                fn = compiled.get(k)
-                T_dev = fn(T_dev) if fn is not None else advance(T_dev, k)
-                step += k
-                if cfg.check_numerics:
-                    if async_on:
-                        if pending_flag is not None:
-                            debug.raise_if_flagged(*pending_flag)
-                        pending_flag = (debug.finite_flag(T_dev), step)
-                    else:
-                        debug.check_finite(T_dev, step)
-                if cfg.heartbeat_every and step % cfg.heartbeat_every == 0:
-                    master_print(" time_it:", step)  # fortran/serial/heat.f90:62
-                if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
-                    if writer is not None:
-                        _submit_snapshot(async_io.device_snapshot(T_dev),
-                                         step)
-                    else:
-                        sync(T_dev)
-                        T_ck = to_host(T_dev)
-                        if T_ck is not None:
-                            checkpoint.save(cfg, T_ck, step)
+            while True:
+                while step < cfg.ntime:
+                    k = min(chunk, cfg.ntime - step)
+                    fn = compiled.get(k)
+                    T_dev = fn(T_dev) if fn is not None else advance(T_dev, k)
+                    step += k
+                    if plan is not None:
+                        plan.maybe_crash(step)
+                        T_dev = plan.maybe_nan(step, T_dev)
+                    if cfg.check_numerics:
+                        if async_on:
+                            if (pending_flag is not None
+                                    and _settle_pending()):
+                                continue  # rolled back: re-step the chunk
+                            pending_flag = (
+                                debug.finite_flag(T_dev), step,
+                                async_io.device_snapshot(T_dev)
+                                if rollback else None,
+                                rollback and writer is not None
+                                and cfg.checkpoint_every
+                                and step % cfg.checkpoint_every == 0)
                         else:
-                            checkpoint.save_shards(cfg, T_dev, step)
-            if pending_flag is not None:
-                debug.raise_if_flagged(*pending_flag)
-                pending_flag = None
+                            try:
+                                debug.check_finite(T_dev, step)
+                            except FloatingPointError:
+                                if _try_rollback(step):
+                                    continue
+                                raise
+                            if rollback:
+                                last_good = (async_io.device_snapshot(T_dev),
+                                             step)
+                    if cfg.heartbeat_every and step % cfg.heartbeat_every == 0:
+                        master_print(" time_it:", step)  # fortran/serial/heat.f90:62
+                    if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
+                        if writer is not None:
+                            if rollback and async_on:
+                                pass  # deferred to _settle_pending: persist
+                                      # only flag-verified snapshots
+                            else:
+                                _submit_snapshot(
+                                    async_io.device_snapshot(T_dev), step)
+                        else:
+                            sync(T_dev)
+                            T_ck = to_host(T_dev)
+                            if T_ck is not None:
+                                checkpoint.save(cfg, T_ck, step)
+                            else:
+                                checkpoint.save_shards(cfg, T_dev, step)
+                if pending_flag is None or not _settle_pending():
+                    break
+                # final boundary flagged and rolled back: resume stepping
             sync(T_dev)
     except BaseException:
         # drain-on-exception: every queued snapshot still lands on disk (a
@@ -289,6 +379,38 @@ def _rebuild_from_shard_blocks(cfg: HeatConfig, sharding, blocks):
     return jax.make_array_from_single_device_arrays(cfg.shape, sharding, arrays)
 
 
+_agree_round = 0  # KV keys must be fresh per agreement (SPMD-aligned calls)
+
+
+def _allgather_steps(local: int) -> list:
+    """Every process's newest shard step, exchanged through the distributed
+    coordination service's KV store (gRPC) instead of an XLA collective:
+    the CPU backend rejects multiprocess jit programs built outside the
+    solve's own shard_map (found by the chaos-launch resume e2e —
+    ``multihost_utils.process_allgather`` aborted every restarted world),
+    and a 4-byte agreement has no business compiling a program anyway.
+    ``blocking_key_value_get`` waits for each peer's key, so no barrier is
+    needed; a peer that died pre-publish surfaces as the supervisor seeing
+    its corpse, not as a deadlock (the get times out at 120 s)."""
+    global _agree_round
+
+    from jax._src.distributed import global_state
+
+    client = getattr(global_state, "client", None)
+    if client is None:
+        # no coordination service (faked multi-host test seam): the
+        # collective fallback — these tests never leave one real process
+        from jax.experimental import multihost_utils
+
+        return list(np.asarray(multihost_utils.process_allgather(
+            jnp.asarray(local, jnp.int32))))
+    _agree_round += 1
+    base = f"heat_tpu/resume_step/r{_agree_round}"
+    client.key_value_set(f"{base}/{jax.process_index()}", str(local))
+    return [int(client.blocking_key_value_get(f"{base}/{i}", 120_000))
+            for i in range(jax.process_count())]
+
+
 def _agree_resume_step(local_step: Optional[int]) -> Optional[int]:
     """Cross-process agreement on the shard-checkpoint resume step.
 
@@ -300,11 +422,7 @@ def _agree_resume_step(local_step: Optional[int]) -> Optional[int]:
     silent IC start against peers mid-run)."""
     local = -1 if local_step is None else int(local_step)
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        steps = np.asarray(multihost_utils.process_allgather(
-            jnp.asarray(local, jnp.int32)))
-        agreed = int(steps.min())
+        agreed = int(min(_allgather_steps(local)))
         if agreed != local:
             master_print(f"shard-checkpoint resume: local step {local} vs "
                          f"job-wide agreed step {agreed}")

@@ -16,7 +16,7 @@ import numpy as np
 
 from ..config import HeatConfig
 from ..grid import np_dtype
-from ..runtime import checkpoint, debug
+from ..runtime import checkpoint, debug, faults
 from ..runtime.logging import master_print
 from ..runtime.timing import Timing
 from . import SolveResult, register
@@ -73,6 +73,7 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, **_) -> SolveResult:
     T = np.array(T0_host, dtype=dt)
     r = dt(cfg.r)
 
+    plan = faults.plan_for(cfg)  # None in every normal run (strictly opt-in)
     t0 = time.perf_counter()
     for i in range(start_step + 1, cfg.ntime + 1):
         if cfg.heartbeat_every and i % cfg.heartbeat_every == 0:
@@ -83,6 +84,9 @@ def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, **_) -> SolveResult:
             T = step_periodic_np(T, r)
         else:
             T = step_ghost_np(T, r, dt(cfg.bc_value))
+        if plan is not None:
+            plan.maybe_crash(i)
+            T = plan.maybe_nan(i, T)
         if cfg.check_numerics:
             debug.check_finite(T, i)  # per step: name the blow-up step and
                                       # never checkpoint a NaN field

@@ -96,6 +96,22 @@ def build_parser() -> argparse.ArgumentParser:
                      help="write a jax.profiler trace of the solve to DIR")
     run.add_argument("--check-numerics", action="store_true",
                      help="detect NaN/Inf per chunk (debug; forces syncs)")
+    run.add_argument("--on-nan", dest="on_nan", choices=["abort", "rollback"],
+                     help="non-finite response under --check-numerics: "
+                          "abort (default) raises at the flagged step; "
+                          "rollback restores the last verified-finite "
+                          "boundary and re-steps (transient soft errors "
+                          "recover; deterministic blow-ups still abort "
+                          "after bounded retries)")
+    run.add_argument("--inject", metavar="SPEC",
+                     help="deterministic fault injection (chaos testing): "
+                          "comma-separated 'kind[@step][:key=val]...' — "
+                          "crash@N[:proc=P], nan@N, ckpt-corrupt@N, "
+                          "ckpt-truncate@N, sink-error@N[:times=K], "
+                          "sink-slow:ms=M; HEAT_TPU_FAULTS env var is "
+                          "equivalent (faults fire only in incarnation 0 "
+                          "unless :restart=R/-1 — a supervisor relaunch "
+                          "does not re-fire them)")
     run.add_argument("--write-int", action=argparse.BooleanOptionalAction,
                      default=None,
                      help="dump the initial field to int.dat before solving "
@@ -166,6 +182,20 @@ def build_parser() -> argparse.ArgumentParser:
     launch.add_argument("-n", "--processes", type=int, default=2)
     launch.add_argument("--devices-per-process", type=int, default=1,
                         help="virtual CPU devices contributed per process")
+    launch.add_argument("--max-restarts", type=int, default=1, metavar="K",
+                        help="self-healing supervisor: after a mid-run "
+                             "worker death, stop the surviving world, "
+                             "validate/quarantine checkpoints, and relaunch "
+                             "with resume up to K times under exponential "
+                             "backoff (default 1; 0 disables). Startup-class "
+                             "failures (<30s, no checkpoint yet) get one "
+                             "extra clean retry outside this budget")
+    launch.add_argument("--deadline", type=int, metavar="S", default=None,
+                        help="per-attempt wall-clock limit in seconds; the "
+                             "flag wins over HEAT_TPU_LAUNCH_TIMEOUT_S "
+                             "(default 3600). A deadline exit is rc=124 and "
+                             "is never restarted — it is a budget, not a "
+                             "fault")
     launch.add_argument("cmd", nargs=argparse.REMAINDER,
                         help="heat-tpu arguments, e.g.: run --backend sharded")
     return p
@@ -179,7 +209,7 @@ def _apply_overrides(cfg: HeatConfig, args) -> HeatConfig:
     for field in ("backend", "dtype", "ic", "bc", "ndim", "comm", "exchange",
                   "fuse_steps", "local_kernel", "heartbeat_every",
                   "checkpoint_every", "checkpoint_dir", "async_io",
-                  "profile_dir", "write_int"):
+                  "profile_dir", "write_int", "on_nan", "inject"):
         v = getattr(args, field, None)
         if v is not None:
             over[field] = v
@@ -421,7 +451,8 @@ def cmd_plan(args) -> int:
 
 
 def cmd_launch(args) -> int:
-    """Spawn N local worker processes joined into one jax.distributed world.
+    """Spawn N local worker processes joined into one jax.distributed world,
+    under a self-healing supervisor.
 
     World plumbing == the reference's mpirun contract: every worker runs the
     same program (SPMD), rank from JAX_PROCESS_ID, world size from
@@ -429,11 +460,24 @@ def cmd_launch(args) -> int:
     fortran/mpi+cuda/heat.F90:60-62). Worker 0's output streams through
     (master-gated prints, like the reference's masterproc writes); all
     workers' files land in the current directory (per-shard soln dumps).
+
+    Supervision (the part the reference's ignored MPI error codes never
+    had): a mid-run worker death stops the surviving world (a dead peer
+    leaves survivors blocked in collective rendezvous — they cannot make
+    progress and must be killed, reaped, and restarted), validates and
+    quarantines the checkpoint directory (``checkpoint.scan_resume_step``),
+    and relaunches with resume under exponential backoff, up to
+    ``--max-restarts`` times, emitting a structured JSON restart record per
+    attempt. A deadline exit (rc=124) is never restarted. Relaunched
+    workers get ``HEAT_TPU_RESTART=<attempt>`` so restart-gated injected
+    faults (runtime/faults.py) don't re-fire in the healed world.
     """
+    import json as _json
     import os
     import socket
     import subprocess
     import sys as _sys
+    import time as _time
 
     cmd = list(args.cmd)
     if cmd and cmd[0] == "--":
@@ -448,11 +492,25 @@ def cmd_launch(args) -> int:
         # overridden where a site hook pins a TPU plugin) and size each
         # worker's device contribution
         cmd = cmd + ["--virtual-devices", str(args.devices_per_process)]
-    import time as _time
 
-    deadline_s = int(os.environ.get("HEAT_TPU_LAUNCH_TIMEOUT_S", "3600"))
+    # --deadline wins over the env knob (documented in TROUBLESHOOTING.md);
+    # it bounds each ATTEMPT, not the supervisor's whole lifetime
+    deadline_s = (args.deadline if args.deadline is not None
+                  else int(os.environ.get("HEAT_TPU_LAUNCH_TIMEOUT_S", "3600")))
 
-    def spawn_world():
+    # supervisor-side view of the workers' checkpoint setup, for restart
+    # records and pre-relaunch validation/quarantine (workers re-validate
+    # with the full config fingerprint on their own resume path)
+    ckpt_dir = None
+    if "--checkpoint-every" in cmd or "--checkpoint-dir" in cmd:
+        ckpt_dir = "checkpoints"
+        if "--checkpoint-dir" in cmd:
+            try:
+                ckpt_dir = cmd[cmd.index("--checkpoint-dir") + 1]
+            except IndexError:
+                pass
+
+    def spawn_world(restart: int):
         # probe-then-release port allocation is racy (another process can
         # grab it before the coordinator binds); the quick-failure retry
         # below absorbs exactly that class of loss
@@ -471,6 +529,8 @@ def cmd_launch(args) -> int:
             + f" --xla_force_host_platform_device_count={args.devices_per_process}",
             "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
             "JAX_NUM_PROCESSES": str(args.processes),
+            # incarnation counter: restart-gated injected faults key off it
+            "HEAT_TPU_RESTART": str(restart),
         }
         # worker 0's stdout streams (master-gated prints); every worker's
         # stderr interleaves, like mpirun, so rank>0 failures keep their
@@ -486,23 +546,29 @@ def cmd_launch(args) -> int:
 
     def run_world(procs):
         """Wait all workers; on first failure or deadline, stop the rest
-        (a dead peer leaves survivors blocked in collective rendezvous)."""
+        (a dead peer leaves survivors blocked in collective rendezvous).
+        Returns (rc, elapsed_s, reason) — reason is "deadline" for the
+        rc=124 budget exit, else the first dead worker's identity."""
         t0 = _time.monotonic()
         live = dict(enumerate(procs))
         rc = 0
+        reason = None
         while live:
             for i, p in sorted(live.items()):
                 if p.poll() is not None:
                     del live[i]
-                    if p.returncode != 0:
+                    if p.returncode != 0 and rc == 0:
                         print(f"launch: worker {i} exited "
                               f"rc={p.returncode}", file=sys.stderr)
-                        rc = rc or p.returncode
+                        rc = p.returncode
+                        reason = f"worker {i} exited rc={p.returncode}"
             if rc or _time.monotonic() - t0 > deadline_s:
                 if not rc:
-                    print(f"launch: deadline {deadline_s}s exceeded",
-                          file=sys.stderr)
                     rc = 124
+                    reason = "deadline"
+                    print(f"launch: deadline {deadline_s}s exceeded — "
+                          f"stopping {len(live)} live worker(s) (rc=124: "
+                          f"budget exit, not a crash)", file=sys.stderr)
                 for p in live.values():
                     p.terminate()
                 for p in live.values():
@@ -510,18 +576,50 @@ def cmd_launch(args) -> int:
                         p.wait(timeout=10)
                     except subprocess.TimeoutExpired:
                         p.kill()
+                        p.wait()  # reap: a SIGKILLed worker must not
+                        # linger as a zombie for the supervisor's lifetime
                 break
             _time.sleep(0.05)
-        return rc, _time.monotonic() - t0
+        return rc, _time.monotonic() - t0, reason
 
-    rc, elapsed = run_world(spawn_world())
-    if rc and elapsed < 30:
-        # startup-class failure (port race, env): one clean retry on a
-        # fresh port; mid-run failures (past 30s) don't rerun the job
-        print("launch: startup failure, retrying once on a fresh port",
-              file=sys.stderr)
-        rc, _ = run_world(spawn_world())
-    return rc
+    from .runtime import checkpoint
+
+    backoff_base = float(os.environ.get("HEAT_TPU_RESTART_BACKOFF_S", "0.5"))
+    restarts = 0
+    startup_retry_used = False
+    while True:
+        rc, elapsed, reason = run_world(spawn_world(restarts))
+        if rc == 0:
+            return 0
+        if reason == "deadline":
+            return rc  # a budget, not a fault: restarting cannot help
+        # newest world-complete, loadable, finite checkpoint step (corrupt
+        # candidates are quarantined to *.corrupt right here, so the
+        # relaunch falls back to the next-older step instead of tripping)
+        resume_step = (checkpoint.scan_resume_step(
+            ckpt_dir, nprocs=args.processes) if ckpt_dir else None)
+        if resume_step is None and elapsed < 30 and not startup_retry_used:
+            # startup-class failure (port race, env): one clean retry on a
+            # fresh port, outside the restart budget
+            startup_retry_used = True
+            print("launch: startup failure, retrying once on a fresh port",
+                  file=sys.stderr)
+            continue
+        if restarts >= args.max_restarts:
+            if args.max_restarts > 0:
+                print(f"launch: giving up after {restarts} restart(s) "
+                      f"(--max-restarts {args.max_restarts})",
+                      file=sys.stderr)
+            return rc
+        restarts += 1
+        backoff = min(backoff_base * 2 ** (restarts - 1), 30.0)
+        rec = {"event": "launch_restart", "attempt": restarts,
+               "max_restarts": args.max_restarts, "reason": reason,
+               "rc": rc, "elapsed_s": round(elapsed, 3),
+               "resume_step": resume_step, "backoff_s": backoff}
+        print("launch: restart " + _json.dumps(rec), file=sys.stderr,
+              flush=True)
+        _time.sleep(backoff)
 
 
 def cmd_viz(args) -> int:

@@ -27,6 +27,7 @@ _BCS = ("edges", "ghost", "periodic")
 _ICS = ("hat", "hat_half", "hat_small", "uniform", "zero")
 _COMMS = ("direct", "staged")
 _ASYNC_IO = ("on", "off", "auto")
+_ON_NAN = ("abort", "rollback")
 _EXCHANGES = ("seq", "indep", "overlap")
 _LOCAL_KERNELS = ("auto", "xla", "pallas")
 
@@ -93,6 +94,24 @@ class HeatConfig:
                                 # use_async_io)
     profile_dir: Optional[str] = None  # jax.profiler trace output dir
     check_numerics: bool = False  # per-chunk NaN/Inf detection (debug mode)
+    on_nan: str = "abort"       # non-finite response under check_numerics:
+                                # "abort" raises at the flagged step (the
+                                # original contract); "rollback" restores
+                                # the last boundary whose finite flag
+                                # PASSED and re-steps — transient soft
+                                # errors (or injected NaN) recover, while a
+                                # deterministic blow-up re-flags at the
+                                # same step and aborts after a bounded
+                                # number of retries (backends/common.py)
+    inject: str = ""            # deterministic fault-injection spec
+                                # (runtime/faults.py grammar:
+                                # "crash@N[:proc=P]", "nan@N",
+                                # "ckpt-corrupt@N", "ckpt-truncate@N",
+                                # "sink-error@N[:times=K]",
+                                # "sink-slow:ms=M", comma-separated).
+                                # Empty (the default) = no fault layer at
+                                # all; HEAT_TPU_FAULTS env var is the
+                                # worker-process channel
     fuse_steps: int = 0         # pallas temporal blocking: FTCS steps fused
                                 # per kernel pass (0 = auto, 1 = off)
     parity_order: bool = False  # literal update-then-swap step ordering
@@ -132,6 +151,19 @@ class HeatConfig:
         if self.async_io not in _ASYNC_IO:
             raise ValueError(
                 f"async_io must be one of {_ASYNC_IO}, got {self.async_io!r}")
+        if self.on_nan not in _ON_NAN:
+            raise ValueError(
+                f"on_nan must be one of {_ON_NAN}, got {self.on_nan!r}")
+        if self.on_nan == "rollback" and not self.check_numerics:
+            raise ValueError(
+                "on_nan='rollback' requires check_numerics=True — the "
+                "finite flag at each boundary is the rollback trigger")
+        if self.inject:
+            # fail at parse time, not at step N of a long solve (lazy import:
+            # the common inject="" path must not load the fault layer at all)
+            from .runtime.faults import parse_spec
+
+            parse_spec(self.inject)
 
     # --- derived quantities (fortran/serial/heat.f90:15-17,59) -------------
     @property

@@ -46,6 +46,35 @@ def _already_joined() -> bool:
         return False
 
 
+def _enable_cpu_collectives() -> None:
+    """Give a multi-process CPU world a real collectives implementation.
+
+    Without one, jaxlib's CPU client rejects EVERY cross-process program —
+    "Multiprocess computations aren't implemented on the CPU backend" — so
+    the reference's mpirun-analog development mode (``heat-tpu launch``)
+    could join a world but never compute in it: the sharded IC build, the
+    halo exchange, and the shard-checkpoint resume all died at their first
+    jit. jaxlib ships a gloo TCP implementation (the flag default is
+    'none'); select it here, before the first backend client is created.
+    Only fires when the run is pinned to CPU (the launch/worker path); TPU
+    pods keep their native ICI/DCN collectives. Respects an explicit user
+    override via JAX_CPU_COLLECTIVES_IMPLEMENTATION; older jax with no such
+    knob keeps the status quo."""
+    if os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+        return  # user already chose (the flag machinery read the env var)
+    on_cpu = (os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+              or (getattr(jax.config, "jax_platforms", None) or ""
+                  ).startswith("cpu"))
+    if not on_cpu:
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        _log.info("multi-process CPU world: gloo collectives enabled")
+    except Exception:  # pragma: no cover - pre-gloo jaxlib
+        _log.info("this jaxlib has no CPU collectives implementation; "
+                  "cross-process CPU programs will not compile")
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -73,6 +102,7 @@ def init_distributed(
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):
         process_id = int(os.environ["JAX_PROCESS_ID"])
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=explicit,  # None on a pod: runtime auto-discovers
         num_processes=num_processes,

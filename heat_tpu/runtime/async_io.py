@@ -27,6 +27,16 @@ Contract:
   re-raised on the next ``submit`` (the solve must not step for hours
   against a dead disk) and again at ``drain``; queued snapshots after a
   failed one are still attempted (independent files).
+- **Transient sink errors are retried, bounded**: an ``OSError`` in the
+  EIO/ENOSPC class (flaky NFS, momentary disk pressure) gets up to
+  ``retries`` in-thread re-attempts under exponential backoff before it
+  becomes a surfaced failure — a single I/O hiccup must not abort a
+  day-long solve. Non-transient exceptions (fingerprint errors, NaN
+  snapshot rejection) surface on the first attempt.
+- **Drain is bounded**: ``drain(timeout_s=...)`` (default 10 min) raises
+  ``TimeoutError`` instead of blocking the exit path forever on a hung
+  sink; the daemon worker thread is abandoned (it cannot outlive the
+  process).
 - **Accounting**: ``busy_s`` (writer wall time in fetch+write), ``wait_s``
   (driver wall time blocked on the pipeline: backpressure + drain), and
   ``hidden_s = max(0, busy_s - wait_s)`` — the I/O wall time genuinely
@@ -35,6 +45,7 @@ Contract:
 
 from __future__ import annotations
 
+import errno
 import queue
 import threading
 import time
@@ -48,6 +59,28 @@ from .logging import master_print
 # writer thread can use.
 DEFAULT_DEPTH = 2
 
+# Transient-sink retry policy: 3 re-attempts at 50/100/200 ms covers the
+# blip class (flaky NFS op, momentary ENOSPC from a log rotation) without
+# stalling a genuinely dead disk for more than ~0.35 s before surfacing.
+DEFAULT_RETRIES = 3
+DEFAULT_RETRY_BACKOFF_S = 0.05
+
+# drain() must never block an exit path forever (hung NFS mount): 10 min is
+# far beyond any sane snapshot write yet still bounds the wait.
+DEFAULT_DRAIN_TIMEOUT_S = 600.0
+
+_TRANSIENT_ERRNOS = frozenset({
+    errno.EIO, errno.ENOSPC, errno.EAGAIN, errno.EBUSY, errno.ETIMEDOUT,
+    errno.EINTR,
+})
+
+
+def is_transient(e: BaseException) -> bool:
+    """The retry-worthy class: OS-level errors that routinely clear on
+    their own. Anything else (fingerprint mismatch, NaN rejection, a
+    coding bug) fails fast on the first attempt."""
+    return isinstance(e, OSError) and e.errno in _TRANSIENT_ERRNOS
+
 
 class SnapshotWriter:
     """Background writer for device snapshots with a bounded queue.
@@ -59,20 +92,47 @@ class SnapshotWriter:
     driver that never drains cannot hang interpreter exit.
     """
 
-    def __init__(self, depth: int = DEFAULT_DEPTH):
+    def __init__(self, depth: int = DEFAULT_DEPTH,
+                 retries: int = DEFAULT_RETRIES,
+                 retry_backoff_s: float = DEFAULT_RETRY_BACKOFF_S):
         self._q: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue(
             maxsize=max(1, depth))
         self._thread: Optional[threading.Thread] = None
         self._exc: Optional[BaseException] = None
+        self.retries = max(0, retries)
+        self.retry_backoff_s = retry_backoff_s
         self.busy_s = 0.0     # writer wall time spent in D2H + disk write
         self.wait_s = 0.0     # driver wall time blocked on the pipeline
         self.submitted = 0
         self.completed = 0    # jobs RUN (successfully or not) — drained
+        self.attempts = 0     # job executions incl. transient retries
 
     @property
     def hidden_s(self) -> float:
         """I/O wall time hidden behind compute (``Timing.overlap_s``)."""
         return max(0.0, self.busy_s - self.wait_s)
+
+    def _run_job(self, job: Callable[[], None]) -> None:
+        """One job with bounded transient retry. Retry sleeps count toward
+        ``busy_s`` (the caller times around this call): a retrying writer IS
+        occupying the pipeline, so the accounting stays honest about what
+        compute could and couldn't hide."""
+        for attempt in range(self.retries + 1):
+            self.attempts += 1
+            try:
+                job()
+                return
+            except BaseException as e:  # noqa: BLE001 — surfaced at the
+                # next submit/drain; later snapshots still attempted
+                if not (is_transient(e) and attempt < self.retries):
+                    if self._exc is None:
+                        self._exc = e
+                    return
+                delay = self.retry_backoff_s * (2 ** attempt)
+                master_print(f"async checkpoint writer: transient sink error "
+                             f"({e}); retry {attempt + 1}/{self.retries} "
+                             f"in {delay:.2g}s")
+                time.sleep(delay)
 
     def _worker(self) -> None:
         while True:
@@ -82,11 +142,7 @@ class SnapshotWriter:
                     return
                 t0 = time.perf_counter()
                 try:
-                    job()
-                except BaseException as e:  # noqa: BLE001 — surfaced at the
-                    # next submit/drain; later snapshots still attempted
-                    if self._exc is None:
-                        self._exc = e
+                    self._run_job(job)
                 finally:
                     self.busy_s += time.perf_counter() - t0
                     self.completed += 1
@@ -112,18 +168,42 @@ class SnapshotWriter:
         self.wait_s += time.perf_counter() - t0
         self.submitted += 1
 
-    def drain(self, raise_errors: bool = True) -> None:
-        """Flush every queued snapshot and stop the worker.
+    def drain(self, raise_errors: bool = True,
+              timeout_s: Optional[float] = DEFAULT_DRAIN_TIMEOUT_S) -> None:
+        """Flush every queued snapshot and stop the worker, within
+        ``timeout_s`` (None = wait forever).
 
         ``raise_errors=False`` is the exception-exit form: snapshots still
         flush (nothing dropped) but a writer error is only logged — it must
-        not mask the solve error already propagating."""
+        not mask the solve error already propagating. A drain that cannot
+        finish inside the timeout (sink hung on a dead mount) raises
+        ``TimeoutError`` (or logs, in the suppressed form) and abandons the
+        daemon worker thread — bounded exit beats a wedged process."""
         t0 = time.perf_counter()
+        hung = False
         if self._thread is not None:
-            self._q.put(None)          # after all queued jobs: FIFO drain
-            self._thread.join()
-            self._thread = None
+            deadline = None if timeout_s is None else t0 + timeout_s
+            try:
+                # after all queued jobs: FIFO drain. The put itself can
+                # block on a full queue behind a hung job — bound it too.
+                self._q.put(None, timeout=None if deadline is None else
+                            max(0.001, deadline - time.perf_counter()))
+            except queue.Full:
+                hung = True
+            if not hung:
+                self._thread.join(None if deadline is None else
+                                  max(0.001, deadline - time.perf_counter()))
+                hung = self._thread.is_alive()
+            self._thread = None  # abandoned if hung: daemon, dies with us
         self.wait_s += time.perf_counter() - t0
+        if hung:
+            msg = (f"async checkpoint writer failed to drain within "
+                   f"{timeout_s:.0f}s (sink hung?) — abandoning the writer "
+                   f"thread; queued snapshots may be lost")
+            if raise_errors:
+                raise TimeoutError(msg)
+            master_print(msg)
+            return
         if raise_errors:
             self._raise_pending()
         elif self._exc is not None:

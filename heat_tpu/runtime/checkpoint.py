@@ -1,4 +1,4 @@
-"""Periodic checkpoint / resume.
+"""Periodic checkpoint / resume, with validation + quarantine on discovery.
 
 The reference has no mid-run persistence — its only dumps are the initial
 ``int.dat`` and final ``soln.dat`` (fortran/serial/heat.f90:50-55,77-83).
@@ -6,19 +6,30 @@ This module is the genuine extension flagged in SURVEY.md §5: periodic
 ``.npz`` snapshots carrying the field, the step index, and a config
 fingerprint, enabling restart of long solves (the 25k-step flagship config,
 ``fortran/input_all.dat``).
+
+Discovery (``latest``/``latest_shards``/``scan_resume_step``) trusts
+nothing: every candidate is verified loadable and finite before it is
+offered for resume; a torn, truncated, or bit-rotted file is renamed to
+``*.corrupt`` (quarantine — it stops matching the discovery glob and a
+human can autopsy it) and discovery falls back to the next-older step. A
+fingerprint mismatch is NOT corruption — the file is intact, it just
+belongs to different physics — so it raises instead of quarantining:
+resuming across physics must stay a loud error, never a silent IC restart.
 """
 
 from __future__ import annotations
 
-import dataclasses
 import hashlib
 import json
+import re
 from pathlib import Path
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from ..config import HeatConfig
+from . import faults
+from .logging import master_print
 
 _FMT = "heat_step{step:08d}.npz"
 
@@ -35,6 +46,9 @@ def save(cfg: HeatConfig, T: np.ndarray, step: int) -> Path:
     d = Path(cfg.checkpoint_dir)
     d.mkdir(parents=True, exist_ok=True)
     path = d / _FMT.format(step=step)
+    plan = faults.plan_for(cfg)
+    if plan is not None:
+        plan.sink_fault(step)  # injected transient sink error / slow sink
     # Temp name must NOT match latest()'s "heat_step*.npz" glob, or a crash
     # mid-save would leave a torn file that resume then trips over.
     tmp = d / (path.name + ".tmp")
@@ -42,10 +56,13 @@ def save(cfg: HeatConfig, T: np.ndarray, step: int) -> Path:
         np.savez_compressed(f, T=np.asarray(T), step=step,
                             fingerprint=config_fingerprint(cfg))
     tmp.rename(path)  # atomic publish: no torn checkpoint on interrupt
+    if plan is not None:
+        plan.damage_checkpoint(path, step)  # injected post-publish bitrot
     return path
 
 
 _SHARD_FMT = "heat_shards_step{step:08d}.proc{proc:04d}.npz"
+_SHARD_RE = re.compile(r"heat_shards_step(\d{8})\.proc(\d{4})\.npz$")
 
 
 def save_shards(cfg: HeatConfig, T_dev, step: int) -> Path:
@@ -59,6 +76,9 @@ def save_shards(cfg: HeatConfig, T_dev, step: int) -> Path:
     d = Path(cfg.checkpoint_dir)
     d.mkdir(parents=True, exist_ok=True)
     path = d / _SHARD_FMT.format(step=step, proc=jax.process_index())
+    plan = faults.plan_for(cfg)
+    if plan is not None:
+        plan.sink_fault(step)
     payload = {"step": np.asarray(step),
                "fingerprint": np.asarray(config_fingerprint(cfg))}
     for i, shard in enumerate(T_dev.addressable_shards):
@@ -69,24 +89,90 @@ def save_shards(cfg: HeatConfig, T_dev, step: int) -> Path:
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **payload)
     tmp.rename(path)
+    if plan is not None:
+        plan.damage_checkpoint(path, step)
     return path
 
 
+# --- validation + quarantine ------------------------------------------------
+
+
+def _finite(a: np.ndarray) -> bool:
+    a = np.asarray(a)
+    if a.dtype.name == "bfloat16":  # np.isfinite has no bf16 loop
+        a = a.astype(np.float32)
+    return bool(np.isfinite(a).all())
+
+
+def validate(path: Path, cfg: Optional[HeatConfig] = None) -> Optional[str]:
+    """None when the checkpoint is restorable; else a reason string
+    (unreadable / non-finite — the quarantine classes). A fingerprint
+    mismatch (checked only when ``cfg`` is given) raises ValueError
+    instead: the file is intact, the CONFIG is wrong, and falling back to
+    an older step would silently resume different physics."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            fp = str(z["fingerprint"])
+            int(z["step"])
+            if "T" in z:
+                if not _finite(z["T"]):
+                    return "non-finite field"
+            else:
+                i = 0
+                while f"shard{i}_data" in z:
+                    if not _finite(z[f"shard{i}_data"]):
+                        return "non-finite shard"
+                    tuple(z[f"shard{i}_start"])
+                    i += 1
+                if i == 0:
+                    return "no shard blocks"
+    except Exception as e:  # torn zip, bad CRC, missing keys, short read —
+        # every decode failure is the same verdict: not restorable
+        return f"unreadable ({type(e).__name__}: {e})"
+    if cfg is not None and fp != config_fingerprint(cfg):
+        raise ValueError(
+            f"checkpoint {path} was written for a different physics config "
+            f"(fingerprint {fp} != {config_fingerprint(cfg)})"
+        )
+    return None
+
+
+def quarantine(path: Path, reason: str) -> Path:
+    """Rename a bad checkpoint to ``*.corrupt``: it stops matching every
+    discovery glob (resume falls back to the next-older step) but stays on
+    disk for autopsy."""
+    q = path.with_name(path.name + ".corrupt")
+    path.rename(q)
+    master_print(f"checkpoint: quarantined {path.name} -> {q.name} ({reason})")
+    return q
+
+
 def latest_shards(cfg: HeatConfig, max_step: Optional[int] = None) -> Optional[int]:
-    """Newest step for which this process has a shard checkpoint."""
+    """Newest step for which this process has a VALID shard checkpoint;
+    invalid candidates are quarantined and the next-older step is tried."""
     import jax
 
     d = Path(cfg.checkpoint_dir)
     if not d.is_dir():
         return None
     suffix = f".proc{jax.process_index():04d}.npz"
+    byname = {
+        p.name: p for p in d.glob("heat_shards_step*.npz")
+        if p.name.endswith(suffix)
+    }
     steps = sorted(
-        int(p.name[len("heat_shards_step"):len("heat_shards_step") + 8])
-        for p in d.glob("heat_shards_step*.npz") if p.name.endswith(suffix)
+        int(name[len("heat_shards_step"):len("heat_shards_step") + 8])
+        for name in byname
     )
     if max_step is not None:
         steps = [s for s in steps if s <= max_step]
-    return steps[-1] if steps else None
+    for step in reversed(steps):
+        p = byname[_SHARD_FMT.format(step=step, proc=jax.process_index())]
+        reason = validate(p, cfg)
+        if reason is None:
+            return step
+        quarantine(p, reason)
+    return None
 
 
 def load_shards(cfg: HeatConfig, step: int):
@@ -115,15 +201,23 @@ def load_shards(cfg: HeatConfig, step: int):
 
 
 def latest(cfg: HeatConfig, max_step: Optional[int] = None) -> Optional[Path]:
-    """Newest checkpoint, optionally capped at ``max_step`` — resuming a run
-    whose ntime is *smaller* than an old checkpoint must not time-travel."""
+    """Newest VALID checkpoint, optionally capped at ``max_step`` — resuming
+    a run whose ntime is *smaller* than an old checkpoint must not
+    time-travel. A corrupt newest candidate is quarantined (``*.corrupt``)
+    and the next-older step offered instead; a fingerprint mismatch raises
+    (see ``validate``)."""
     d = Path(cfg.checkpoint_dir)
     if not d.is_dir():
         return None
     cks = sorted(d.glob("heat_step*.npz"))
     if max_step is not None:
         cks = [c for c in cks if int(c.stem.replace("heat_step", "")) <= max_step]
-    return cks[-1] if cks else None
+    for c in reversed(cks):
+        reason = validate(c, cfg)
+        if reason is None:
+            return c
+        quarantine(c, reason)
+    return None
 
 
 def latest_step(cfg: HeatConfig, max_step: Optional[int] = None) -> Optional[int]:
@@ -131,6 +225,52 @@ def latest_step(cfg: HeatConfig, max_step: Optional[int] = None) -> Optional[int
     this module's private business."""
     p = latest(cfg, max_step=max_step)
     return None if p is None else int(p.stem.replace("heat_step", ""))
+
+
+def scan_resume_step(ckpt_dir, nprocs: int = 1,
+                     max_step: Optional[int] = None) -> Optional[int]:
+    """Supervisor-side discovery (cli.cmd_launch): the newest step a
+    relaunched world could resume from, config-free (loadable + finite
+    only — the workers' own ``latest*``/``load*`` still enforce the
+    fingerprint). Single-file checkpoints count directly; a shard step
+    counts only when ALL ``nprocs`` per-process files are present and
+    valid (a partial shard set is a crash caught between two processes'
+    saves — ``_agree_resume_step`` would reject it anyway). Invalid
+    candidates are quarantined here so the relaunch never re-trips."""
+    d = Path(ckpt_dir)
+    if not d.is_dir():
+        return None
+    best: Optional[int] = None
+    for p in sorted(d.glob("heat_step*.npz"), reverse=True):
+        step = int(p.stem.replace("heat_step", ""))
+        if max_step is not None and step > max_step:
+            continue
+        reason = validate(p)
+        if reason is None:
+            best = step
+            break
+        quarantine(p, reason)
+    by_step: Dict[int, Dict[int, Path]] = {}
+    for p in d.glob("heat_shards_step*.npz"):
+        m = _SHARD_RE.match(p.name)
+        if m:
+            by_step.setdefault(int(m.group(1)), {})[int(m.group(2))] = p
+    for step in sorted(by_step, reverse=True):
+        if max_step is not None and step > max_step:
+            continue
+        files = by_step[step]
+        if set(range(nprocs)) - set(files):
+            continue  # partial shard set: some process never saved this step
+        bad = False
+        for p in files.values():
+            reason = validate(p)
+            if reason is not None:
+                quarantine(p, reason)
+                bad = True
+        if not bad:
+            best = step if best is None else max(best, step)
+            break
+    return best
 
 
 def load(path: Path, cfg: HeatConfig) -> Tuple[np.ndarray, int]:

@@ -1,0 +1,64 @@
+"""Checkpoint edge cases on the resume path (ISSUE 2 satellites): the
+time-travel cap, cross-process resume-step agreement with missing shard
+files, and fingerprint rejection through the public CLI."""
+
+import pytest
+
+import heat_tpu.backends.common as common
+from heat_tpu.backends import solve
+from heat_tpu.cli import main
+from heat_tpu.config import HeatConfig
+from heat_tpu.runtime import checkpoint
+
+
+def test_latest_max_step_time_travel_cap(tmp_path):
+    """Resuming a run whose ntime is SMALLER than an old checkpoint must
+    not time-travel past it: latest(max_step=...) caps discovery."""
+    d = tmp_path / "ck"
+    cfg = HeatConfig(n=16, ntime=8, dtype="float64", backend="xla",
+                     checkpoint_every=2, checkpoint_dir=str(d))
+    solve(cfg)  # checkpoints at 2, 4, 6, 8
+    assert checkpoint.latest_step(cfg) == 8
+    assert checkpoint.latest_step(cfg, max_step=5) == 4
+    assert checkpoint.latest_step(cfg, max_step=1) is None
+    # end to end: a shorter re-run resumes at its own ntime, not at 8
+    res = solve(cfg.with_(ntime=6))
+    assert res.start_step == 6
+
+
+def test_agree_resume_step_subset_missing(monkeypatch):
+    """A crash between one process's save and the others' leaves a subset
+    with no shard file: everyone must agree on the MINIMUM, and 'no file
+    anywhere in the subset' means all fall back together — never a silent
+    IC start against peers mid-run."""
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # peers hold different steps: agree on the minimum
+    monkeypatch.setattr(common, "_allgather_steps", lambda local: [4, 10])
+    assert common._agree_resume_step(10) == 4
+    # one peer has NO shard file (local=-1): everyone resumes from scratch
+    monkeypatch.setattr(common, "_allgather_steps", lambda local: [-1, 10])
+    assert common._agree_resume_step(10) is None
+    # single process: no agreement round at all
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    assert common._agree_resume_step(6) == 6
+    assert common._agree_resume_step(None) is None
+
+
+def test_fingerprint_mismatch_rejected_via_cli(tmp_cwd):
+    """Resume rejection on fingerprint mismatch through the public CLI
+    path: checkpoints written under one physics config must make a re-run
+    under different physics fail loudly — not quarantine-and-fall-back,
+    and never silently restart from the IC."""
+    (tmp_cwd / "input.dat").write_text("16 0.25 0.05 2.0 4 0\n")
+    args = ["run", "--backend", "xla", "--dtype", "float64",
+            "--checkpoint-every", "2"]
+    assert main(args) == 0
+    assert len(list((tmp_cwd / "checkpoints").glob("*.npz"))) == 2
+    # same command, different physics (nu changed in input.dat)
+    (tmp_cwd / "input.dat").write_text("16 0.25 0.99 2.0 4 0\n")
+    with pytest.raises(ValueError, match="different physics"):
+        main(args)
+    # the intact foreign checkpoint must NOT have been quarantined
+    assert len(list((tmp_cwd / "checkpoints").glob("*.corrupt"))) == 0

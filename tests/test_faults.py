@@ -1,0 +1,294 @@
+"""Fault-injection layer (runtime/faults.py) + the recovery semantics it
+exists to exercise: --on-nan rollback, checkpoint quarantine + fallback,
+transient-sink retry. Single-process and fast — the multi-process
+supervisor e2e lives in test_chaos.py (slow-marked)."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig
+from heat_tpu.runtime import checkpoint, faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plans():
+    """Firing state is cached per spec string per process; tests must not
+    inherit a previous test's spent faults."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# --- spec grammar -----------------------------------------------------------
+
+
+def test_parse_spec_full_grammar():
+    fs = faults.parse_spec(
+        "crash@8:proc=1,nan@6,ckpt-corrupt@4,ckpt-truncate@4,"
+        "sink-error@2:times=3,sink-slow:ms=7:restart=-1")
+    kinds = [f.kind for f in fs]
+    assert kinds == ["crash", "nan", "ckpt-corrupt", "ckpt-truncate",
+                     "sink-error", "sink-slow"]
+    assert fs[0].step == 8 and fs[0].proc == 1
+    assert fs[4].times == 3
+    assert fs[5].ms == 7.0 and fs[5].restart == -1
+
+
+@pytest.mark.parametrize("bad", [
+    "bogus@3",            # unknown kind
+    "crash",              # crash needs a step
+    "nan@x",              # non-integer step
+    "crash@3:zorp=1",     # unknown key
+    "sink-error@2:times=abc",
+])
+def test_parse_spec_rejects_typos(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_config_validates_inject_spec_at_parse_time():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        HeatConfig(inject="bogus@3")
+    HeatConfig(inject="nan@6")  # valid spec constructs fine
+
+
+def test_plan_for_is_strictly_opt_in(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    assert faults.plan_for(HeatConfig()) is None
+    assert faults.plan_for(None) is None
+    # env var is the worker-process channel
+    monkeypatch.setenv(faults.ENV_VAR, "nan@6")
+    plan = faults.plan_for(HeatConfig())
+    assert plan is not None and plan.faults[0].kind == "nan"
+    # cfg.inject wins over the env var
+    assert faults.plan_for(HeatConfig(inject="crash@2")).faults[0].kind == "crash"
+
+
+def test_restart_gate_suppresses_fired_faults(monkeypatch):
+    """A restarted incarnation (HEAT_TPU_RESTART=1) must not re-fire a
+    default (restart=0) fault — the self-healing relaunch would otherwise
+    die the same death forever."""
+    monkeypatch.setenv(faults.RESTART_ENV_VAR, "1")
+    cfg = HeatConfig(n=16, ntime=8, dtype="float64", backend="xla",
+                     inject="nan@4", check_numerics=True)
+    res = solve(cfg)  # nan@4 is gated off: completes clean
+    assert np.isfinite(res.T).all()
+    # restart=-1 fires in every incarnation
+    faults.reset()
+    with pytest.raises(FloatingPointError):
+        solve(cfg.with_(inject="nan@4:restart=-1"))
+
+
+# --- nan injection + --on-nan ----------------------------------------------
+
+
+def test_injected_nan_aborts_by_default():
+    # heartbeat_every=2 pins the chunk (event_interval) to 2, so the fault
+    # fires exactly at its nominal step and the error names it
+    cfg = HeatConfig(n=16, ntime=8, dtype="float64", backend="xla",
+                     check_numerics=True, heartbeat_every=2, inject="nan@4")
+    with pytest.raises(FloatingPointError, match="step 4"):
+        solve(cfg)
+
+
+def test_on_nan_rollback_requires_check_numerics():
+    with pytest.raises(ValueError, match="rollback"):
+        HeatConfig(on_nan="rollback")
+    with pytest.raises(ValueError, match="on_nan"):
+        HeatConfig(on_nan="retry", check_numerics=True)
+
+
+@pytest.mark.parametrize("async_io", ["auto", "off"])
+def test_on_nan_rollback_recovers_transient_nan(tmp_path, async_io):
+    """The headline recovery semantic: a transient non-finite boundary
+    (injected NaN) rolls back to the last verified-finite boundary,
+    re-steps, and finishes with a final field BIT-IDENTICAL to an
+    uninterrupted run — on both I/O paths."""
+    cfg = HeatConfig(n=24, ntime=12, dtype="float64", backend="xla",
+                     check_numerics=True, on_nan="rollback",
+                     checkpoint_every=2, async_io=async_io,
+                     checkpoint_dir=str(tmp_path / "ck"), inject="nan@6")
+    res = solve(cfg)
+    clean = solve(HeatConfig(n=24, ntime=12, dtype="float64", backend="xla"))
+    np.testing.assert_array_equal(res.T, clean.T)
+    # every checkpoint on disk is finite (the NaN boundary never persisted)
+    names = sorted(p.name for p in (tmp_path / "ck").glob("*.npz"))
+    assert names == [f"heat_step{s:08d}.npz" for s in range(2, 13, 2)]
+    for name in names:
+        T, _ = checkpoint.load(tmp_path / "ck" / name,
+                               cfg.with_(inject="", on_nan="abort",
+                                         check_numerics=False))
+        assert np.isfinite(T).all()
+
+
+def test_on_nan_rollback_aborts_deterministic_blowup():
+    """sigma far past the FTCS bound re-flags the same step after every
+    rollback: the bounded retry budget must declare it deterministic and
+    abort instead of looping forever."""
+    cfg = HeatConfig(n=16, ntime=400, sigma=2.0, dtype="float32",
+                     backend="xla", check_numerics=True, on_nan="rollback",
+                     heartbeat_every=10)
+    with pytest.raises(FloatingPointError):
+        solve(cfg)
+
+
+def test_rollback_serial_backend_still_aborts():
+    """The rollback driver lives in backends/common.drive (device
+    backends); the serial oracle keeps the abort contract."""
+    cfg = HeatConfig(n=16, ntime=8, dtype="float64", backend="serial",
+                     check_numerics=True, on_nan="rollback", inject="nan@4")
+    with pytest.raises(FloatingPointError):
+        solve(cfg)
+
+
+# --- checkpoint damage + quarantine ----------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["ckpt-corrupt", "ckpt-truncate"])
+def test_damaged_newest_checkpoint_quarantined_and_fallback(tmp_path, kind):
+    """Acceptance criterion: corrupting the newest checkpoint makes resume
+    fall back to the next-older step, with the bad file renamed to
+    ``*.corrupt``."""
+    d = tmp_path / "ck"
+    cfg = HeatConfig(n=24, ntime=6, dtype="float64", backend="xla",
+                     checkpoint_every=2, checkpoint_dir=str(d),
+                     inject=f"{kind}@6")
+    solve(cfg)  # steps 2, 4 intact; step 6 damaged post-publish
+    res = solve(cfg.with_(ntime=10, inject=""))
+    assert res.start_step == 4
+    assert sorted(p.name for p in d.glob("*.corrupt")) == [
+        "heat_step00000006.npz.corrupt"]
+    clean = solve(HeatConfig(n=24, ntime=10, dtype="float64", backend="xla"))
+    np.testing.assert_array_equal(res.T, clean.T)
+
+
+def test_shard_checkpoint_quarantine_falls_back(tmp_path, monkeypatch):
+    """latest_shards applies the same validate->quarantine->older-step
+    contract to per-process shard files."""
+    import heat_tpu.backends.common as common
+
+    monkeypatch.setattr(common, "_addressable", lambda x: False)
+    d = tmp_path / "ck"
+    cfg = HeatConfig(n=16, ntime=4, dtype="float32", backend="sharded",
+                     mesh_shape=(2, 2), checkpoint_every=2,
+                     checkpoint_dir=str(d))
+    solve(cfg)  # shard files at steps 2 and 4
+    newest = d / "heat_shards_step00000004.proc0000.npz"
+    newest.write_bytes(newest.read_bytes()[:100])  # torn write
+    res = solve(cfg.with_(ntime=6))
+    assert res.start_step == 2
+    assert (d / "heat_shards_step00000004.proc0000.npz.corrupt").exists()
+
+
+def test_non_finite_checkpoint_is_quarantined(tmp_path):
+    """A checkpoint that loads but carries NaN is as dead as a torn one."""
+    d = tmp_path / "ck"
+    cfg = HeatConfig(n=16, ntime=4, dtype="float64", backend="xla",
+                     checkpoint_every=2, checkpoint_dir=str(d))
+    solve(cfg)
+    # overwrite the newest with a NaN field under a valid fingerprint
+    bad = np.full((16, 16), np.nan)
+    checkpoint.save(cfg, bad, 4)
+    assert checkpoint.latest_step(cfg) == 2
+    assert (d / "heat_step00000004.npz.corrupt").exists()
+
+
+def test_scan_resume_step_supervisor_view(tmp_path):
+    """The launch supervisor's config-free discovery: singles + complete
+    shard sets count, partial shard sets don't, corrupt candidates are
+    quarantined during the scan."""
+    d = tmp_path / "ck"
+    cfg = HeatConfig(n=16, ntime=4, dtype="float64", backend="xla",
+                     checkpoint_every=2, checkpoint_dir=str(d))
+    solve(cfg)  # heat_step...2/4.npz
+    assert checkpoint.scan_resume_step(d) == 4
+    assert checkpoint.scan_resume_step(d, max_step=3) == 2
+    # a fake 2-proc shard set: complete at step 6, partial at step 8
+    for proc in (0, 1):
+        src = (d / "heat_step00000004.npz").read_bytes()
+        (d / f"heat_shards_step00000006.proc{proc:04d}.npz").write_bytes(src)
+    (d / "heat_shards_step00000008.proc0000.npz").write_bytes(src)
+    assert checkpoint.scan_resume_step(d, nprocs=2) == 6
+    # corrupt the newest single: quarantined mid-scan, falls back
+    p4 = d / "heat_step00000004.npz"
+    p4.write_bytes(p4.read_bytes()[:80])
+    assert checkpoint.scan_resume_step(d, nprocs=2) == 6
+    assert (d / "heat_step00000004.npz.corrupt").exists()
+    assert checkpoint.scan_resume_step(tmp_path / "nope") is None
+
+
+# --- transient sink faults through the async writer -------------------------
+
+
+def test_transient_sink_error_absorbed_by_writer_retry(tmp_path):
+    """Two injected EIO write failures stay below the writer's retry
+    budget: the solve completes and every checkpoint lands."""
+    d = tmp_path / "ck"
+    cfg = HeatConfig(n=16, ntime=6, dtype="float64", backend="xla",
+                     checkpoint_every=2, checkpoint_dir=str(d),
+                     inject="sink-error@2:times=2")
+    solve(cfg)
+    assert sorted(p.name for p in d.glob("*.npz")) == [
+        f"heat_step{s:08d}.npz" for s in (2, 4, 6)]
+
+
+def test_persistent_sink_error_surfaces(tmp_path):
+    cfg = HeatConfig(n=16, ntime=6, dtype="float64", backend="xla",
+                     checkpoint_every=2,
+                     checkpoint_dir=str(tmp_path / "ck"),
+                     inject="sink-error@2:times=99")
+    with pytest.raises(OSError, match="injected transient sink error"):
+        solve(cfg)
+
+
+def test_slow_sink_fault_only_delays(tmp_path):
+    cfg = HeatConfig(n=16, ntime=4, dtype="float64", backend="xla",
+                     checkpoint_every=2, checkpoint_dir=str(tmp_path / "ck"),
+                     inject="sink-slow:ms=20")
+    res = solve(cfg)
+    assert np.isfinite(res.T).all()
+    assert len(list((tmp_path / "ck").glob("*.npz"))) == 2
+
+
+# --- crash fault (subprocess: os._exit must not kill the test runner) -------
+
+
+def test_crash_fault_exits_with_chaos_rc(tmp_cwd):
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    env = {**os.environ,
+           "PYTHONPATH": str(Path(__file__).resolve().parent.parent)
+           + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    (tmp_cwd / "input.dat").write_text("16 0.25 0.05 2.0 6 0\n")
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from heat_tpu.cli import main\n"
+        "raise SystemExit(main(['run', '--backend', 'serial',\n"
+        "                       '--dtype', 'float64',\n"
+        "                       '--inject', 'crash@3']))\n"
+    )
+    p = subprocess.run([sys.executable, "-c", code], cwd=tmp_cwd, env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == faults.CRASH_RC, p.stderr
+    assert "injected crash at step 3" in p.stderr
+
+
+def test_no_inject_leaves_solve_bit_identical(tmp_path, monkeypatch):
+    """Strict opt-in: inject='' must not perturb results or checkpoint
+    bytes (the hot path carries only a plan-is-None test)."""
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    da, db = tmp_path / "a", tmp_path / "b"
+    base = HeatConfig(n=24, ntime=8, dtype="float64", backend="xla",
+                      checkpoint_every=4)
+    ra = solve(base.with_(checkpoint_dir=str(da)))
+    rb = solve(base.with_(checkpoint_dir=str(db), inject=""))
+    np.testing.assert_array_equal(ra.T, rb.T)
+    for name in ("heat_step00000004.npz", "heat_step00000008.npz"):
+        assert (da / name).read_bytes() == (db / name).read_bytes()

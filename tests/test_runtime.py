@@ -288,6 +288,85 @@ def test_async_io_off_is_the_sync_path(tmp_path):
     assert len(list((tmp_path / "ck").glob("*.npz"))) == 2
 
 
+def test_snapshot_writer_retries_transient_errors():
+    """An EIO/ENOSPC-class sink error gets bounded in-thread retries under
+    backoff before surfacing; a clean third attempt absorbs the blip
+    entirely (a flaky NFS op must not abort a day-long solve)."""
+    import errno
+
+    from heat_tpu.runtime.async_io import SnapshotWriter
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError(errno.EIO, "blip")
+
+    w = SnapshotWriter(retries=3, retry_backoff_s=0.001)
+    w.submit(flaky)
+    w.drain()                      # no error: the retries absorbed it
+    assert len(calls) == 3
+    assert w.attempts == 3 and w.completed == 1
+
+    # budget exhausted: the transient error finally surfaces
+    w2 = SnapshotWriter(retries=2, retry_backoff_s=0.001)
+    w2.submit(lambda: (_ for _ in ()).throw(OSError(errno.EIO, "dead")))
+    with pytest.raises(OSError, match="dead"):
+        w2.drain()
+    assert w2.attempts == 3        # 1 try + 2 retries
+
+
+def test_snapshot_writer_non_transient_fails_fast():
+    """Only the transient OSError class is retry-worthy: a ValueError (or
+    an errno-less OSError) surfaces after exactly one attempt."""
+    from heat_tpu.runtime.async_io import SnapshotWriter
+
+    w = SnapshotWriter(retries=3, retry_backoff_s=0.001)
+    w.submit(lambda: (_ for _ in ()).throw(ValueError("fingerprint")))
+    with pytest.raises(ValueError, match="fingerprint"):
+        w.drain()
+    assert w.attempts == 1
+
+
+def test_snapshot_writer_drain_timeout():
+    """A hung sink must not wedge the exit path: drain raises TimeoutError
+    at its deadline (and only logs on the suppressed exception-exit form)."""
+    import threading
+
+    from heat_tpu.runtime.async_io import SnapshotWriter
+
+    gate = threading.Event()
+    w = SnapshotWriter()
+    w.submit(lambda: gate.wait(30))
+    with pytest.raises(TimeoutError, match="drain"):
+        w.drain(timeout_s=0.2)
+    gate.set()                     # release the abandoned daemon thread
+
+    gate2 = threading.Event()
+    w2 = SnapshotWriter()
+    w2.submit(lambda: gate2.wait(30))
+    w2.drain(raise_errors=False, timeout_s=0.2)  # logs, must not raise
+    gate2.set()
+
+
+def test_async_writer_error_surfaces_at_final_drain(tmp_path, monkeypatch):
+    """Exit-path contract (PR 1): when the ONLY checkpoint boundary is the
+    run's final step there is no later submit to piggyback on — the final
+    drain itself must surface the writer error."""
+    from heat_tpu.runtime import checkpoint
+
+    def broken(cfg, T, step):
+        raise ValueError("sink died on the last boundary")
+
+    monkeypatch.setattr(checkpoint, "save", broken)
+    cfg = HeatConfig(n=24, ntime=5, dtype="float32", backend="xla",
+                     checkpoint_every=5,
+                     checkpoint_dir=str(tmp_path / "ck"))
+    with pytest.raises(ValueError, match="last boundary"):
+        solve(cfg)
+
+
 def test_async_io_knob_validation_and_cli():
     with pytest.raises(ValueError, match="async_io"):
         HeatConfig(async_io="maybe")
