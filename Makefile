@@ -2,14 +2,35 @@
 # (fortran/*/makefile: main/init/out/clean) in one place.
 
 PY ?= python
+# tier1 needs pipefail (a dash /bin/sh has no `set -o pipefail`)
+SHELL := /bin/bash
 
-.PHONY: test bench bench-all bench-smoke chip-check weak-scaling \
+.PHONY: test tier1 lint bench bench-all bench-smoke chip-check weak-scaling \
         collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
-        native run viz clean
+        serve-lab native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
+
+tier1:          # the ROADMAP.md tier-1 verify command, verbatim semantics
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
+
+lint:           # ruff when installed; syntax-level fallback otherwise
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+	  $(PY) -m ruff check heat_tpu tests benchmarks; \
+	elif command -v ruff >/dev/null 2>&1; then \
+	  ruff check heat_tpu tests benchmarks; \
+	else \
+	  echo "lint: ruff not installed — falling back to compileall syntax check"; \
+	  $(PY) -m compileall -q heat_tpu tests benchmarks; \
+	fi
 
 bench:
 	$(PY) bench.py
@@ -47,6 +68,9 @@ topology-schedule:     # multi-chip schedule census (overlap evidence)
 
 topology-validate:     # cross-chip machine-model compile validation
 	$(PY) benchmarks/topology_validate.py
+
+serve-lab:             # continuous-batching engine vs sequential solos A/B
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_lab.py
 
 sweep:                 # flap-tolerant full chip queue
 	bash benchmarks/watch_and_sweep.sh

@@ -124,6 +124,33 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--json", action="store_true",
                      help="also print a machine-readable result line")
 
+    serve = sub.add_parser(
+        "serve",
+        help="multi-tenant serving engine: drain a JSONL file of solve "
+             "requests as continuously-batched vmapped lanes (same-bucket "
+             "requests step in one compiled program; finished lanes are "
+             "swapped for queued requests without recompiling)")
+    serve.add_argument("--requests", required=True, metavar="FILE.jsonl",
+                       help="JSON Lines: one request object per line, keys "
+                            "= HeatConfig physics fields (n, ntime, sigma, "
+                            "nu, dom_len, ndim, dtype, ic, bc, bc_value) + "
+                            "optional id; '#' lines are comments")
+    serve.add_argument("--lanes", type=int, default=4,
+                       help="max concurrent requests per bucket group "
+                            "(default 4)")
+    serve.add_argument("--chunk", type=int, default=16,
+                       help="steps per device program call — the swap "
+                            "granularity of continuous batching (default 16)")
+    serve.add_argument("--buckets", default="256,512,1024",
+                       help="comma-separated grid-side buckets; a request "
+                            "is padded up to the smallest side that fits "
+                            "(default 256,512,1024)")
+    serve.add_argument("--out-dir", metavar="DIR",
+                       help="write each result as DIR/<id>.npz (atomic "
+                            "publish); default: results stay in memory")
+    serve.add_argument("--json", action="store_true",
+                       help="also print a machine-readable summary line")
+
     viz = sub.add_parser("viz", help="render a .dat file as a 3D surface")
     viz.add_argument("datfile")
     viz.add_argument("--save", default="sol.png")
@@ -341,6 +368,41 @@ def _process_index() -> int:
     import jax
 
     return jax.process_index()
+
+
+def cmd_serve(args) -> int:
+    """Drain a JSONL request file through the batched serving engine.
+
+    Per-request structured records stream as JSON lines while lanes
+    finish; the exit code is 0 only when every request served cleanly
+    (a rejected or failed request is that request's record AND a nonzero
+    exit, so batch drivers notice without parsing records).
+    """
+    import json as _json
+
+    from .serve import ServeConfig, serve_requests
+
+    path = Path(args.requests)
+    if not path.exists():
+        print(f"error: {path} not found", file=sys.stderr)
+        return 2
+    try:
+        buckets = tuple(int(b) for b in str(args.buckets).split(",") if b)
+        scfg = ServeConfig(lanes=args.lanes, chunk=args.chunk,
+                           buckets=buckets, out_dir=args.out_dir)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    records, summary = serve_requests(path, scfg)
+    ok = sum(1 for r in records if r["status"] == "ok")
+    master_print(f"served {summary['requests']} request(s): {ok} ok, "
+                 f"{summary.get('rejected', 0)} rejected, "
+                 f"{summary.get('error', 0)} failed "
+                 f"({summary['step_compiles']} stepping compile(s), "
+                 f"{summary['compile_s']:.3f}s compiling)")
+    if args.json:
+        master_print(_json.dumps(summary, sort_keys=True))
+    return 0 if ok == summary["requests"] else 1
 
 
 def cmd_plan(args) -> int:
@@ -678,6 +740,45 @@ def cmd_info(_args) -> int:
           + ("" if chip.calibrated else " — spec-derived table"))
     print(f"process {jax.process_index()}/{jax.process_count()}")
     print(f"native fastio: {'available' if native_available() else 'unavailable (numpy fallback)'}")
+
+    # gloo CPU collectives: the multi-process-CPU prerequisite the launch
+    # path selects automatically — surfaced here so its absence is visible
+    # BEFORE a `heat-tpu launch -n 2` dies at its first cross-process jit
+    from .parallel.dist import cpu_collectives_info
+
+    cc = cpu_collectives_info()
+    if cc["available"]:
+        detail = f"selected={cc['value'] or 'none'}"
+        if cc["env_override"]:
+            detail += " (pinned via JAX_CPU_COLLECTIVES_IMPLEMENTATION)"
+        elif (cc["value"] or "none") == "none":
+            detail += " (heat-tpu launch selects gloo automatically)"
+        print(f"gloo CPU collectives: available — {detail}")
+    else:
+        print("gloo CPU collectives: UNAVAILABLE (pre-gloo jaxlib) — "
+              "multi-process CPU worlds cannot compile cross-process "
+              "programs; `heat-tpu launch` sharded runs will fail")
+
+    # persistent compile cache: which programs are already warm (serve
+    # buckets, backend advance programs, guard probes all land here) —
+    # entry names are XLA key hashes, so report population, not keys
+    import os
+
+    from .utils.cache import default_cache_dir
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_cache_dir()
+    entries = []
+    p = Path(cache_dir)
+    if p.is_dir():
+        entries = [e for e in p.iterdir() if e.is_file()]
+    if entries:
+        size_mib = sum(e.stat().st_size for e in entries) / 2**20
+        print(f"compile cache: {cache_dir} — warm ({len(entries)} compiled "
+              f"program(s), {size_mib:.1f} MiB); backends/serve buckets "
+              f"compiled under this jax/platform skip their cold compile")
+    else:
+        print(f"compile cache: {cache_dir} — cold/empty (first run of each "
+              f"backend chunk program or serve bucket pays its compile)")
     return 0
 
 
@@ -691,7 +792,7 @@ def cmd_calibrate(args) -> int:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     return {"run": cmd_run, "viz": cmd_viz, "info": cmd_info,
-            "launch": cmd_launch, "plan": cmd_plan,
+            "launch": cmd_launch, "plan": cmd_plan, "serve": cmd_serve,
             "bench": cmd_bench, "calibrate": cmd_calibrate}[args.command](args)
 
 

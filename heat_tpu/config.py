@@ -236,6 +236,40 @@ def parse_input(path: str | Path) -> HeatConfig:
     return HeatConfig(n=n, sigma=sigma, nu=nu, dom_len=dom_len, ntime=ntime, soln=soln)
 
 
+# Request-JSONL surface of the serving engine (serve/api.py): the physics
+# and per-request knobs a tenant may set. Framework-level execution knobs
+# (backend, mesh, checkpointing, async_io) are engine policy, not request
+# payload — a request naming them is a typo or a privilege confusion, and
+# both must reject loudly rather than silently serve different physics.
+_REQUEST_KEYS = ("n", "sigma", "nu", "dom_len", "ntime", "ndim", "dtype",
+                 "ic", "bc", "bc_value", "inject")
+
+
+def config_from_request(d) -> HeatConfig:
+    """Build a HeatConfig from one parsed serve-request object.
+
+    ``id`` is the scheduler's, everything else must be a known request key;
+    HeatConfig's own __post_init__ then validates values exactly as it does
+    for the CLI, so a request cannot express a config the solo path would
+    reject.
+    """
+    unknown = set(d) - set(_REQUEST_KEYS) - {"id"}
+    if unknown:
+        raise ValueError(
+            f"unknown request key(s) {sorted(unknown)}; allowed: "
+            f"{sorted(_REQUEST_KEYS)} (+ optional 'id')")
+    kw = {k: d[k] for k in _REQUEST_KEYS if k in d}
+    # JSON numbers arrive untyped: pin the integer fields (a float n would
+    # sail through range validation and break shapes much later)
+    for k in ("n", "ntime", "ndim"):
+        if k in kw:
+            kw[k] = int(kw[k])
+    for k in ("sigma", "nu", "dom_len", "bc_value"):
+        if k in kw:
+            kw[k] = float(kw[k])
+    return HeatConfig(**kw)
+
+
 def write_input(cfg: HeatConfig, path: str | Path) -> None:
     """Write the 6-field ``input.dat`` form (readable by every variant)."""
     # repr keeps full precision: a write/parse round-trip must not perturb

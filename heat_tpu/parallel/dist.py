@@ -75,6 +75,27 @@ def _enable_cpu_collectives() -> None:
                   "cross-process CPU programs will not compile")
 
 
+def cpu_collectives_info() -> dict:
+    """Observability for the gloo unbreak (``_enable_cpu_collectives``):
+    whether this jaxlib HAS a CPU collectives knob at all, what it is
+    currently set to, and whether the user pinned it via env var — so
+    ``heat-tpu info`` can say up front whether a multi-process CPU world
+    (``heat-tpu launch``) will be able to compile cross-process programs,
+    instead of that surfacing as a launch failure minutes in."""
+    env = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION") or None
+    try:
+        value = jax.config.read("jax_cpu_collectives_implementation")
+        available = True
+    except Exception:  # pre-gloo jaxlib: no such config option
+        value, available = None, False
+    return {
+        "available": available,     # the knob (and gloo impl) exists
+        "value": value,             # current selection ('none' until the
+                                    # launch path or the user picks gloo)
+        "env_override": env,        # user pinned it; launch won't touch it
+    }
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

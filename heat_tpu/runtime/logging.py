@@ -42,6 +42,19 @@ def master_print(*args, **kw) -> None:
         sys.stdout.flush()
 
 
+def json_record(event: str, **fields) -> None:
+    """One structured, machine-parseable JSON line (master-gated).
+
+    The serving engine's per-request records and any future structured
+    telemetry share this single emitter so consumers can grep one shape:
+    ``{"event": "<event>", ...}`` with sorted keys, one record per line.
+    """
+    import json
+
+    master_print(json.dumps({"event": event, **fields}, sort_keys=True,
+                            default=str))
+
+
 class _MasterFilter(logging.Filter):
     """Drop sub-ERROR records on non-master processes (checked per record,
     so creating the logger costs no backend initialization)."""

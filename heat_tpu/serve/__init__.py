@@ -1,0 +1,26 @@
+"""Multi-tenant serving engine: continuous batching over vmapped lanes.
+
+The reference runs exactly one solve per process invocation (``program
+heat`` reads one ``input.dat`` and exits); the ROADMAP north star is a
+system serving *many* independent solve requests as batched device work.
+This package applies the continuous-batching shape of modern inference
+servers (Orca-style iteration-level scheduling — see PAPERS.md) to the
+paper's FTCS stencil:
+
+- ``engine.py``    — the device half: up to L same-bucket grids stacked
+  into one ``(L, ny, nx)`` array with per-lane scalar params and an
+  active-lane mask, all lanes stepped by one jitted shape-stable chunk
+  program (masked lanes step too; their results are ignored).
+- ``scheduler.py`` — the host half: admission queue, shape bucketing
+  (requests padded up to a small set of grid buckets so there is at most
+  one stepping-program compile per bucket x lane-count), and continuous
+  batching at chunk boundaries — a finished lane's result goes to the
+  async writeback pipeline and a queued request takes the freed lane
+  without recompiling or stalling the other lanes.
+- ``api.py``       — the request JSONL contract and the ``heat-tpu
+  serve`` entry point.
+"""
+
+from .engine import BucketKey, LaneEngine, lane_buffer  # noqa: F401
+from .scheduler import Engine, Request, ServeConfig  # noqa: F401
+from .api import load_requests, serve_requests  # noqa: F401

@@ -1,0 +1,78 @@
+"""Request JSONL contract + the ``heat-tpu serve`` entry point.
+
+A requests file is JSON Lines: one JSON object per line, blank lines and
+``#`` comment lines ignored. Each object is a solve request; keys map to
+the same-named ``HeatConfig`` fields (``config.config_from_request``):
+
+    {"id": "a", "n": 128, "ntime": 500}
+    {"id": "b", "n": 300, "ntime": 200, "nu": 0.1, "dtype": "float32",
+     "bc": "ghost", "bc_value": 1.0, "ic": "uniform"}
+
+``id`` is optional (auto-assigned ``req-NNNN``); everything else defaults
+to the ``HeatConfig`` defaults. Unknown keys are a per-request rejection
+(typos must not silently serve different physics). The engine pads each
+request up to the smallest configured bucket side and serves same-bucket
+requests as vmapped lanes (see scheduler.py / engine.py).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from ..config import HeatConfig, config_from_request
+from .scheduler import Engine, ServeConfig
+
+
+def load_requests(path) -> List[Tuple[Optional[str], Optional[HeatConfig], Optional[str]]]:
+    """Parse a requests JSONL file into ``(id, cfg, parse_error)`` triples.
+
+    A malformed line yields ``(id-or-None, None, reason)`` instead of
+    raising: one bad request must not take down the whole file (the same
+    per-request isolation contract the engine applies at admission).
+    """
+    out = []
+    for lineno, raw in enumerate(Path(path).read_text().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        rid = None
+        try:
+            d = json.loads(line)
+            if not isinstance(d, dict):
+                raise ValueError(f"request must be a JSON object, got "
+                                 f"{type(d).__name__}")
+            rid = d.get("id")
+            out.append((rid, config_from_request(d), None))
+        except Exception as e:  # noqa: BLE001 — recorded per request
+            out.append((rid, None, f"line {lineno}: {type(e).__name__}: {e}"))
+    return out
+
+
+def serve_requests(path, scfg: ServeConfig = ServeConfig(),
+                   engine: Optional[Engine] = None) -> Tuple[List[dict], dict]:
+    """Serve every request in a JSONL file; returns (records, summary).
+
+    Parse failures become status='rejected' records alongside the engine's
+    own admission rejections, so the records list covers every input line.
+    """
+    eng = engine or Engine(scfg)
+    parse_failures = []
+    for i, (rid, cfg, err) in enumerate(load_requests(path)):
+        if cfg is None:
+            rec = {"id": rid or f"line-{i}", "status": "rejected",
+                   "error": err}
+            parse_failures.append(rec)
+            if scfg.emit_records:
+                from ..runtime.logging import json_record
+
+                json_record("serve_request", **rec)
+            continue
+        eng.submit(cfg, request_id=rid)
+    records = eng.results() + parse_failures
+    summary = eng.summary()
+    summary["requests"] += len(parse_failures)
+    if parse_failures:
+        summary["rejected"] = summary.get("rejected", 0) + len(parse_failures)
+    return records, summary

@@ -1,0 +1,251 @@
+"""Vmapped simulation lanes: the device half of the serving engine.
+
+One compiled program steps up to ``L`` independent solve requests at once.
+The requests of one *bucket* (same ndim/dtype/BC, grid side <= the bucket
+side ``B``) are stacked into a single ``(L, B+2, ..., B+2)`` array — each
+lane carries its request's field in the ``[1 : 1+n]`` corner of a one-cell-
+margined bucket buffer, plus per-lane scalars: the stencil coefficient
+``r`` (each request's own ``cfg.r``), the request side ``n``, and the
+remaining step count. The chunk program runs ``k`` masked steps under
+``lax.fori_loop``: every lane computes the full-bucket stencil every step
+(shape-stable — the compiled program never depends on which lanes are
+live), and a per-lane/per-cell mask decides what is *kept*:
+
+- cells outside the request region keep their old value, so padding never
+  contaminates physics;
+- a lane whose ``remaining`` counter has hit zero keeps its whole field,
+  so lanes finish at exactly their own step count (step-granular, not
+  chunk-granular) and idle until the scheduler swaps them.
+
+Bit-identity with solo runs falls out of the masking scheme, not of luck:
+
+- ``edges`` BC: only request-interior cells update; each reads neighbors
+  that are all inside the request region — the same values combined in
+  the same left-to-right order as ``ops.stencil.ftcs_step_edges``, and
+  float add/mul are elementwise IEEE ops that XLA fusion cannot reorder
+  per element. The request's frozen boundary ring blocks every read path
+  into the padding.
+- ``ghost`` BC: every request cell updates, and the loader establishes
+  the invariant that ALL padding cells (the margin ring and the unused
+  bucket corner) hold ``bc_value``; the mask never lets them update, so a
+  request-edge cell reads exactly the conceptual ``bc_value`` ghost ring
+  of ``ops.stencil.ftcs_step_ghost``.
+- ``periodic`` BC has no padded-bucket form (wraparound would wrap at the
+  bucket edge, not the request edge); the scheduler rejects it per
+  request instead of letting the engine mis-serve it.
+
+Compile economics: the stepping program is keyed by (bucket, lane-count,
+chunk) — the scheduler fixes lane-count and chunk per engine, so serving
+any number of requests costs at most ONE stepping compile per bucket x
+lane-count, plus one trivial lane-swap program per bucket (the swap takes
+the lane index as a traced scalar precisely so refilling lane 3 vs lane 7
+is the same executable).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..ops.stencil import accum_dtype_for, laplacian_interior
+from ..utils import jnp_dtype
+
+# BC -> first request-interior offset that updates: ghost updates every
+# request cell (offset 0), edges freezes the outermost request ring
+# (offset 1). periodic is absent by design (see module docstring).
+_BC_LO = {"ghost": 0, "edges": 1}
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketKey:
+    """What must match for two requests to share a stacked lane array."""
+
+    ndim: int
+    n: int        # bucket side: requests with side <= n fit
+    dtype: str
+    bc: str
+
+    @property
+    def padded_shape(self) -> Tuple[int, ...]:
+        """Per-lane buffer shape: bucket side + one-cell margin each side
+        (the margin is what lets ``laplacian_interior`` see a neighbor for
+        every bucket cell, exactly as the ghost/edges solo paths do)."""
+        return (self.n + 2,) * self.ndim
+
+
+def lane_buffer(key: BucketKey, field: np.ndarray, bc_value: float) -> np.ndarray:
+    """Host-side lane image of one request: a bucket buffer filled with
+    ``bc_value`` (the ghost-BC invariant; harmless fill for edges) with the
+    request field written into the ``[1 : 1+n]`` corner."""
+    n = field.shape[0]
+    if field.shape != (n,) * key.ndim:
+        raise ValueError(f"request field {field.shape} is not square/cubic")
+    if n > key.n:
+        raise ValueError(f"request side {n} exceeds bucket {key.n}")
+    buf = np.full(key.padded_shape, bc_value, dtype=np.float64)
+    buf[tuple(slice(1, 1 + n) for _ in range(key.ndim))] = np.asarray(
+        field, np.float64)
+    return buf
+
+
+def _lane_step(T, r, n, lo: int):
+    """One masked FTCS step of a single lane (vmapped over the lane axis).
+
+    ``T``: the padded bucket buffer; the request occupies interior
+    coordinates ``0..n-1`` (buffer ``[1:1+n]``). ``r``/``n`` are this
+    lane's scalars. Cells with request-interior coordinate in
+    ``[lo, n-1-lo]`` along every axis take the stencil update; everything
+    else — the frozen edges ring (lo=1), the padding corner, the margin —
+    keeps its old value.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nd = T.ndim
+    acc = accum_dtype_for(T.dtype)
+    ctr = tuple(slice(1, -1) for _ in range(nd))
+    # identical arithmetic to the solo paths: T + r*lap, summed in the
+    # reference's left-to-right order by laplacian_interior
+    upd = (T[ctr].astype(acc)
+           + r.astype(acc) * laplacian_interior(T)).astype(T.dtype)
+    mask = None
+    for d in range(nd):
+        io = jax.lax.broadcasted_iota(jnp.int32, upd.shape, d)
+        m = (io >= lo) & (io <= n - 1 - lo)
+        mask = m if mask is None else mask & m
+    return T.at[ctr].set(jnp.where(mask, upd, T[ctr]))
+
+
+def make_lane_advance(key: BucketKey):
+    """The jitted chunk program for one bucket: ``advance(state, k)`` runs
+    ``k`` masked steps over every lane. ``state`` is the flat lane pytree
+    ``(fields, r, n, remaining)``; donated, so the double buffer ping-pongs
+    like the solo drive loop's."""
+    import jax
+    import jax.numpy as jnp
+
+    lo = _BC_LO[key.bc]
+    step_all = jax.vmap(functools.partial(_lane_step, lo=lo),
+                        in_axes=(0, 0, 0))
+    ndim = key.ndim
+
+    @functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+    def advance(state, k: int):
+        fields, r, n, remaining = state
+
+        def body(_, carry):
+            f, rem = carry
+            stepped = step_all(f, r, n)
+            act = rem > 0
+            f = jnp.where(act.reshape(act.shape + (1,) * ndim), stepped, f)
+            return f, rem - act.astype(rem.dtype)
+
+        fields, remaining = jax.lax.fori_loop(0, k, body, (fields, remaining))
+        return fields, r, n, remaining
+
+    return advance
+
+
+def make_lane_loader(key: BucketKey):
+    """The jitted lane-swap program: replace lane ``lane`` (a TRACED scalar
+    — one compile covers every lane index) with a new request's buffer and
+    scalars. Donated like ``advance`` so swapping never copies the other
+    lanes."""
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def load(state, lane, buf, r_new, n_new, steps_new):
+        fields, r, n, remaining = state
+        fields = jax.lax.dynamic_update_index_in_dim(fields, buf, lane, 0)
+        return (fields, r.at[lane].set(r_new), n.at[lane].set(n_new),
+                remaining.at[lane].set(steps_new))
+
+    return load
+
+
+class LaneEngine:
+    """Device-side lane state for ONE (bucket, lane-count) combination.
+
+    The scheduler owns admission and swap policy; this class owns the
+    arrays and the compiled programs. All methods treat the state
+    linearly (every call consumes and replaces it — the buffers are
+    donated into each jitted program).
+    """
+
+    def __init__(self, key: BucketKey, lanes: int, chunk: int,
+                 compiled_cache: Optional[Dict] = None):
+        import jax.numpy as jnp
+
+        if key.bc not in _BC_LO:
+            raise ValueError(
+                f"bc {key.bc!r} has no lane form (periodic wraparound would "
+                f"wrap at the bucket edge); supported: {sorted(_BC_LO)}")
+        if lanes < 1 or chunk < 1:
+            raise ValueError(f"lanes/chunk must be >= 1, got {lanes}/{chunk}")
+        self.key = key
+        self.lanes = lanes
+        self.chunk = chunk
+        dt = jnp_dtype(key.dtype)
+        acc = accum_dtype_for(dt)
+        self._state = (
+            jnp.zeros((lanes,) + key.padded_shape, dtype=dt),
+            jnp.zeros((lanes,), dtype=acc),          # per-lane r
+            jnp.ones((lanes,), dtype=jnp.int32),     # per-lane request side
+            jnp.zeros((lanes,), dtype=jnp.int32),    # per-lane steps left
+        )
+        self._load = make_lane_loader(key)
+        # AOT-compile the stepping program (shared across engines through
+        # compiled_cache — the scheduler passes one dict per serve run so
+        # the (bucket, lane-count) compile really happens at most once)
+        self.compile_s = 0.0
+        cache = compiled_cache if compiled_cache is not None else {}
+        ckey = (key, lanes, chunk)
+        if ckey not in cache:
+            from ..backends.common import aot_compile_chunks
+
+            advance = make_lane_advance(key)
+            compiled, self.compile_s = aot_compile_chunks(
+                advance, self._state, [chunk])
+            cache[ckey] = compiled[chunk]
+        self._advance = cache[ckey]
+
+    # --- lane I/O ---------------------------------------------------------
+    def load_lane(self, lane: int, field: np.ndarray, r: float,
+                  steps: int, bc_value: float) -> None:
+        """Install one request into ``lane``: pad the host field into a
+        bucket buffer and swap it in (one traced-index program)."""
+        import jax.numpy as jnp
+
+        dt = jnp_dtype(self.key.dtype)
+        acc = accum_dtype_for(dt)
+        buf = jnp.asarray(lane_buffer(self.key, field, bc_value), dtype=dt)
+        self._state = self._load(
+            self._state, jnp.int32(lane), buf,
+            jnp.asarray(r, acc), jnp.int32(field.shape[0]),
+            jnp.int32(steps))
+
+    def extract_lane(self, lane: int, n: int) -> np.ndarray:
+        """Fetch one finished lane's request field to host (D2H of a single
+        lane; the scheduler hands the result to the async writeback)."""
+        buf = np.asarray(self._state[0][lane])
+        return buf[tuple(slice(1, 1 + n) for _ in range(self.key.ndim))]
+
+    # --- stepping ---------------------------------------------------------
+    def step_chunk(self) -> np.ndarray:
+        """Run one ``chunk``-step program over every lane; returns the
+        per-lane remaining-step counts (host, (L,) int32 — the only fetch
+        the boundary needs). The fetch doubles as the chunk fence."""
+        self._state = self._advance(self._state)
+        return np.asarray(self._state[3])
+
+    def remaining(self) -> np.ndarray:
+        return np.asarray(self._state[3])
+
+
+def wall_clock() -> float:
+    """Seam for tests; the scheduler stamps queue/serve waits with this."""
+    return time.perf_counter()

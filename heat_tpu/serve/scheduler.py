@@ -1,0 +1,279 @@
+"""Admission queue + shape bucketing + continuous batching (host half).
+
+The serving contract, in the shape of an inference server's scheduler:
+
+- **Admission**: ``Engine.submit(cfg)`` validates a request against the
+  bucket table and enqueues it. A request the engine cannot serve (side
+  larger than the biggest bucket; periodic BC, which has no padded-lane
+  form) is *rejected as a record*, never as an engine error — multi-tenant
+  serving must not let one bad request take down the queue.
+- **Bucketing**: requests are grouped by ``BucketKey`` (ndim, smallest
+  bucket side that fits, dtype, BC). One group = one stacked lane array =
+  at most one stepping-program compile per (bucket, lane-count) no matter
+  how many requests flow through it.
+- **Continuous batching**: the chunk loop never stops for a single lane.
+  At each chunk boundary the scheduler fetches the (L,) remaining-step
+  vector — the only per-boundary D2H — extracts finished lanes, hands
+  their fields to the async writeback pipeline (``runtime/async_io``,
+  the same bounded-queue writer the checkpoint path uses), and swaps
+  queued requests into the freed lanes while the other lanes keep their
+  state. This is Orca-style iteration-level scheduling (PAPERS.md) with
+  the FTCS chunk as the iteration.
+- **Fault isolation**: an injected or real sink failure on one request's
+  writeback (``sink-error`` in runtime/faults.py grammar) fails THAT
+  request's record; transient errors still ride the writer's bounded
+  in-thread retry, and the engine keeps draining the other lanes either
+  way.
+
+Per-request structured JSON records (queue wait, steps/s, lane id) go
+through ``runtime/logging``; each request also keeps a python-level record
+for library callers (``Engine.results()``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config import HeatConfig
+from ..grid import initial_condition
+from ..runtime import async_io, faults
+from ..runtime.logging import json_record
+from .engine import BucketKey, LaneEngine, wall_clock
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine-level knobs (the per-request physics lives in HeatConfig)."""
+
+    lanes: int = 4            # max concurrent requests per bucket group
+    chunk: int = 16           # steps per device program call (the swap
+                              # granularity of continuous batching)
+    buckets: tuple = (256, 512, 1024)  # grid-side buckets; a request is
+                              # padded up to the smallest side that fits
+    out_dir: Optional[str] = None  # writeback directory (<id>.npz); None =
+                              # results kept in-memory on the records
+    keep_fields: bool = False  # keep final fields on records even when
+                              # writing files (tests / library callers)
+    emit_records: bool = True  # print one JSON line per finished request
+
+    def __post_init__(self):
+        if self.lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {self.lanes}")
+        if self.chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {self.chunk}")
+        if not self.buckets or any(b < 3 for b in self.buckets):
+            raise ValueError(f"buckets must be sides >= 3, got {self.buckets}")
+
+
+@dataclasses.dataclass
+class Request:
+    """One admitted solve request."""
+
+    id: str
+    cfg: HeatConfig
+    submit_t: float
+    key: Optional[BucketKey] = None   # None once rejected
+
+
+def _bucket_for(cfg: HeatConfig, buckets) -> Optional[int]:
+    """Smallest bucket side that fits the request, or None (overflow)."""
+    for b in sorted(buckets):
+        if cfg.n <= b:
+            return b
+    return None
+
+
+def _write_result(out_dir, req_id: str, T: np.ndarray, cfg: HeatConfig):
+    """Atomic-publish one request's final field (same torn-file discipline
+    as runtime/checkpoint.py: temp name outside any discovery glob)."""
+    from pathlib import Path
+
+    d = Path(out_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / f"{req_id}.npz"
+    tmp = d / (path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, T=np.asarray(T), step=cfg.ntime,
+                            n=cfg.n, ndim=cfg.ndim, dtype=cfg.dtype)
+    tmp.rename(path)
+    return path
+
+
+class Engine:
+    """Request-driven batched execution engine (library API).
+
+    >>> eng = Engine(ServeConfig(lanes=4, chunk=8, buckets=(64,)))
+    >>> rid = eng.submit(HeatConfig(n=32, ntime=100, dtype="float64"))
+    >>> records = eng.results()   # drains the queue, returns all records
+
+    ``submit`` only enqueues; ``run``/``results`` executes every admitted
+    request to completion via continuous batching and returns the records
+    in submit order.
+    """
+
+    def __init__(self, scfg: ServeConfig = ServeConfig()):
+        self.scfg = scfg
+        self._queues: Dict[BucketKey, collections.deque] = {}
+        self._records: List[dict] = []
+        self._by_id: Dict[str, dict] = {}
+        self._seq = 0
+        # one compiled-program cache for the engine's lifetime: repeated
+        # runs (a long-lived server draining wave after wave) never pay a
+        # second (bucket, lane-count) compile
+        self._compiled: Dict = {}
+        self.step_compiles = 0    # stepping programs built (the criterion:
+                                  # at most one per (bucket, lane-count))
+        self.compile_s = 0.0
+
+    # --- admission --------------------------------------------------------
+    def submit(self, cfg: HeatConfig, request_id: Optional[str] = None) -> str:
+        """Admit one request; returns its id. Unservable requests become
+        status='rejected' records instead of raising (see module doc)."""
+        rid = request_id or f"req-{self._seq:04d}"
+        self._seq += 1
+        if rid in self._by_id:
+            raise ValueError(f"duplicate request id {rid!r}")
+        rec = {"id": rid, "n": cfg.n, "ndim": cfg.ndim, "ntime": cfg.ntime,
+               "dtype": cfg.dtype, "bc": cfg.bc, "status": "queued",
+               "bucket": None, "lane": None, "queue_wait_s": None,
+               "solve_s": None, "steps_per_s": None, "error": None}
+        self._records.append(rec)
+        self._by_id[rid] = rec
+        if cfg.bc == "periodic":
+            self._reject(rec, "unsupported-bc: periodic has no padded-lane "
+                              "form (wraparound would wrap at the bucket "
+                              "edge, not the request edge)")
+            return rid
+        b = _bucket_for(cfg, self.scfg.buckets)
+        if b is None:
+            self._reject(rec, f"bucket-overflow: request side {cfg.n} "
+                              f"exceeds the biggest bucket "
+                              f"{max(self.scfg.buckets)}")
+            return rid
+        key = BucketKey(ndim=cfg.ndim, n=b, dtype=cfg.dtype, bc=cfg.bc)
+        rec["bucket"] = b
+        self._queues.setdefault(key, collections.deque()).append(
+            Request(id=rid, cfg=cfg, submit_t=wall_clock(), key=key))
+        return rid
+
+    def _reject(self, rec: dict, reason: str) -> None:
+        rec["status"] = "rejected"
+        rec["error"] = reason
+        self._emit(rec)
+
+    def _emit(self, rec: dict) -> None:
+        if self.scfg.emit_records:
+            json_record("serve_request",
+                        **{k: v for k, v in rec.items() if k != "T"})
+
+    # --- execution --------------------------------------------------------
+    def run(self) -> List[dict]:
+        """Drain every queued request through continuous batching; returns
+        all records (submit order). Reentrant: new submits after a run are
+        served by the next run against warm compiled programs."""
+        writer = async_io.SnapshotWriter()
+        try:
+            for key in list(self._queues):
+                q = self._queues[key]
+                if q:
+                    self._run_group(key, q, writer)
+        finally:
+            # every queued writeback lands (or fails per-request) before
+            # results are reported; per-request jobs swallow their own
+            # failures, so a surviving writer error here is a real bug
+            writer.drain()
+        return list(self._records)
+
+    def results(self) -> List[dict]:
+        """``run`` + records (the common library call)."""
+        if any(self._queues.values()):
+            self.run()
+        return list(self._records)
+
+    def _run_group(self, key: BucketKey, q, writer) -> None:
+        """Continuous-batching loop for one bucket group."""
+        lanes = min(self.scfg.lanes, len(q))
+        ckey = (key, lanes, self.scfg.chunk)
+        fresh = ckey not in self._compiled
+        eng = LaneEngine(key, lanes, self.scfg.chunk,
+                         compiled_cache=self._compiled)
+        if fresh:
+            self.step_compiles += 1
+            self.compile_s += eng.compile_s
+        occupant: List[Optional[Request]] = [None] * lanes
+
+        def fill_free_lanes():
+            for lane in range(lanes):
+                if occupant[lane] is None and q:
+                    req = q.popleft()
+                    now = wall_clock()
+                    rec = self._by_id[req.id]
+                    rec["lane"] = lane
+                    rec["queue_wait_s"] = round(now - req.submit_t, 6)
+                    rec["status"] = "running"
+                    rec["_start_t"] = now
+                    T0 = initial_condition(req.cfg)
+                    eng.load_lane(lane, T0, float(req.cfg.r),
+                                  req.cfg.ntime, req.cfg.bc_value)
+                    occupant[lane] = req
+
+        fill_free_lanes()
+        while any(o is not None for o in occupant):
+            rem = eng.step_chunk()
+            for lane in range(lanes):
+                req = occupant[lane]
+                if req is not None and rem[lane] == 0:
+                    self._finish(eng, lane, req, writer)
+                    occupant[lane] = None
+            fill_free_lanes()   # continuous batching: freed lanes refill
+                                # while the others' state stays put
+
+    def _finish(self, eng: LaneEngine, lane: int, req: Request,
+                writer) -> None:
+        """Extract a finished lane and hand it to the async writeback."""
+        rec = self._by_id[req.id]
+        now = wall_clock()
+        start = rec.pop("_start_t", now)
+        rec["solve_s"] = round(now - start, 6)
+        rec["steps_per_s"] = (round(req.cfg.ntime / (now - start), 3)
+                              if now > start else None)
+        T = eng.extract_lane(lane, req.cfg.n)
+        if self.scfg.keep_fields or not self.scfg.out_dir:
+            rec["T"] = T
+        cfg, scfg = req.cfg, self.scfg
+        attempts = {"n": 0}
+
+        def job():
+            # Runs in the writer thread. Transient sink errors are
+            # re-raised so the SnapshotWriter's bounded in-thread retry
+            # (backoff, same budget as checkpoints) gets its shot; a final
+            # failure is recorded on THIS request and swallowed — it must
+            # not poison writer._exc and kill the other lanes' drain.
+            attempts["n"] += 1
+            try:
+                plan = faults.plan_for(cfg)
+                if plan is not None:
+                    plan.sink_fault(cfg.ntime)
+                if scfg.out_dir:
+                    rec["path"] = str(_write_result(scfg.out_dir, req.id,
+                                                    T, cfg))
+                rec["status"] = "ok"
+            except BaseException as e:  # noqa: BLE001 — per-request record
+                if async_io.is_transient(e) and attempts["n"] <= writer.retries:
+                    raise
+                rec["status"] = "error"
+                rec["error"] = f"{type(e).__name__}: {e}"
+            self._emit(rec)
+
+        writer.submit(job)
+
+    # --- reporting --------------------------------------------------------
+    def summary(self) -> dict:
+        by_status = collections.Counter(r["status"] for r in self._records)
+        return {"requests": len(self._records), **dict(by_status),
+                "step_compiles": self.step_compiles,
+                "compile_s": round(self.compile_s, 3)}

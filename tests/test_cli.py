@@ -91,7 +91,13 @@ def test_viz(input_dat):
 
 def test_info(capsys):
     assert main(["info"]) == 0
-    assert "devices:" in capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert "devices:" in out
+    # PR-2's gloo unbreak and the persistent compile cache must be visible
+    # to users, not only discoverable through a failed launch
+    assert "gloo CPU collectives:" in out
+    assert "compile cache:" in out
+    assert ("warm" in out) or ("cold/empty" in out)
 
 
 def test_bad_mesh_arg():

@@ -1,0 +1,289 @@
+"""Serving engine: batched-lane correctness, continuous batching, bucket
+admission, per-request fault isolation, and the serve CLI.
+
+The load-bearing contract is bit-identity: a request served through the
+vmapped masked lanes must produce, on CPU at the same dtype, the exact
+bytes a solo ``heat-tpu run`` of the same config produces — including
+requests admitted mid-flight into a lane another request just vacated
+(the continuous-batching path)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig, config_from_request
+from heat_tpu.runtime import faults
+from heat_tpu.serve import Engine, ServeConfig
+from heat_tpu.serve.engine import BucketKey, LaneEngine, lane_buffer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def quiet(**kw) -> ServeConfig:
+    kw.setdefault("emit_records", False)
+    return ServeConfig(**kw)
+
+
+# --- batched-engine bit-identity -------------------------------------------
+
+
+MIXED_REQUESTS = [
+    # mixed sizes, BCs, diffusivities, step counts — all one engine run;
+    # 6 requests over 2 lanes forces continuous-batching admission
+    HeatConfig(n=17, ntime=37, dtype="float64", bc="edges", ic="hat"),
+    HeatConfig(n=32, ntime=50, dtype="float64", bc="ghost", ic="uniform"),
+    HeatConfig(n=24, ntime=5, dtype="float64", bc="edges", ic="hat_small",
+               nu=0.1),
+    HeatConfig(n=40, ntime=20, dtype="float64", bc="edges", ic="hat"),
+    HeatConfig(n=20, ntime=64, dtype="float64", bc="ghost", ic="hat",
+               bc_value=2.5),
+    HeatConfig(n=17, ntime=3, dtype="float64", bc="ghost", ic="hat_half"),
+]
+
+
+def test_batched_lanes_bit_identical_to_solo_runs():
+    """Acceptance: every lane's final field == the solo run of the same
+    config, bitwise, including lanes filled mid-flight (6 requests, 2
+    lanes -> 4 of them are continuous-batching admits)."""
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(32, 48)))
+    ids = [eng.submit(cfg) for cfg in MIXED_REQUESTS]
+    recs = {r["id"]: r for r in eng.results()}
+    for cfg, rid in zip(MIXED_REQUESTS, ids):
+        rec = recs[rid]
+        assert rec["status"] == "ok", rec
+        solo = solve(cfg).T
+        assert solo.dtype == rec["T"].dtype
+        np.testing.assert_array_equal(rec["T"], solo)
+
+
+def test_mid_flight_admit_into_freed_lane_is_exact():
+    """One lane, three requests: requests 2 and 3 can ONLY run by being
+    swapped into the lane request 1 finished in. Different step counts so
+    the masked per-step countdown (not the chunk size) sets each stop."""
+    cfgs = [HeatConfig(n=16, ntime=k, dtype="float64", bc="edges")
+            for k in (7, 19, 30)]  # none a multiple of chunk=8
+    eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,)))
+    ids = [eng.submit(c) for c in cfgs]
+    recs = {r["id"]: r for r in eng.results()}
+    for cfg, rid in zip(cfgs, ids):
+        np.testing.assert_array_equal(recs[rid]["T"], solve(cfg).T)
+    # one lane means one (bucket, lane-count) combo: exactly one compile
+    assert eng.step_compiles == 1
+
+
+def test_float32_and_bfloat16_lanes_match_solo():
+    for dtype in ("float32", "bfloat16"):
+        cfgs = [HeatConfig(n=12, ntime=9, dtype=dtype, bc="edges"),
+                HeatConfig(n=16, ntime=14, dtype=dtype, bc="ghost",
+                           ic="uniform")]
+        eng = Engine(quiet(lanes=2, chunk=4, buckets=(16,)))
+        ids = [eng.submit(c) for c in cfgs]
+        recs = {r["id"]: r for r in eng.results()}
+        for cfg, rid in zip(cfgs, ids):
+            solo = solve(cfg).T
+            assert np.array_equal(np.asarray(recs[rid]["T"], np.float32),
+                                  np.asarray(solo, np.float32))
+
+
+def test_3d_requests_served():
+    cfg = HeatConfig(n=10, ntime=6, ndim=3, dtype="float64", bc="ghost",
+                     ic="uniform", sigma=0.1)
+    eng = Engine(quiet(lanes=2, chunk=4, buckets=(12,)))
+    rid = eng.submit(cfg)
+    rec = eng.results()[0]
+    assert rec["status"] == "ok" and rec["id"] == rid
+    np.testing.assert_array_equal(rec["T"], solve(cfg).T)
+
+
+def test_zero_step_request_returns_ic():
+    cfg = HeatConfig(n=8, ntime=0, dtype="float64")
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(8,)))
+    eng.submit(cfg)
+    np.testing.assert_array_equal(eng.results()[0]["T"], solve(cfg).T)
+
+
+def test_compile_count_one_per_bucket_lane_combo():
+    """Acceptance: at most one stepping compile per (bucket, lane-count),
+    however many requests flow through — and a second wave of submits
+    reuses the warm programs (zero new compiles)."""
+    cfgs = [HeatConfig(n=n, ntime=4, dtype="float64")
+            for n in (8, 10, 12, 14, 16, 9, 11, 13)]
+    eng = Engine(quiet(lanes=3, chunk=4, buckets=(12, 16)))
+    for c in cfgs:
+        eng.submit(c)
+    eng.results()
+    # two buckets, both with >= 3 requests -> exactly two combos
+    assert eng.step_compiles == 2
+    before = eng.step_compiles
+    for c in cfgs:
+        eng.submit(c)
+    recs = eng.results()
+    assert eng.step_compiles == before  # warm reuse across waves
+    assert sum(r["status"] == "ok" for r in recs) == 2 * len(cfgs)
+
+
+# --- admission / rejection --------------------------------------------------
+
+
+def test_bucket_overflow_is_a_per_request_rejection():
+    eng = Engine(quiet(buckets=(32, 64)))
+    big = eng.submit(HeatConfig(n=100, ntime=5))
+    ok = eng.submit(HeatConfig(n=16, ntime=5, dtype="float64"))
+    recs = {r["id"]: r for r in eng.results()}
+    assert recs[big]["status"] == "rejected"
+    assert "bucket-overflow" in recs[big]["error"]
+    assert recs[ok]["status"] == "ok"  # the engine kept serving
+
+
+def test_periodic_bc_rejected_not_mis_served():
+    eng = Engine(quiet(buckets=(32,)))
+    rid = eng.submit(HeatConfig(n=16, ntime=5, bc="periodic"))
+    rec = eng.results()[0]
+    assert rec["status"] == "rejected" and "periodic" in rec["error"]
+    assert rid == rec["id"]
+
+
+def test_bucket_selection_smallest_fit():
+    eng = Engine(quiet(lanes=1, buckets=(64, 16, 32)))
+    rids = [eng.submit(HeatConfig(n=n, ntime=1, dtype="float64"))
+            for n in (16, 17, 33)]
+    recs = {r["id"]: r for r in eng.results()}
+    assert [recs[r]["bucket"] for r in rids] == [16, 32, 64]
+
+
+def test_lane_engine_rejects_periodic_and_bad_geometry():
+    with pytest.raises(ValueError, match="periodic"):
+        LaneEngine(BucketKey(2, 16, "float64", "periodic"), 1, 4)
+    key = BucketKey(2, 16, "float64", "edges")
+    with pytest.raises(ValueError, match="exceeds bucket"):
+        lane_buffer(key, np.ones((32, 32)), 1.0)
+    with pytest.raises(ValueError, match="square"):
+        lane_buffer(key, np.ones((8, 4)), 1.0)
+
+
+def test_queue_wait_and_lane_metadata_recorded():
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,)))
+    for _ in range(3):
+        eng.submit(HeatConfig(n=8, ntime=8, dtype="float64"))
+    recs = eng.results()
+    assert all(r["lane"] == 0 for r in recs)
+    assert all(r["queue_wait_s"] >= 0 for r in recs)
+    assert all(r["steps_per_s"] > 0 for r in recs)
+    # FIFO admission: later submits waited at least as long
+    waits = [r["queue_wait_s"] for r in recs]
+    assert waits == sorted(waits)
+
+
+# --- writeback + fault isolation -------------------------------------------
+
+
+def test_sink_error_fails_one_request_engine_keeps_draining(tmp_path):
+    """Acceptance: a sink-error injected on one request's writeback fails
+    THAT record; the other lanes' results land intact."""
+    out = tmp_path / "results"
+    eng = Engine(quiet(lanes=2, chunk=4, buckets=(32,), out_dir=str(out),
+                       keep_fields=True))
+    good1 = eng.submit(HeatConfig(n=16, ntime=10, dtype="float64"))
+    bad = eng.submit(HeatConfig(n=16, ntime=10, dtype="float64",
+                                inject="sink-error@0:times=99"))
+    good2 = eng.submit(HeatConfig(n=16, ntime=10, dtype="float64", nu=0.1))
+    recs = {r["id"]: r for r in eng.results()}
+    assert recs[bad]["status"] == "error"
+    assert "injected transient sink error" in recs[bad]["error"]
+    assert not (out / f"{bad}.npz").exists()
+    for rid in (good1, good2):
+        assert recs[rid]["status"] == "ok"
+        with np.load(out / f"{rid}.npz") as z:
+            np.testing.assert_array_equal(z["T"], recs[rid]["T"])
+
+
+def test_transient_sink_error_recovers_via_writer_retry(tmp_path):
+    """times=1 is within SnapshotWriter's bounded retry budget: the
+    request must end ok, not error."""
+    out = tmp_path / "results"
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(32,), out_dir=str(out)))
+    rid = eng.submit(HeatConfig(n=16, ntime=10, dtype="float64",
+                                inject="sink-error@0:times=1"))
+    rec = eng.results()[0]
+    assert rec["status"] == "ok"
+    assert (out / f"{rid}.npz").exists()
+
+
+def test_result_files_atomic_and_loadable(tmp_path):
+    out = tmp_path / "r"
+    cfg = HeatConfig(n=12, ntime=6, dtype="float64")
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,), out_dir=str(out)))
+    rid = eng.submit(cfg)
+    eng.results()
+    assert not list(out.glob("*.tmp"))  # atomic publish: no torn temps
+    with np.load(out / f"{rid}.npz") as z:
+        np.testing.assert_array_equal(z["T"], solve(cfg).T)
+        assert int(z["step"]) == cfg.ntime
+
+
+# --- request JSONL + CLI ----------------------------------------------------
+
+
+def test_config_from_request_validates_and_coerces():
+    cfg = config_from_request({"id": "x", "n": 24.0, "ntime": 7,
+                               "nu": 0.1, "bc": "ghost"})
+    assert cfg.n == 24 and isinstance(cfg.n, int)
+    assert cfg.nu == 0.1 and cfg.bc == "ghost"
+    with pytest.raises(ValueError, match="unknown request key"):
+        config_from_request({"n": 8, "backend": "pallas"})
+    with pytest.raises(ValueError):
+        config_from_request({"n": 8, "dtype": "float16"})
+
+
+def test_serve_cli_end_to_end(tmp_cwd, capsys):
+    from heat_tpu.cli import main
+
+    reqs = tmp_cwd / "reqs.jsonl"
+    reqs.write_text(
+        "# comment line\n"
+        '{"id": "a", "n": 24, "ntime": 16, "dtype": "float64"}\n'
+        "\n"
+        '{"n": 40, "ntime": 8, "bc": "ghost", "ic": "uniform", '
+        '"dtype": "float64"}\n')
+    rc = main(["serve", "--requests", "reqs.jsonl", "--lanes", "2",
+               "--chunk", "8", "--buckets", "32,48", "--out-dir", "res",
+               "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    records = [json.loads(l) for l in out.splitlines()
+               if l.startswith("{") and '"serve_request"' in l]
+    assert {r["id"] for r in records} == {"a", "req-0001"}
+    assert all(r["status"] == "ok" for r in records)
+    # the library-visible result equals the solo run of the same request
+    with np.load(tmp_cwd / "res" / "a.npz") as z:
+        solo = solve(HeatConfig(n=24, ntime=16, dtype="float64")).T
+        np.testing.assert_array_equal(z["T"], solo)
+    assert "served 2 request(s): 2 ok" in out
+
+
+def test_serve_cli_bad_requests_nonzero_exit(tmp_cwd, capsys):
+    from heat_tpu.cli import main
+
+    (tmp_cwd / "reqs.jsonl").write_text(
+        '{"n": 9999, "ntime": 1}\n'      # bucket overflow
+        'garbage\n'                       # parse failure
+        '{"n": 16, "ntime": 2, "dtype": "float64"}\n')
+    rc = main(["serve", "--requests", "reqs.jsonl", "--buckets", "64"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "1 ok" in out and "2 rejected" in out
+
+
+def test_serve_cli_missing_file(tmp_cwd, capsys):
+    from heat_tpu.cli import main
+
+    rc = main(["serve", "--requests", "nope.jsonl"])
+    assert rc == 2
+    assert "not found" in capsys.readouterr().err

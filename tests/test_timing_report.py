@@ -1,0 +1,92 @@
+"""Direct coverage for runtime/timing report fields and the CLI --json
+surface of the async-I/O accounting (``overlap_s``/``io_wait_s``) — added
+in the async-pipeline PR but until now only exercised incidentally through
+full CLI runs."""
+
+import json
+
+import numpy as np
+import pytest
+
+from heat_tpu.cli import main
+from heat_tpu.runtime.timing import Timing
+
+
+def test_report_lines_keep_reference_contract_lines():
+    t = Timing(total_s=2.0, solve_s=1.0, steps=10, points=100)
+    lines = t.report_lines()
+    assert lines[0] == "simulation completed!!!!"     # serial/heat.f90:73
+    assert any(l.startswith("total time:") for l in lines)
+    assert any(l.startswith("Average time per timestep:") for l in lines)
+
+
+def test_report_lines_async_overlap_only_when_pipeline_ran():
+    quiet = Timing(total_s=1.0, solve_s=0.5, steps=4, points=16)
+    assert not any("async I/O overlap" in l for l in quiet.report_lines())
+
+    ran = Timing(total_s=1.0, solve_s=0.5, steps=4, points=16,
+                 overlap_s=0.25, io_wait_s=0.125)
+    (line,) = [l for l in ran.report_lines() if "async I/O overlap" in l]
+    assert "0.250000 hidden" in line and "0.125000 blocked" in line
+
+
+def test_report_lines_overlap_with_none_io_wait_renders_zero():
+    # overlap_s set but io_wait_s None (a writer that never blocked the
+    # driver): the line must not crash on the None format
+    t = Timing(total_s=1.0, solve_s=0.5, steps=1, points=1,
+               overlap_s=0.1, io_wait_s=None)
+    (line,) = [l for l in t.report_lines() if "async I/O overlap" in l]
+    assert "0.000000 blocked" in line
+
+
+def test_compile_line_present_only_when_compiled():
+    with_c = Timing(total_s=1.0, compile_s=0.3, solve_s=0.5, steps=1, points=1)
+    without = Timing(total_s=1.0, compile_s=0.0, solve_s=0.5, steps=1, points=1)
+    assert any(l.startswith("compile time:") for l in with_c.report_lines())
+    assert not any(l.startswith("compile time:") for l in without.report_lines())
+
+
+def test_rate_properties_and_zero_guards():
+    t = Timing(total_s=4.0, solve_s=2.0, steps=8, points=100)
+    assert t.per_step_s == pytest.approx(0.25)
+    assert t.points_per_s == pytest.approx(100 * 8 / 2.0)
+    empty = Timing()
+    assert empty.per_step_s == 0.0 and empty.points_per_s == 0.0
+
+
+def _json_record(out: str) -> dict:
+    (line,) = [l for l in out.splitlines() if l.startswith("{")]
+    return json.loads(line)
+
+
+@pytest.fixture
+def input_dat(tmp_cwd):
+    (tmp_cwd / "input.dat").write_text("16 0.25 0.05 2.0 8 0\n")
+    return tmp_cwd
+
+
+def test_cli_json_reports_overlap_fields_when_async_ran(input_dat, capsys):
+    rc = main(["run", "--backend", "xla", "--dtype", "float64",
+               "--checkpoint-every", "2", "--json"])
+    assert rc == 0
+    rec = _json_record(capsys.readouterr().out)
+    # the async writer really ran: both fields present, finite, >= 0
+    assert rec["overlap_s"] >= 0.0
+    assert rec["io_wait_s"] >= 0.0
+    assert np.isfinite(rec["overlap_s"]) and np.isfinite(rec["io_wait_s"])
+
+
+def test_cli_json_omits_overlap_fields_in_sync_mode(input_dat, capsys):
+    rc = main(["run", "--backend", "xla", "--dtype", "float64",
+               "--checkpoint-every", "2", "--async-io", "off", "--json"])
+    assert rc == 0
+    rec = _json_record(capsys.readouterr().out)
+    assert "overlap_s" not in rec and "io_wait_s" not in rec
+
+
+def test_cli_json_omits_overlap_fields_without_checkpointing(input_dat,
+                                                            capsys):
+    rc = main(["run", "--backend", "xla", "--dtype", "float64", "--json"])
+    assert rc == 0
+    rec = _json_record(capsys.readouterr().out)
+    assert "overlap_s" not in rec and "io_wait_s" not in rec
