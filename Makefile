@@ -69,7 +69,8 @@ topology-schedule:     # multi-chip schedule census (overlap evidence)
 topology-validate:     # cross-chip machine-model compile validation
 	$(PY) benchmarks/topology_validate.py
 
-serve-lab:             # continuous-batching engine vs sequential solos A/B
+serve-lab:             # serving A/B: dispatch-ahead vs sync fallback vs
+                       # sequential solos (boundary-wait + device-idle est.)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_lab.py
 
 sweep:                 # flap-tolerant full chip queue

@@ -91,9 +91,12 @@ phase compile_bisect32 2000 python benchmarks/compile_bisect.py --ks 32 --timeou
 # chip, so it runs even when the tunnel is down; keep it last so chip
 # phases get the budget first.
 phase recovery_lab     1200 env JAX_PLATFORMS=cpu python benchmarks/recovery_lab.py
-# Serving-engine A/B (ISSUE 3): 64 mixed-size requests through the
-# continuous-batching engine vs sequential solos — aggregate throughput
-# ratio + one-compile-per-(bucket,lane-count) accounting + bit-identity
-# spot-check. CPU-world like recovery_lab: runs even with the tunnel down.
+# Serving-engine A/B (ISSUE 3 + 4): 64 mixed-size requests, three ways —
+# dispatch-ahead engine (pipelined boundaries, async extraction) vs the
+# synchronous fallback (--dispatch-depth off) vs sequential solos.
+# Reports aggregate throughput ratios, boundary-wait wall, estimated
+# device-idle fraction, one-compile-per-(bucket,lane-tier) accounting,
+# and a bit-identity spot-check on BOTH engine modes. CPU-world like
+# recovery_lab: runs even with the tunnel down.
 phase serve_lab        1200 env JAX_PLATFORMS=cpu python benchmarks/serve_lab.py
 echo "=== extras_r5c done at $(date)"

@@ -77,20 +77,27 @@ def chunk_sizes(cfg: HeatConfig, remaining: int) -> list[int]:
 
 
 def aot_compile_chunks(advance, example, sizes, compiled=None):
-    """AOT-compile ``advance(example, k)`` for every chunk size ``k`` in
-    ``sizes`` not already covered; returns ``(compiled, seconds)``.
+    """AOT-compile ``advance(example..., k)`` for every chunk size ``k``
+    in ``sizes`` not already covered; returns ``(compiled, seconds)``.
 
     The ONE compile path for chunked step programs: ``drive``'s warmup and
     the serving engine's lane programs (serve/engine.py) both go through
     here, so no compile ever lands inside a timed region and compile
     bookkeeping (guard hand-off, serve's one-per-bucket accounting) stays a
     dict of size -> executable everywhere.
+
+    ``example`` is a single array for the solo drive shape
+    (``advance(T, k)``) or a TUPLE of arrays for multi-argument programs
+    (the serve engine's ``advance(fields, r, n, remaining, k)`` — its
+    leaves are donated selectively, which a single pytree argument cannot
+    express); a tuple is splatted into ``lower``.
     """
     compiled = dict(compiled or {})
+    args = example if isinstance(example, tuple) else (example,)
     t0 = time.perf_counter()
     for k in sizes:
         if k not in compiled:
-            compiled[k] = advance.lower(example, k).compile()
+            compiled[k] = advance.lower(*args, k).compile()
     return compiled, time.perf_counter() - t0
 
 

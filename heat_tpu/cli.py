@@ -145,6 +145,14 @@ def build_parser() -> argparse.ArgumentParser:
                        help="comma-separated grid-side buckets; a request "
                             "is padded up to the smallest side that fits "
                             "(default 256,512,1024)")
+    serve.add_argument("--dispatch-depth", default="on", metavar="on|off|N",
+                       help="chunk programs kept in flight per bucket "
+                            "group: the boundary D2H + bookkeeping of "
+                            "chunk i overlap chunk i+1's compute instead "
+                            "of fencing it. 'on' (default) = 2; N >= 1 "
+                            "sets the depth explicitly; 'off' = fully "
+                            "synchronous fallback for debugging (fence "
+                            "every boundary, PR-3 behavior)")
     serve.add_argument("--out-dir", metavar="DIR",
                        help="write each result as DIR/<id>.npz (atomic "
                             "publish); default: results stay in memory")
@@ -380,6 +388,7 @@ def cmd_serve(args) -> int:
     """
     import json as _json
 
+    from .config import parse_dispatch_depth
     from .serve import ServeConfig, serve_requests
 
     path = Path(args.requests)
@@ -389,7 +398,9 @@ def cmd_serve(args) -> int:
     try:
         buckets = tuple(int(b) for b in str(args.buckets).split(",") if b)
         scfg = ServeConfig(lanes=args.lanes, chunk=args.chunk,
-                           buckets=buckets, out_dir=args.out_dir)
+                           buckets=buckets, out_dir=args.out_dir,
+                           dispatch_depth=parse_dispatch_depth(
+                               args.dispatch_depth))
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -398,8 +409,15 @@ def cmd_serve(args) -> int:
     master_print(f"served {summary['requests']} request(s): {ok} ok, "
                  f"{summary.get('rejected', 0)} rejected, "
                  f"{summary.get('error', 0)} failed "
-                 f"({summary['step_compiles']} stepping compile(s), "
+                 f"({summary['step_compiles']} stepping + "
+                 f"{summary['tail_compiles']} tail compile(s), "
                  f"{summary['compile_s']:.3f}s compiling)")
+    master_print(f"dispatch: depth {summary['dispatch_depth']}, "
+                 f"{summary['chunks_dispatched']} chunk(s) "
+                 f"({summary['tail_chunks']} tail), "
+                 f"{summary['boundary_waits']} boundary wait(s) totaling "
+                 f"{summary['boundary_wait_s']:.3f}s, "
+                 f"est. device idle {summary['device_idle_s']:.3f}s")
     if args.json:
         master_print(_json.dumps(summary, sort_keys=True))
     return 0 if ok == summary["requests"] else 1
@@ -758,6 +776,19 @@ def cmd_info(_args) -> int:
         print("gloo CPU collectives: UNAVAILABLE (pre-gloo jaxlib) — "
               "multi-process CPU worlds cannot compile cross-process "
               "programs; `heat-tpu launch` sharded runs will fail")
+
+    # serve execution defaults: what a `heat-tpu serve` run will do before
+    # any knob is passed (the per-run counters — chunks dispatched,
+    # boundary waits, tail chunks — print on every serve invocation and in
+    # Engine.summary(); this line is the static half of that story)
+    from .serve import ServeConfig
+    from .serve.engine import tail_size
+
+    _sd = ServeConfig()
+    print(f"serve defaults: dispatch depth 2 (pipelined; --dispatch-depth "
+          f"off = sync fallback), {_sd.lanes} lanes (power-of-two tiers), "
+          f"chunk {_sd.chunk} (+{tail_size(_sd.chunk)}-step tail program, "
+          f"compiled on first use), buckets {','.join(map(str, _sd.buckets))}")
 
     # persistent compile cache: which programs are already warm (serve
     # buckets, backend advance programs, guard probes all land here) —

@@ -245,6 +245,32 @@ _REQUEST_KEYS = ("n", "sigma", "nu", "dom_len", "ntime", "ndim", "dtype",
                  "ic", "bc", "bc_value", "inject")
 
 
+def parse_dispatch_depth(v) -> int:
+    """``--dispatch-depth`` grammar (serve CLI): ``on`` -> 2 (the default
+    pipeline: inspect chunk i's boundary while chunk i+1 computes),
+    ``off`` -> 0 (fully synchronous debugging fallback — fence every
+    boundary, extract on the scheduler thread), an integer N >= 1 -> keep
+    N chunk programs in flight per bucket group. Deeper pipelines only
+    help when boundary bookkeeping outlasts a whole chunk; each extra
+    level delays lane swaps by one chunk, so 2 is almost always right."""
+    s = str(v).strip().lower()
+    if s == "on":
+        return 2
+    if s == "off":
+        return 0
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"--dispatch-depth must be 'on', 'off', or an integer >= 1, "
+            f"got {v!r}") from None
+    if n < 1:
+        raise ValueError(
+            f"--dispatch-depth integer form must be >= 1 (use 'off' for "
+            f"the synchronous fallback), got {n}")
+    return n
+
+
 def config_from_request(d) -> HeatConfig:
     """Build a HeatConfig from one parsed serve-request object.
 

@@ -229,3 +229,18 @@ def device_snapshot(T):
     if isinstance(T, jax.Array):
         return jnp.copy(T)
     return np.array(T)
+
+
+def lane_snapshot(stacked, lane: int):
+    """One-LANE on-device copy out of a stacked ``(L, ...)`` lane array
+    (``device_snapshot``'s shape for the serving engine's dispatch-ahead
+    extraction): the gather enqueues behind the chunks already in flight
+    and produces its own buffer, detached from the donation chain, so the
+    scheduler resumes dispatching immediately and only the writer thread
+    ever blocks on the D2H. One lane, not the stack — a finished 256-side
+    lane must not drag the other L-1 lanes' bytes across the link."""
+    import jax
+
+    if isinstance(stacked, jax.Array):
+        return stacked[lane]
+    return np.array(stacked[lane])

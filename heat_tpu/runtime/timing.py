@@ -126,6 +126,15 @@ class Timing:
     # stepping; the remaining flush overlaps nothing).
     overlap_s: float | None = None
     io_wait_s: float | None = None
+    # Serving-engine dispatch accounting (None outside `heat-tpu serve`).
+    # dispatch_depth: chunk programs kept in flight per bucket group (0 =
+    # the synchronous fallback). boundary_wait_s: host wall actually spent
+    # blocked on chunk-boundary remaining-vector fetches — under
+    # dispatch-ahead the transfer overlaps the chunks queued behind it,
+    # so this should be a small fraction of solve_s; under the sync
+    # fallback it fences the whole chunk and approaches solve_s.
+    dispatch_depth: int | None = None
+    boundary_wait_s: float | None = None
 
     @property
     def per_step_s(self) -> float:
@@ -149,4 +158,7 @@ class Timing:
         if self.overlap_s is not None:
             lines.append(f"async I/O overlap: {self.overlap_s:.6f} hidden, "
                          f"{self.io_wait_s or 0.0:.6f} blocked")
+        if self.dispatch_depth is not None:
+            lines.append(f"serve dispatch: depth {self.dispatch_depth}, "
+                         f"boundary wait {self.boundary_wait_s or 0.0:.6f}")
         return lines

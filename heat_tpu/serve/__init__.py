@@ -13,14 +13,18 @@ paper's FTCS stencil:
   program (masked lanes step too; their results are ignored).
 - ``scheduler.py`` — the host half: admission queue, shape bucketing
   (requests padded up to a small set of grid buckets so there is at most
-  one stepping-program compile per bucket x lane-count), and continuous
-  batching at chunk boundaries — a finished lane's result goes to the
-  async writeback pipeline and a queued request takes the freed lane
-  without recompiling or stalling the other lanes.
+  one stepping-program compile per bucket x lane-tier), and
+  *dispatch-ahead* continuous batching — a configurable depth of chunk
+  programs stays in flight per group while the scheduler inspects the
+  oldest boundary, finished lanes hand a one-lane device snapshot to the
+  async writeback pipeline without stopping the stepping, and chunk
+  dispatch round-robins across bucket groups so one group's bookkeeping
+  hides under another's compute.
 - ``api.py``       — the request JSONL contract and the ``heat-tpu
   serve`` entry point.
 """
 
-from .engine import BucketKey, LaneEngine, lane_buffer  # noqa: F401
+from .engine import (BucketKey, LaneEngine, lane_buffer,  # noqa: F401
+                     lane_tier, tail_size)
 from .scheduler import Engine, Request, ServeConfig  # noqa: F401
 from .api import load_requests, serve_requests  # noqa: F401

@@ -12,7 +12,10 @@ the same-named ``HeatConfig`` fields (``config.config_from_request``):
 to the ``HeatConfig`` defaults. Unknown keys are a per-request rejection
 (typos must not silently serve different physics). The engine pads each
 request up to the smallest configured bucket side and serves same-bucket
-requests as vmapped lanes (see scheduler.py / engine.py).
+requests as vmapped lanes under dispatch-ahead continuous batching (see
+scheduler.py / engine.py); execution knobs — ``--lanes``, ``--chunk``,
+``--buckets``, ``--dispatch-depth`` — are engine policy, never request
+payload.
 """
 
 from __future__ import annotations

@@ -39,7 +39,23 @@ chunk) — the scheduler fixes lane-count and chunk per engine, so serving
 any number of requests costs at most ONE stepping compile per bucket x
 lane-count, plus one trivial lane-swap program per bucket (the swap takes
 the lane index as a traced scalar precisely so refilling lane 3 vs lane 7
-is the same executable).
+is the same executable). Lane counts are rounded up to power-of-two
+*tiers* (``lane_tier``) so waves of 3 and then 5 requests under the same
+``--lanes`` cap land on one compiled program instead of two, and a lazily
+compiled *tail* program (``chunk // 4`` steps) bounds the masked waste
+when every live lane is about to finish — one tail compile per
+(bucket, lane-tier), only paid when a tail is actually dispatched.
+
+Dispatch discipline (the PR-4 rework): stepping no longer fences.
+``dispatch_chunk`` enqueues one chunk program and returns a *device*
+handle to the post-chunk remaining-step vector without any host
+round-trip; ``fetch_remaining`` is the only boundary D2H, and the
+scheduler calls it on a handle whose chunk was dispatched one or more
+chunks ago — the transfer overlaps the chunks queued behind it. The
+per-lane scalars (r, side, remaining) are deliberately NOT donated into
+the chunk program so an old remaining-handle stays valid while newer
+chunks consume the field stack; only the (L, B+2, ...) field buffer —
+the allocation that matters — ping-pongs through donation.
 """
 
 from __future__ import annotations
@@ -47,7 +63,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -58,6 +74,38 @@ from ..utils import jnp_dtype
 # request cell (offset 0), edges freezes the outermost request ring
 # (offset 1). periodic is absent by design (see module docstring).
 _BC_LO = {"ghost": 0, "edges": 1}
+
+
+def host_fetch(x) -> np.ndarray:
+    """The ONE device->host fetch seam of the serve hot path.
+
+    Every boundary inspection and lane extraction funnels through here so
+    tests can monkeypatch it to prove the dispatch path never fences
+    (ISSUE 4 regression contract) and to count fetches per boundary."""
+    return np.asarray(x)
+
+
+def lane_tier(needed: int, cap: int) -> int:
+    """Round a wave's lane need up to the next power-of-two tier, capped
+    at the configured lane budget. Waves of 3 then 5 requests under
+    ``cap=4`` both land on tier 4 — one compiled stepping program where
+    ``min(lanes, len(q))`` would have compiled two."""
+    if needed < 1 or cap < 1:
+        raise ValueError(f"needed/cap must be >= 1, got {needed}/{cap}")
+    t = 1
+    while t < needed:
+        t <<= 1
+    return min(cap, t)
+
+
+def tail_size(chunk: int) -> Optional[int]:
+    """Size of the one precompiled tail program per (bucket, lane-tier):
+    a quarter chunk (>= 1). When every live lane's remaining count drops
+    below ``chunk``, stepping ``ceil(rem / tail)`` tail chunks computes at
+    most ``rem + tail - 1`` masked steps instead of a full ``chunk`` —
+    bounded waste for one extra (lazily compiled) program. ``None`` for
+    chunk 1, where a tail cannot be smaller than the chunk."""
+    return chunk // 4 if chunk >= 4 else (1 if chunk > 1 else None)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -121,10 +169,13 @@ def _lane_step(T, r, n, lo: int):
 
 
 def make_lane_advance(key: BucketKey):
-    """The jitted chunk program for one bucket: ``advance(state, k)`` runs
-    ``k`` masked steps over every lane. ``state`` is the flat lane pytree
-    ``(fields, r, n, remaining)``; donated, so the double buffer ping-pongs
-    like the solo drive loop's."""
+    """The jitted chunk program for one bucket: ``advance(fields, r, n,
+    remaining, k)`` runs ``k`` masked steps over every lane. Only the
+    field stack is donated (the buffer that matters — it ping-pongs like
+    the solo drive loop's double buffer); the per-lane scalars are left
+    undonated on purpose, so a remaining-step handle taken after chunk
+    ``i`` survives while chunks ``i+1..`` are dispatched behind it — the
+    foundation of the dispatch-ahead boundary (scheduler.py)."""
     import jax
     import jax.numpy as jnp
 
@@ -133,10 +184,8 @@ def make_lane_advance(key: BucketKey):
                         in_axes=(0, 0, 0))
     ndim = key.ndim
 
-    @functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
-    def advance(state, k: int):
-        fields, r, n, remaining = state
-
+    @functools.partial(jax.jit, static_argnums=(4,), donate_argnums=(0,))
+    def advance(fields, r, n, remaining, k: int):
         def body(_, carry):
             f, rem = carry
             stepped = step_all(f, r, n)
@@ -153,13 +202,13 @@ def make_lane_advance(key: BucketKey):
 def make_lane_loader(key: BucketKey):
     """The jitted lane-swap program: replace lane ``lane`` (a TRACED scalar
     — one compile covers every lane index) with a new request's buffer and
-    scalars. Donated like ``advance`` so swapping never copies the other
-    lanes."""
+    scalars. The field stack is donated like ``advance``'s so swapping
+    never copies the other lanes; the scalar vectors are tiny and stay
+    undonated for the same handle-liveness reason."""
     import jax
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def load(state, lane, buf, r_new, n_new, steps_new):
-        fields, r, n, remaining = state
+    def load(fields, r, n, remaining, lane, buf, r_new, n_new, steps_new):
         fields = jax.lax.dynamic_update_index_in_dim(fields, buf, lane, 0)
         return (fields, r.at[lane].set(r_new), n.at[lane].set(n_new),
                 remaining.at[lane].set(steps_new))
@@ -168,16 +217,23 @@ def make_lane_loader(key: BucketKey):
 
 
 class LaneEngine:
-    """Device-side lane state for ONE (bucket, lane-count) combination.
+    """Device-side lane state for ONE (bucket, lane-tier) combination.
 
-    The scheduler owns admission and swap policy; this class owns the
-    arrays and the compiled programs. All methods treat the state
-    linearly (every call consumes and replaces it — the buffers are
-    donated into each jitted program).
+    The scheduler owns admission, dispatch depth, and swap policy; this
+    class owns the arrays and the compiled programs. All methods treat
+    the field stack linearly (every stepping/loading call consumes and
+    replaces it — the buffer is donated into each jitted program).
+
+    Stepping programs (the steady ``chunk`` and the optional ``tail``)
+    compile lazily through ``_ensure`` against a shared ``compiled_cache``
+    keyed by (bucket, lane-tier, k); ``on_compile(k, seconds)`` fires for
+    every program actually built so the scheduler's compile accounting
+    (one stepping compile per combo, plus at most one tail) stays exact.
     """
 
     def __init__(self, key: BucketKey, lanes: int, chunk: int,
-                 compiled_cache: Optional[Dict] = None):
+                 compiled_cache: Optional[Dict] = None,
+                 on_compile: Optional[Callable[[int, float], None]] = None):
         import jax.numpy as jnp
 
         if key.bc not in _BC_LO:
@@ -189,6 +245,7 @@ class LaneEngine:
         self.key = key
         self.lanes = lanes
         self.chunk = chunk
+        self.tail = tail_size(chunk)
         dt = jnp_dtype(key.dtype)
         acc = accum_dtype_for(dt)
         self._state = (
@@ -198,49 +255,94 @@ class LaneEngine:
             jnp.zeros((lanes,), dtype=jnp.int32),    # per-lane steps left
         )
         self._load = make_lane_loader(key)
-        # AOT-compile the stepping program (shared across engines through
-        # compiled_cache — the scheduler passes one dict per serve run so
-        # the (bucket, lane-count) compile really happens at most once)
+        self._advance_fn = make_lane_advance(key)
+        self._cache = compiled_cache if compiled_cache is not None else {}
+        self._on_compile = on_compile
         self.compile_s = 0.0
-        cache = compiled_cache if compiled_cache is not None else {}
-        ckey = (key, lanes, chunk)
-        if ckey not in cache:
+        # the steady chunk program compiles up front (before any request
+        # is admitted into a lane) — the tail program waits for first use
+        self._ensure(chunk)
+
+    def _ensure(self, k: int):
+        """Compiled executable for a k-step program, built at most once
+        per (bucket, lane-tier, k) across the scheduler's shared cache."""
+        ckey = (self.key, self.lanes, k)
+        if ckey not in self._cache:
             from ..backends.common import aot_compile_chunks
 
-            advance = make_lane_advance(key)
-            compiled, self.compile_s = aot_compile_chunks(
-                advance, self._state, [chunk])
-            cache[ckey] = compiled[chunk]
-        self._advance = cache[ckey]
+            compiled, spent = aot_compile_chunks(
+                self._advance_fn, self._state, [k])
+            self._cache[ckey] = compiled[k]
+            self.compile_s += spent
+            if self._on_compile is not None:
+                self._on_compile(k, spent)
+        return self._cache[ckey]
 
     # --- lane I/O ---------------------------------------------------------
     def load_lane(self, lane: int, field: np.ndarray, r: float,
                   steps: int, bc_value: float) -> None:
         """Install one request into ``lane``: pad the host field into a
-        bucket buffer and swap it in (one traced-index program)."""
-        import jax.numpy as jnp
+        bucket buffer and swap it in (one traced-index program).
 
+        The buffer and scalars are converted with NUMPY and handed to the
+        jitted loader raw: every ``jnp.asarray``/``jnp.int32`` here would
+        be an eager device op — a python-dispatch round trip per argument
+        per admission, plus a one-time XLA compile per (shape, dtype) —
+        on the serve hot path. The loader's own dispatch does the H2D.
+        (numpy handles the bfloat16 cast through ml_dtypes, with the same
+        round-to-nearest-even the XLA convert would apply.)"""
         dt = jnp_dtype(self.key.dtype)
         acc = accum_dtype_for(dt)
-        buf = jnp.asarray(lane_buffer(self.key, field, bc_value), dtype=dt)
+        buf = lane_buffer(self.key, field, bc_value).astype(dt)
         self._state = self._load(
-            self._state, jnp.int32(lane), buf,
-            jnp.asarray(r, acc), jnp.int32(field.shape[0]),
-            jnp.int32(steps))
+            *self._state, np.int32(lane), buf,
+            np.asarray(r, acc), np.int32(field.shape[0]),
+            np.int32(steps))
 
-    def extract_lane(self, lane: int, n: int) -> np.ndarray:
-        """Fetch one finished lane's request field to host (D2H of a single
-        lane; the scheduler hands the result to the async writeback)."""
-        buf = np.asarray(self._state[0][lane])
+    def snapshot_lane(self, lane: int):
+        """One-lane ON-DEVICE copy of a finished lane (the PR-1 snapshot
+        trick, one lane wide): enqueued behind whatever chunks are in
+        flight and detached from the donation chain, so stepping resumes
+        immediately and the writer thread fetches at its leisure."""
+        from ..runtime.async_io import lane_snapshot
+
+        return lane_snapshot(self._state[0], lane)
+
+    def extract(self, snap, n: int) -> np.ndarray:
+        """D2H a lane snapshot and crop it to the request's field. This is
+        the transfer the dispatch-ahead rework moved OFF the scheduler
+        thread — call it from the writer thread."""
+        buf = host_fetch(snap)
         return buf[tuple(slice(1, 1 + n) for _ in range(self.key.ndim))]
 
+    def extract_lane(self, lane: int, n: int) -> np.ndarray:
+        """Synchronous one-lane fetch (the --dispatch-depth off fallback
+        and library spelunking; blocks on every chunk in flight)."""
+        return self.extract(self.snapshot_lane(lane), n)
+
     # --- stepping ---------------------------------------------------------
+    def dispatch_chunk(self, k: Optional[int] = None):
+        """Enqueue one k-step program (default: the steady chunk) over
+        every lane and return a DEVICE handle to the post-chunk
+        remaining-step vector — no host round trip, no fence. The handle
+        stays valid under later dispatches because the scalar leaves are
+        never donated."""
+        fn = self._ensure(self.chunk if k is None else k)
+        self._state = fn(*self._state)
+        return self._state[3]
+
+    def fetch_remaining(self, handle) -> np.ndarray:
+        """The boundary D2H: fetch a remaining-step handle to host. With
+        dispatch depth > 1 the scheduler calls this on a chunk dispatched
+        one or more chunks ago, so the transfer (and the bookkeeping it
+        gates) hides under the chunks queued behind it."""
+        return host_fetch(handle)
+
     def step_chunk(self) -> np.ndarray:
-        """Run one ``chunk``-step program over every lane; returns the
-        per-lane remaining-step counts (host, (L,) int32 — the only fetch
-        the boundary needs). The fetch doubles as the chunk fence."""
-        self._state = self._advance(self._state)
-        return np.asarray(self._state[3])
+        """Dispatch one steady chunk and immediately fetch its remaining
+        vector — the synchronous boundary (``--dispatch-depth off``); the
+        fetch doubles as the chunk fence."""
+        return self.fetch_remaining(self.dispatch_chunk())
 
     def remaining(self) -> np.ndarray:
         return np.asarray(self._state[3])

@@ -1,4 +1,4 @@
-"""Admission queue + shape bucketing + continuous batching (host half).
+"""Admission queue + shape bucketing + dispatch-ahead continuous batching.
 
 The serving contract, in the shape of an inference server's scheduler:
 
@@ -9,16 +9,38 @@ The serving contract, in the shape of an inference server's scheduler:
   serving must not let one bad request take down the queue.
 - **Bucketing**: requests are grouped by ``BucketKey`` (ndim, smallest
   bucket side that fits, dtype, BC). One group = one stacked lane array =
-  at most one stepping-program compile per (bucket, lane-count) no matter
-  how many requests flow through it.
-- **Continuous batching**: the chunk loop never stops for a single lane.
-  At each chunk boundary the scheduler fetches the (L,) remaining-step
-  vector — the only per-boundary D2H — extracts finished lanes, hands
-  their fields to the async writeback pipeline (``runtime/async_io``,
-  the same bounded-queue writer the checkpoint path uses), and swaps
-  queued requests into the freed lanes while the other lanes keep their
-  state. This is Orca-style iteration-level scheduling (PAPERS.md) with
-  the FTCS chunk as the iteration.
+  at most one stepping-program compile per (bucket, lane-tier) no matter
+  how many requests flow through it — lane counts round UP to power-of-two
+  tiers (``engine.lane_tier``) so uneven waves share programs.
+- **Continuous batching, dispatch-ahead**: the chunk loop never stops for
+  a single lane, and (the PR-4 rework) the device never waits on the host
+  between chunks. The scheduler keeps ``dispatch_depth`` chunk programs in
+  flight per group and inspects the remaining-step vector of the OLDEST
+  one — fetched while the newer chunks compute behind it, so the
+  boundary's D2H and python bookkeeping overlap device work instead of
+  fencing it. Finished lanes take a one-lane on-device snapshot
+  (``runtime/async_io.lane_snapshot``) and stepping resumes immediately;
+  the D2H + result write happen wholly in the ``SnapshotWriter`` thread.
+  ``Engine.run`` round-robins chunk dispatch across all live bucket
+  groups, so one group's boundary bookkeeping hides under another group's
+  compute. ``dispatch_depth=0`` is the fully synchronous debugging
+  fallback (fetch-every-boundary, extraction on the scheduler thread —
+  the PR-3 shape).
+- **Determinism of the boundary**: the device decrements each lane's
+  remaining count by exactly one per step while positive, so the host
+  mirrors the countdown and PREDICTS every chunk's post-step vector at
+  dispatch time. Prediction drives dispatch policy (is another chunk
+  useful; steady chunk vs tail); the fetched vector stays the ground
+  truth for finishing lanes — and must equal the prediction, enforced
+  per boundary (a divergence means the masking contract broke, and a
+  serving engine must never silently mis-serve). Lanes whose occupant
+  was swapped in after a chunk was dispatched are guarded by a per-lane
+  epoch: a stale in-flight chunk cannot "finish" the new occupant.
+- **Tail chunks**: when every live lane's remaining count has dropped
+  below the chunk (and far enough that it saves compute), the group
+  dispatches a lazily-precompiled quarter-chunk tail program instead of
+  a mostly-masked full chunk — at most ONE extra compile per
+  (bucket, lane-tier), waste bounded by the tail size.
 - **Fault isolation**: an injected or real sink failure on one request's
   writeback (``sink-error`` in runtime/faults.py grammar) fails THAT
   request's record; transient errors still ride the writer's bounded
@@ -27,13 +49,17 @@ The serving contract, in the shape of an inference server's scheduler:
 
 Per-request structured JSON records (queue wait, steps/s, lane id) go
 through ``runtime/logging``; each request also keeps a python-level record
-for library callers (``Engine.results()``).
+for library callers (``Engine.results()``). Records are mutated from both
+the scheduler thread and the writer thread — one engine-wide lock guards
+every record mutation and every ``json_record`` emission so JSON lines
+cannot interleave mid-line.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -42,7 +68,7 @@ from ..config import HeatConfig
 from ..grid import initial_condition
 from ..runtime import async_io, faults
 from ..runtime.logging import json_record
-from .engine import BucketKey, LaneEngine, wall_clock
+from .engine import BucketKey, LaneEngine, lane_tier, wall_clock
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,10 +76,19 @@ class ServeConfig:
     """Engine-level knobs (the per-request physics lives in HeatConfig)."""
 
     lanes: int = 4            # max concurrent requests per bucket group
+                              # (waves round up to power-of-two tiers
+                              # capped here — see engine.lane_tier)
     chunk: int = 16           # steps per device program call (the swap
                               # granularity of continuous batching)
     buckets: tuple = (256, 512, 1024)  # grid-side buckets; a request is
                               # padded up to the smallest side that fits
+    dispatch_depth: int = 2   # chunk programs kept in flight per group
+                              # before the scheduler blocks on a boundary
+                              # fetch; 1 = fetch the chunk just dispatched
+                              # (pipelined bookkeeping only), 0 = fully
+                              # synchronous fallback for debugging (the
+                              # PR-3 fence-every-chunk shape, extraction
+                              # on the scheduler thread)
     out_dir: Optional[str] = None  # writeback directory (<id>.npz); None =
                               # results kept in-memory on the records
     keep_fields: bool = False  # keep final fields on records even when
@@ -65,6 +100,9 @@ class ServeConfig:
             raise ValueError(f"lanes must be >= 1, got {self.lanes}")
         if self.chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {self.chunk}")
+        if self.dispatch_depth < 0:
+            raise ValueError(f"dispatch_depth must be >= 0 (0 = sync "
+                             f"fallback), got {self.dispatch_depth}")
         if not self.buckets or any(b < 3 for b in self.buckets):
             raise ValueError(f"buckets must be sides >= 3, got {self.buckets}")
 
@@ -103,6 +141,167 @@ def _write_result(out_dir, req_id: str, T: np.ndarray, cfg: HeatConfig):
     return path
 
 
+class _GroupRunner:
+    """Dispatch-ahead continuous batching for ONE bucket group.
+
+    Owns the group's ``LaneEngine``, occupancy, the host-side countdown
+    mirror (``dev_rem`` — exact, because the device decrements remaining
+    by one per step while positive), and the in-flight deque of
+    ``(seq, remaining-handle, predicted-vector)`` chunk boundaries.
+    ``Engine.run`` drives many runners round-robin; each tick dispatches
+    until ``dispatch_depth`` chunks are queued, then takes at most one
+    boundary (the oldest handle).
+    """
+
+    def __init__(self, outer: "Engine", key: BucketKey, q, writer):
+        self.outer = outer
+        self.key = key
+        self.q = q
+        self.writer = writer
+        scfg = outer.scfg
+        self.chunk = scfg.chunk
+        self.depth = max(1, scfg.dispatch_depth)
+        self.lanes = lane_tier(min(len(q), scfg.lanes), scfg.lanes)
+        self.eng = LaneEngine(key, self.lanes, scfg.chunk,
+                              compiled_cache=outer._compiled,
+                              on_compile=outer._note_compile)
+        self.occupant: List[Optional[Request]] = [None] * self.lanes
+        # first dispatch seq whose chunk covers the lane's CURRENT
+        # occupant: an in-flight chunk older than the epoch shows the
+        # PREVIOUS occupant's zeros and must not finish the new one
+        self.epoch = [0] * self.lanes
+        self.dev_rem = np.zeros(self.lanes, dtype=np.int64)
+        self.seq = 0                        # next dispatch's sequence id
+        self.inflight: collections.deque = collections.deque()
+        self.idle_from: Optional[float] = None  # group device queue empty
+                                                # since (boundary gaps only)
+        self._fill()
+
+    # --- admission into lanes --------------------------------------------
+    def _fill(self) -> None:
+        """Swap queued requests into every free lane (continuous
+        batching). The IC build + H2D load run on the scheduler thread,
+        but with chunks in flight they overlap device compute instead of
+        extending a fence."""
+        for lane in range(self.lanes):
+            if self.occupant[lane] is None and self.q:
+                req = self.q.popleft()
+                now = wall_clock()
+                rec = self.outer._by_id[req.id]
+                with self.outer._lock:
+                    rec["lane"] = lane
+                    rec["queue_wait_s"] = round(now - req.submit_t, 6)
+                    rec["status"] = "running"
+                    rec["_start_t"] = now
+                T0 = initial_condition(req.cfg)
+                self.eng.load_lane(lane, T0, float(req.cfg.r),
+                                   req.cfg.ntime, req.cfg.bc_value)
+                self.occupant[lane] = req
+                self.epoch[lane] = self.seq
+                self.dev_rem[lane] = req.cfg.ntime
+
+    def _live_remaining(self) -> List[int]:
+        return [int(self.dev_rem[i]) for i, o in enumerate(self.occupant)
+                if o is not None and self.dev_rem[i] > 0]
+
+    # --- dispatch side ----------------------------------------------------
+    def dispatch_fill(self) -> None:
+        """Queue chunk programs until ``dispatch_depth`` are in flight or
+        no lane has steps left to run. Pure host->device enqueue: no
+        fetch, no fence."""
+        while len(self.inflight) < self.depth:
+            live = self._live_remaining()
+            if not live:
+                break
+            k = self.chunk
+            tail = self.eng.tail
+            if tail is not None and max(live) <= self.chunk - tail:
+                # every live lane finishes inside the chunk, with enough
+                # headroom that ceil(rem/tail) tail programs compute
+                # strictly fewer masked steps than one full chunk
+                k = tail
+                self.outer.tail_chunks += 1
+            handle = self.eng.dispatch_chunk(k)
+            if self.idle_from is not None:
+                self.outer.device_idle_s += wall_clock() - self.idle_from
+                self.idle_from = None
+            np.maximum(self.dev_rem - k, 0, out=self.dev_rem)
+            self.inflight.append(
+                (self.seq, handle, self.dev_rem.astype(np.int32)))
+            self.seq += 1
+            self.outer.chunks_dispatched += 1
+
+    # --- boundary side ----------------------------------------------------
+    def process_boundary(self) -> None:
+        """Take one chunk boundary: fetch the OLDEST in-flight remaining
+        vector (the newer chunks keep computing behind the transfer),
+        retire lanes that finished, refill from the queue."""
+        outer = self.outer
+        if self.inflight:
+            seq, handle, predicted = self.inflight.popleft()
+            t0 = wall_clock()
+            rem = self.eng.fetch_remaining(handle)
+            outer.boundary_wait_s += wall_clock() - t0
+            outer.boundary_waits += 1
+            if not self.inflight:
+                self.idle_from = wall_clock()
+            if not np.array_equal(rem, predicted):
+                raise RuntimeError(
+                    f"serve dispatch-ahead desync for bucket {self.key}: "
+                    f"device remaining {rem.tolist()} != host-predicted "
+                    f"{predicted.tolist()} at chunk {seq} — the lane "
+                    f"masking contract broke; results cannot be trusted")
+            for lane in range(self.lanes):
+                req = self.occupant[lane]
+                if (req is not None and rem[lane] == 0
+                        and seq >= self.epoch[lane]):
+                    outer._finish_async(self.eng, lane, req, self.writer)
+                    self.occupant[lane] = None
+        else:
+            # nothing in flight and nothing left to step: occupants whose
+            # countdown is already settled at zero (ntime=0 admits, or
+            # the final boundary was already inspected) retire directly
+            for lane in range(self.lanes):
+                req = self.occupant[lane]
+                if req is not None and self.dev_rem[lane] == 0:
+                    outer._finish_async(self.eng, lane, req, self.writer)
+                    self.occupant[lane] = None
+        self._fill()
+
+    def has_work(self) -> bool:
+        return (bool(self.inflight) or bool(self.q)
+                or any(o is not None for o in self.occupant))
+
+    # --- synchronous fallback (--dispatch-depth off) ----------------------
+    def run_sync(self) -> None:
+        """The PR-3 shape, kept verbatim for debugging A/Bs: fetch every
+        boundary as its chunk is dispatched (the fetch fences the whole
+        chunk) and extract finished lanes on the scheduler thread. No
+        pipelining, no tail programs."""
+        outer = self.outer
+        while self.has_work():
+            if self._live_remaining():
+                t0 = wall_clock()
+                if self.idle_from is not None:
+                    # device sat idle from the last fetch's return until
+                    # this dispatch — the fence cost the A/B demonstrates
+                    outer.device_idle_s += t0 - self.idle_from
+                rem = self.eng.step_chunk()
+                outer.boundary_wait_s += wall_clock() - t0
+                outer.boundary_waits += 1
+                outer.chunks_dispatched += 1
+                self.idle_from = wall_clock()
+                np.maximum(self.dev_rem - self.chunk, 0, out=self.dev_rem)
+            else:
+                rem = self.dev_rem
+            for lane in range(self.lanes):
+                req = self.occupant[lane]
+                if req is not None and rem[lane] == 0:
+                    outer._finish_sync(self.eng, lane, req, self.writer)
+                    self.occupant[lane] = None
+            self._fill()
+
+
 class Engine:
     """Request-driven batched execution engine (library API).
 
@@ -111,8 +310,8 @@ class Engine:
     >>> records = eng.results()   # drains the queue, returns all records
 
     ``submit`` only enqueues; ``run``/``results`` executes every admitted
-    request to completion via continuous batching and returns the records
-    in submit order.
+    request to completion via dispatch-ahead continuous batching and
+    returns the records in submit order.
     """
 
     def __init__(self, scfg: ServeConfig = ServeConfig()):
@@ -121,13 +320,35 @@ class Engine:
         self._records: List[dict] = []
         self._by_id: Dict[str, dict] = {}
         self._seq = 0
+        # one engine-wide lock: records are mutated and emitted from both
+        # the scheduler thread and the SnapshotWriter thread — JSON lines
+        # must not interleave mid-line and record mutation must not race
+        self._lock = threading.Lock()
         # one compiled-program cache for the engine's lifetime: repeated
         # runs (a long-lived server draining wave after wave) never pay a
-        # second (bucket, lane-count) compile
+        # second (bucket, lane-tier) compile
         self._compiled: Dict = {}
-        self.step_compiles = 0    # stepping programs built (the criterion:
-                                  # at most one per (bucket, lane-count))
+        self.step_compiles = 0    # steady stepping programs built (the
+                                  # criterion: at most one per
+                                  # (bucket, lane-tier))
+        self.tail_compiles = 0    # tail programs built (at most one per
+                                  # (bucket, lane-tier), lazily)
         self.compile_s = 0.0
+        # dispatch-ahead observability (summary()/cmd_serve surface these)
+        self.chunks_dispatched = 0
+        self.tail_chunks = 0
+        self.boundary_waits = 0
+        self.boundary_wait_s = 0.0   # host wall blocked on boundary fetches
+        self.device_idle_s = 0.0     # est. device idle: per-group gaps with
+                                     # nothing in flight at a boundary
+        self.timing = None           # runtime.timing.Timing of the last run
+
+    def _note_compile(self, k: int, seconds: float) -> None:
+        if k == self.scfg.chunk:
+            self.step_compiles += 1
+        else:
+            self.tail_compiles += 1
+        self.compile_s += seconds
 
     # --- admission --------------------------------------------------------
     def submit(self, cfg: HeatConfig, request_id: Optional[str] = None) -> str:
@@ -161,31 +382,67 @@ class Engine:
         return rid
 
     def _reject(self, rec: dict, reason: str) -> None:
-        rec["status"] = "rejected"
-        rec["error"] = reason
+        with self._lock:
+            rec["status"] = "rejected"
+            rec["error"] = reason
         self._emit(rec)
 
     def _emit(self, rec: dict) -> None:
+        """Emit one request record as a JSON line. Called from the
+        scheduler thread (rejections) AND the writer thread (finishes);
+        the lock keeps concurrent lines from interleaving mid-line and
+        snapshots the record fields consistently."""
         if self.scfg.emit_records:
-            json_record("serve_request",
-                        **{k: v for k, v in rec.items() if k != "T"})
+            with self._lock:
+                json_record("serve_request",
+                            **{k: v for k, v in rec.items() if k != "T"})
 
     # --- execution --------------------------------------------------------
     def run(self) -> List[dict]:
-        """Drain every queued request through continuous batching; returns
-        all records (submit order). Reentrant: new submits after a run are
-        served by the next run against warm compiled programs."""
+        """Drain every queued request through dispatch-ahead continuous
+        batching; returns all records (submit order). Reentrant: new
+        submits after a run are served by the next run against warm
+        compiled programs."""
+        from ..runtime.timing import Timing
+
         writer = async_io.SnapshotWriter()
+        t0 = wall_clock()
         try:
-            for key in list(self._queues):
-                q = self._queues[key]
-                if q:
-                    self._run_group(key, q, writer)
+            runners = [
+                _GroupRunner(self, key, self._queues[key], writer)
+                for key in list(self._queues) if self._queues[key]
+            ]
+            if self.scfg.dispatch_depth == 0:
+                # synchronous debugging fallback: groups drain one at a
+                # time with a fence at every boundary (the PR-3 shape)
+                for r in runners:
+                    r.run_sync()
+            else:
+                live = [r for r in runners if r.has_work()]
+                while live:
+                    # prime every group's device queue before anyone
+                    # blocks: one group's boundary D2H + bookkeeping then
+                    # hides under the other groups' queued compute
+                    for r in live:
+                        r.dispatch_fill()
+                    nxt = []
+                    for r in live:
+                        r.process_boundary()
+                        r.dispatch_fill()   # refilled lanes step while the
+                                            # other groups take boundaries
+                        if r.has_work():
+                            nxt.append(r)
+                    live = nxt
         finally:
             # every queued writeback lands (or fails per-request) before
             # results are reported; per-request jobs swallow their own
             # failures, so a surviving writer error here is a real bug
             writer.drain()
+        wall = wall_clock() - t0
+        self.timing = Timing(total_s=wall, solve_s=wall,
+                             compile_s=self.compile_s,
+                             dispatch_depth=self.scfg.dispatch_depth,
+                             boundary_wait_s=round(self.boundary_wait_s, 6))
         return list(self._records)
 
     def results(self) -> List[dict]:
@@ -194,56 +451,23 @@ class Engine:
             self.run()
         return list(self._records)
 
-    def _run_group(self, key: BucketKey, q, writer) -> None:
-        """Continuous-batching loop for one bucket group."""
-        lanes = min(self.scfg.lanes, len(q))
-        ckey = (key, lanes, self.scfg.chunk)
-        fresh = ckey not in self._compiled
-        eng = LaneEngine(key, lanes, self.scfg.chunk,
-                         compiled_cache=self._compiled)
-        if fresh:
-            self.step_compiles += 1
-            self.compile_s += eng.compile_s
-        occupant: List[Optional[Request]] = [None] * lanes
-
-        def fill_free_lanes():
-            for lane in range(lanes):
-                if occupant[lane] is None and q:
-                    req = q.popleft()
-                    now = wall_clock()
-                    rec = self._by_id[req.id]
-                    rec["lane"] = lane
-                    rec["queue_wait_s"] = round(now - req.submit_t, 6)
-                    rec["status"] = "running"
-                    rec["_start_t"] = now
-                    T0 = initial_condition(req.cfg)
-                    eng.load_lane(lane, T0, float(req.cfg.r),
-                                  req.cfg.ntime, req.cfg.bc_value)
-                    occupant[lane] = req
-
-        fill_free_lanes()
-        while any(o is not None for o in occupant):
-            rem = eng.step_chunk()
-            for lane in range(lanes):
-                req = occupant[lane]
-                if req is not None and rem[lane] == 0:
-                    self._finish(eng, lane, req, writer)
-                    occupant[lane] = None
-            fill_free_lanes()   # continuous batching: freed lanes refill
-                                # while the others' state stays put
-
-    def _finish(self, eng: LaneEngine, lane: int, req: Request,
-                writer) -> None:
-        """Extract a finished lane and hand it to the async writeback."""
+    # --- lane retirement --------------------------------------------------
+    def _finish_timing(self, req: Request) -> dict:
         rec = self._by_id[req.id]
         now = wall_clock()
-        start = rec.pop("_start_t", now)
-        rec["solve_s"] = round(now - start, 6)
-        rec["steps_per_s"] = (round(req.cfg.ntime / (now - start), 3)
-                              if now > start else None)
-        T = eng.extract_lane(lane, req.cfg.n)
-        if self.scfg.keep_fields or not self.scfg.out_dir:
-            rec["T"] = T
+        with self._lock:
+            start = rec.pop("_start_t", now)
+            rec["solve_s"] = round(now - start, 6)
+            rec["steps_per_s"] = (round(req.cfg.ntime / (now - start), 3)
+                                  if now > start else None)
+        return rec
+
+    def _writeback_job(self, rec: dict, req: Request, writer,
+                       get_field) -> None:
+        """Build + submit the writer-thread job for one finished request.
+        ``get_field()`` produces the host field — under dispatch-ahead it
+        performs the snapshot D2H *in the writer thread*; the sync
+        fallback passes a host array already fetched."""
         cfg, scfg = req.cfg, self.scfg
         attempts = {"n": 0}
 
@@ -255,25 +479,56 @@ class Engine:
             # not poison writer._exc and kill the other lanes' drain.
             attempts["n"] += 1
             try:
+                T = get_field()
                 plan = faults.plan_for(cfg)
                 if plan is not None:
                     plan.sink_fault(cfg.ntime)
-                if scfg.out_dir:
-                    rec["path"] = str(_write_result(scfg.out_dir, req.id,
-                                                    T, cfg))
-                rec["status"] = "ok"
+                path = (str(_write_result(scfg.out_dir, req.id, T, cfg))
+                        if scfg.out_dir else None)
+                with self._lock:
+                    if scfg.keep_fields or not scfg.out_dir:
+                        rec["T"] = T
+                    if path is not None:
+                        rec["path"] = path
+                    rec["status"] = "ok"
             except BaseException as e:  # noqa: BLE001 — per-request record
                 if async_io.is_transient(e) and attempts["n"] <= writer.retries:
                     raise
-                rec["status"] = "error"
-                rec["error"] = f"{type(e).__name__}: {e}"
+                with self._lock:
+                    rec["status"] = "error"
+                    rec["error"] = f"{type(e).__name__}: {e}"
             self._emit(rec)
 
         writer.submit(job)
+
+    def _finish_async(self, eng: LaneEngine, lane: int, req: Request,
+                      writer) -> None:
+        """Dispatch-ahead retirement: take a one-lane ON-DEVICE snapshot
+        (enqueued behind the in-flight chunks; the scheduler thread never
+        blocks) and move the D2H + writeback wholly into the writer."""
+        rec = self._finish_timing(req)
+        snap = eng.snapshot_lane(lane)
+        n = req.cfg.n
+        self._writeback_job(rec, req, writer, lambda: eng.extract(snap, n))
+
+    def _finish_sync(self, eng: LaneEngine, lane: int, req: Request,
+                     writer) -> None:
+        """Sync-fallback retirement: fetch the lane on the scheduler
+        thread (fences every chunk in flight), write back in the writer."""
+        rec = self._finish_timing(req)
+        T = eng.extract_lane(lane, req.cfg.n)
+        self._writeback_job(rec, req, writer, lambda: T)
 
     # --- reporting --------------------------------------------------------
     def summary(self) -> dict:
         by_status = collections.Counter(r["status"] for r in self._records)
         return {"requests": len(self._records), **dict(by_status),
                 "step_compiles": self.step_compiles,
-                "compile_s": round(self.compile_s, 3)}
+                "tail_compiles": self.tail_compiles,
+                "compile_s": round(self.compile_s, 3),
+                "dispatch_depth": self.scfg.dispatch_depth,
+                "chunks_dispatched": self.chunks_dispatched,
+                "tail_chunks": self.tail_chunks,
+                "boundary_waits": self.boundary_waits,
+                "boundary_wait_s": round(self.boundary_wait_s, 6),
+                "device_idle_s": round(self.device_idle_s, 6)}

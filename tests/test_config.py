@@ -146,3 +146,22 @@ def test_cuda_kernel_preset_kernel_contract(monkeypatch):
 
     solve(cfg.with_(dtype="float32"))
     assert calls, "f32 must run the hand-written Pallas kernel"
+
+
+def test_parse_dispatch_depth_grammar():
+    """--dispatch-depth: on -> 2, off -> 0 (sync fallback), N >= 1 -> N;
+    everything else is a loud per-invocation error, never a silent
+    default (a typo'd depth must not quietly change the serve pipeline)."""
+    from heat_tpu.config import parse_dispatch_depth
+
+    assert parse_dispatch_depth("on") == 2
+    assert parse_dispatch_depth("OFF") == 0
+    assert parse_dispatch_depth("1") == 1
+    assert parse_dispatch_depth(" 4 ") == 4
+    assert parse_dispatch_depth(8) == 8
+    with pytest.raises(ValueError, match="dispatch-depth"):
+        parse_dispatch_depth("auto")
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_dispatch_depth("0")      # spelled 'off', not 0, on the CLI
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_dispatch_depth("-2")

@@ -16,7 +16,9 @@ from heat_tpu.backends import solve
 from heat_tpu.config import HeatConfig, config_from_request
 from heat_tpu.runtime import faults
 from heat_tpu.serve import Engine, ServeConfig
-from heat_tpu.serve.engine import BucketKey, LaneEngine, lane_buffer
+from heat_tpu.serve import engine as engine_mod
+from heat_tpu.serve.engine import (BucketKey, LaneEngine, lane_buffer,
+                                   lane_tier, tail_size)
 
 
 @pytest.fixture(autouse=True)
@@ -127,6 +129,174 @@ def test_compile_count_one_per_bucket_lane_combo():
     recs = eng.results()
     assert eng.step_compiles == before  # warm reuse across waves
     assert sum(r["status"] == "ok" for r in recs) == 2 * len(cfgs)
+
+
+# --- dispatch-ahead (ISSUE 4) ----------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 1, 2, 4])
+def test_bit_identity_at_every_dispatch_depth(depth):
+    """Acceptance: served results are bit-identical to solo runs at every
+    dispatch depth — including depth=0 (the sync fallback) and depths
+    deeper than the chunk count — with mid-flight admits (6+1 requests
+    over 2 lanes) and a zero-step request in the mix."""
+    cfgs = MIXED_REQUESTS + [HeatConfig(n=12, ntime=0, dtype="float64")]
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(32, 48),
+                       dispatch_depth=depth))
+    ids = [eng.submit(cfg) for cfg in cfgs]
+    recs = {r["id"]: r for r in eng.results()}
+    for cfg, rid in zip(cfgs, ids):
+        assert recs[rid]["status"] == "ok", recs[rid]
+        np.testing.assert_array_equal(recs[rid]["T"], solve(cfg).T)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_low_precision_lanes_identical_across_depths(dtype):
+    """f32/bf16 results must not depend on the dispatch depth: the depth
+    changes WHEN boundaries are inspected, never what the device steps."""
+    cfgs = [HeatConfig(n=12, ntime=9, dtype=dtype, bc="edges"),
+            HeatConfig(n=16, ntime=14, dtype=dtype, bc="ghost",
+                       ic="uniform"),
+            HeatConfig(n=10, ntime=21, dtype=dtype, bc="edges", nu=0.1)]
+    fields = {}
+    for depth in (0, 2, 3):
+        eng = Engine(quiet(lanes=2, chunk=4, buckets=(16,),
+                           dispatch_depth=depth))
+        ids = [eng.submit(c) for c in cfgs]
+        recs = {r["id"]: r for r in eng.results()}
+        fields[depth] = [np.asarray(recs[rid]["T"], np.float32)
+                         for rid in ids]
+    for cfg, a, b, c in zip(cfgs, fields[0], fields[2], fields[3]):
+        solo = np.asarray(solve(cfg).T, np.float32)
+        np.testing.assert_array_equal(a, solo)
+        np.testing.assert_array_equal(b, solo)
+        np.testing.assert_array_equal(c, solo)
+
+
+def test_dispatch_does_not_fence():
+    """Regression (ISSUE 4): the hot loop must dispatch ahead — with
+    depth 2, TWO chunk programs are queued before the first boundary
+    fetch happens, and dispatching itself never touches host memory.
+    host_fetch is the one D2H seam, so event order proves the shape."""
+    events = []
+    real_fetch = engine_mod.host_fetch
+    real_dispatch = LaneEngine.dispatch_chunk
+
+    def spy_fetch(x):
+        events.append("fetch")
+        return real_fetch(x)
+
+    def spy_dispatch(self, k=None):
+        events.append("dispatch")
+        return real_dispatch(self, k)
+
+    cfg = HeatConfig(n=16, ntime=32, dtype="float64")  # 4 chunks of 8
+    eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,), dispatch_depth=2))
+    eng.submit(cfg)
+    try:
+        engine_mod.host_fetch = spy_fetch
+        LaneEngine.dispatch_chunk = spy_dispatch
+        recs = eng.results()
+    finally:
+        engine_mod.host_fetch = real_fetch
+        LaneEngine.dispatch_chunk = real_dispatch
+    assert recs[0]["status"] == "ok"
+    # the pipeline primes to depth 2 before the first boundary D2H
+    assert events[:3] == ["dispatch", "dispatch", "fetch"], events
+    assert events.count("dispatch") == 4
+    # every boundary was inspected exactly once, none re-fetched
+    assert eng.boundary_waits == eng.chunks_dispatched == 4
+
+
+def test_lane_tiers_share_programs_across_uneven_waves():
+    """The lane-tier rule: waves of 3 then 5 requests under a --lanes 4
+    cap round up to the SAME tier (4) and reuse one compiled stepping
+    program; under a cap of 8 they land on tiers 4 and 8 (two programs,
+    not three when a wave of 6 follows)."""
+    assert [lane_tier(n, 4) for n in (1, 2, 3, 4, 5, 8)] == [1, 2, 4, 4, 4, 4]
+    assert [lane_tier(n, 8) for n in (3, 5, 6, 9)] == [4, 8, 8, 8]
+
+    def wave(eng, count):
+        ids = [eng.submit(HeatConfig(n=12, ntime=6, dtype="float64"))
+               for _ in range(count)]
+        recs = {r["id"]: r for r in eng.results()}
+        assert all(recs[i]["status"] == "ok" for i in ids)
+
+    eng = Engine(quiet(lanes=4, chunk=4, buckets=(16,)))
+    wave(eng, 3)
+    assert eng.step_compiles == 1          # tier 4
+    wave(eng, 5)
+    assert eng.step_compiles == 1          # tier 4 again: warm reuse
+    eng8 = Engine(quiet(lanes=8, chunk=4, buckets=(16,)))
+    wave(eng8, 3)
+    wave(eng8, 5)
+    wave(eng8, 6)
+    assert eng8.step_compiles == 2         # tiers 4 and 8, wave 3 reuses
+
+
+def test_tail_chunks_bounded_compiles_and_exact():
+    """Tail-waste fix: when every live lane's countdown drops below the
+    chunk, the group switches to the quarter-chunk tail program — at most
+    ONE tail compile per (bucket, lane-tier) across waves, and the
+    results stay bit-identical."""
+    assert tail_size(16) == 4 and tail_size(4) == 1
+    assert tail_size(2) == 1 and tail_size(1) is None
+    cfgs = [HeatConfig(n=12, ntime=21, dtype="float64"),
+            HeatConfig(n=12, ntime=10, dtype="float64", nu=0.1)]
+    eng = Engine(quiet(lanes=2, chunk=16, buckets=(16,)))
+    ids = [eng.submit(c) for c in cfgs]
+    recs = {r["id"]: r for r in eng.results()}
+    for cfg, rid in zip(cfgs, ids):
+        np.testing.assert_array_equal(recs[rid]["T"], solve(cfg).T)
+    assert eng.tail_chunks >= 1
+    assert eng.tail_compiles == 1
+    # a second same-tier wave hitting the tail regime reuses the compiled
+    # tail (two requests again — one request would be tier 1, a new combo)
+    wave2 = [HeatConfig(n=12, ntime=5, dtype="float64"),
+             HeatConfig(n=12, ntime=7, dtype="float64")]
+    ids = [eng.submit(c) for c in wave2]
+    recs = {r["id"]: r for r in eng.results()}
+    for cfg, rid in zip(wave2, ids):
+        np.testing.assert_array_equal(recs[rid]["T"], solve(cfg).T)
+    assert eng.tail_compiles == 1 and eng.step_compiles == 1
+
+
+def test_sink_error_isolated_under_async_extraction(tmp_path):
+    """Fault isolation survives the async-extraction rework at depth > 1:
+    the failing request's D2H + write run in the writer thread, fail that
+    record, and the pipelined lanes keep draining bit-exact."""
+    out = tmp_path / "results"
+    eng = Engine(quiet(lanes=2, chunk=4, buckets=(32,), out_dir=str(out),
+                       keep_fields=True, dispatch_depth=3))
+    good1 = eng.submit(HeatConfig(n=16, ntime=10, dtype="float64"))
+    bad = eng.submit(HeatConfig(n=16, ntime=10, dtype="float64",
+                                inject="sink-error@0:times=99"))
+    good2 = eng.submit(HeatConfig(n=16, ntime=10, dtype="float64", nu=0.1))
+    recs = {r["id"]: r for r in eng.results()}
+    assert recs[bad]["status"] == "error"
+    assert "injected transient sink error" in recs[bad]["error"]
+    assert not (out / f"{bad}.npz").exists()
+    for rid in (good1, good2):
+        assert recs[rid]["status"] == "ok"
+        with np.load(out / f"{rid}.npz") as z:
+            np.testing.assert_array_equal(z["T"], recs[rid]["T"])
+    solo = solve(HeatConfig(n=16, ntime=10, dtype="float64")).T
+    np.testing.assert_array_equal(recs[good1]["T"], solo)
+
+
+def test_summary_surfaces_dispatch_counters():
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(16,)))
+    for _ in range(3):
+        eng.submit(HeatConfig(n=12, ntime=20, dtype="float64"))
+    eng.results()
+    s = eng.summary()
+    assert s["dispatch_depth"] == 2
+    assert s["chunks_dispatched"] >= 3
+    assert s["boundary_waits"] >= 1 and s["boundary_wait_s"] >= 0
+    assert set(s) >= {"tail_chunks", "tail_compiles", "device_idle_s"}
+    # and the run left a Timing carrying the serve fields
+    assert eng.timing is not None and eng.timing.dispatch_depth == 2
+    assert any("serve dispatch" in l for l in eng.timing.report_lines())
 
 
 # --- admission / rejection --------------------------------------------------
@@ -279,6 +449,56 @@ def test_serve_cli_bad_requests_nonzero_exit(tmp_cwd, capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert "1 ok" in out and "2 rejected" in out
+
+
+def test_serve_cli_dispatch_depth_off_and_bad_value(tmp_cwd, capsys):
+    from heat_tpu.cli import main
+
+    reqs = tmp_cwd / "reqs.jsonl"
+    reqs.write_text('{"id": "a", "n": 16, "ntime": 12, "dtype": "float64"}\n')
+    rc = main(["serve", "--requests", "reqs.jsonl", "--buckets", "16",
+               "--chunk", "4", "--dispatch-depth", "off"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "dispatch: depth 0" in out
+    rc = main(["serve", "--requests", "reqs.jsonl", "--buckets", "16",
+               "--dispatch-depth", "sideways"])
+    assert rc == 2
+    assert "dispatch-depth" in capsys.readouterr().err
+
+
+def test_serve_lab_ab_harness_smoke(tmp_path, capsys):
+    """The serve_lab A/B harness (dispatch-ahead vs sync vs sequential)
+    runs end-to-end on a tiny 2-lane workload and emits every field the
+    committed artifact relies on. Speed thresholds deliberately NOT
+    asserted — 6 requests on a loaded CI box prove plumbing, not perf."""
+    import importlib.util
+    import sys
+    from pathlib import Path
+
+    bench_dir = Path(__file__).resolve().parent.parent / "benchmarks"
+    sys.path.insert(0, str(bench_dir))
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "serve_lab_smoke", bench_dir / "serve_lab.py")
+        serve_lab = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(serve_lab)
+        out = tmp_path / "serve_lab.json"
+        serve_lab.main(["--requests", "6", "--lanes", "2", "--chunk", "8",
+                        "--out", str(out)])
+    finally:
+        sys.path.remove(str(bench_dir))
+    rec = json.loads(out.read_text())
+    assert rec["bench"] == "serve_lab"
+    for side in ("engine", "engine_sync"):
+        assert rec[side]["ok"] == 6
+        assert rec[side]["bit_identical_sample"] is True
+        assert rec[side]["boundary_wait_s"] >= 0
+        assert "device_idle_frac_est" in rec[side]
+    assert rec["engine"]["dispatch_depth"] == 2
+    assert rec["engine_sync"]["dispatch_depth"] == 0
+    assert rec["dispatch_ab_speedup"] is not None
+    assert rec["one_compile_per_bucket_lane_tier"] is True
 
 
 def test_serve_cli_missing_file(tmp_cwd, capsys):

@@ -39,6 +39,23 @@ def test_report_lines_overlap_with_none_io_wait_renders_zero():
     assert "0.000000 blocked" in line
 
 
+def test_report_lines_serve_dispatch_only_when_serving():
+    solo = Timing(total_s=1.0, solve_s=0.5, steps=4, points=16)
+    assert not any("serve dispatch" in l for l in solo.report_lines())
+
+    served = Timing(total_s=1.0, solve_s=1.0, dispatch_depth=2,
+                    boundary_wait_s=0.125)
+    (line,) = [l for l in served.report_lines() if "serve dispatch" in l]
+    assert "depth 2" in line and "boundary wait 0.125000" in line
+
+    # the sync fallback (depth 0) still reports — 0 is a real depth, and
+    # a None boundary wait must render as zero, not crash the format
+    sync = Timing(total_s=1.0, solve_s=1.0, dispatch_depth=0,
+                  boundary_wait_s=None)
+    (line,) = [l for l in sync.report_lines() if "serve dispatch" in l]
+    assert "depth 0" in line and "boundary wait 0.000000" in line
+
+
 def test_compile_line_present_only_when_compiled():
     with_c = Timing(total_s=1.0, compile_s=0.3, solve_s=0.5, steps=1, points=1)
     without = Timing(total_s=1.0, compile_s=0.0, solve_s=0.5, steps=1, points=1)
