@@ -5,10 +5,10 @@ PY ?= python
 # tier1 needs pipefail (a dash /bin/sh has no `set -o pipefail`)
 SHELL := /bin/bash
 
-.PHONY: test tier1 lint bench bench-all bench-smoke chip-check weak-scaling \
-        collective-overhead exchange-lab sharded3d-check sweep \
+.PHONY: test tier1 chaos lint bench bench-all bench-smoke chip-check \
+        weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
-        serve-lab native run viz clean
+        serve-lab serve-chaos-lab native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -21,6 +21,12 @@ tier1:          # the ROADMAP.md tier-1 verify command, verbatim semantics
 	rc=$${PIPESTATUS[0]}; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
 	exit $$rc
+
+chaos:          # the full-fidelity chaos suite tier-1 deselects (slow
+                # marker): supervisor crash-resume e2e over real 2-process
+                # worlds + the serve per-lane fault-domain e2e
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q -m slow \
+	  -p no:cacheprovider
 
 lint:           # ruff when installed; syntax-level fallback otherwise
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
@@ -72,6 +78,10 @@ topology-validate:     # cross-chip machine-model compile validation
 serve-lab:             # serving A/B: dispatch-ahead vs sync fallback vs
                        # sequential solos (boundary-wait + device-idle est.)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_lab.py
+
+serve-chaos-lab:       # serving chaos A/B: clean wave vs ~10% lane-nan
+                       # poisoned (quarantine cost on healthy tenants)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_chaos_lab.py
 
 sweep:                 # flap-tolerant full chip queue
 	bash benchmarks/watch_and_sweep.sh

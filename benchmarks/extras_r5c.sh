@@ -99,4 +99,10 @@ phase recovery_lab     1200 env JAX_PLATFORMS=cpu python benchmarks/recovery_lab
 # and a bit-identity spot-check on BOTH engine modes. CPU-world like
 # recovery_lab: runs even with the tunnel down.
 phase serve_lab        1200 env JAX_PLATFORMS=cpu python benchmarks/serve_lab.py
+# Serving chaos A/B (ISSUE 5): the same 64-request wave clean vs ~10%
+# lane-nan-poisoned — poisoned lanes must quarantine with structured
+# nonfinite records while healthy-request aggregate throughput stays
+# within 10% of the clean run and a healthy sample stays bit-identical.
+# CPU-world: runs with the tunnel down.
+phase serve_chaos_lab  1200 env JAX_PLATFORMS=cpu python benchmarks/serve_chaos_lab.py
 echo "=== extras_r5c done at $(date)"

@@ -1,0 +1,158 @@
+"""Serving-engine chaos A/B: per-lane fault domains under poisoned load.
+
+The ISSUE-5 claim, measured: quarantining a NaN lane at a chunk boundary
+must cost the HEALTHY tenants (almost) nothing. One 64-request wave runs
+twice through the dispatch-ahead engine:
+
+- **clean**: every request well-posed (the serve_lab population);
+- **chaos**: the SAME wave with ~10% of the requests poisoned via the
+  per-request ``lane-nan@N`` injection (runtime/faults.py) — each
+  poisoned lane must fail with a structured ``nonfinite`` record at its
+  next chunk boundary while its co-scheduled lanes keep stepping.
+
+Two acceptance gates ride in the artifact:
+
+- healthy-request aggregate throughput (healthy cell-steps over the
+  drain's wall clock) in the chaos run within 10% of the clean run —
+  the quarantine path may cost at most boundary bookkeeping, never a
+  stall of the batch;
+- a sample of healthy results BIT-IDENTICAL between the two runs (the
+  masking contract confines the poison to its own lane — a perf artifact
+  must never certify a chaos engine that perturbs its neighbors).
+
+    JAX_PLATFORMS=cpu python benchmarks/serve_chaos_lab.py [--requests 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from _util import write_atomic
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# every POISON_EVERY-th request is poisoned at mid-flight step 40 (inside
+# every request's 96..128-step budget, past a few chunk boundaries so the
+# lane has already survived finite verdicts)
+POISON_EVERY = 10
+POISON_STEP = 40
+
+
+def build_waves(count: int):
+    from serve_lab import build_requests
+
+    clean = build_requests(count)
+    chaos = [cfg.with_(inject=f"lane-nan@{POISON_STEP}")
+             if i % POISON_EVERY == POISON_EVERY - 1 else cfg
+             for i, cfg in enumerate(clean)]
+    poisoned = [i for i in range(count) if i % POISON_EVERY == POISON_EVERY - 1]
+    return clean, chaos, poisoned
+
+
+def run_wave(reqs, lanes: int, chunk: int, depth: int):
+    from heat_tpu.runtime import faults
+    from heat_tpu.serve import Engine, ServeConfig
+
+    faults.reset()  # per-spec firing state must not leak between waves
+    eng = Engine(ServeConfig(lanes=lanes, chunk=chunk, buckets=(32, 48),
+                             dispatch_depth=depth, emit_records=False))
+    t0 = time.perf_counter()
+    ids = [eng.submit(cfg) for cfg in reqs]
+    records = eng.results()
+    wall = time.perf_counter() - t0
+    by_id = {r["id"]: r for r in records}
+    return wall, eng, [by_id[i] for i in ids]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--lanes", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--out", default=str(Path(__file__).parent
+                                         / "serve_chaos_lab.json"))
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    clean_reqs, chaos_reqs, poisoned = build_waves(args.requests)
+    healthy = [i for i in range(args.requests) if i not in set(poisoned)]
+    healthy_work = sum(clean_reqs[i].points * clean_reqs[i].ntime
+                       for i in healthy)
+    total_work = sum(cfg.points * cfg.ntime for cfg in clean_reqs)
+
+    clean_wall, clean_eng, clean_recs = run_wave(
+        clean_reqs, args.lanes, args.chunk, args.depth)
+    chaos_wall, chaos_eng, chaos_recs = run_wave(
+        chaos_reqs, args.lanes, args.chunk, args.depth)
+
+    # healthy-request aggregate throughput: the tenants that did nothing
+    # wrong, against the wall clock their wave actually took
+    clean_tput = total_work / clean_wall
+    chaos_tput = healthy_work / chaos_wall
+    ratio = chaos_tput / (clean_tput * healthy_work / total_work)
+
+    sample = sorted({healthy[0], healthy[len(healthy) // 2], healthy[-1]})
+    bit_identical = all(
+        np.array_equal(chaos_recs[i]["T"], clean_recs[i]["T"])
+        for i in sample)
+    quarantined_ok = all(chaos_recs[i]["status"] == "nonfinite"
+                         for i in poisoned)
+    healthy_ok = all(chaos_recs[i]["status"] == "ok" for i in healthy)
+
+    s = chaos_eng.summary()
+    rec = {
+        "bench": "serve_chaos_lab",
+        "config": {"requests": args.requests, "lanes": args.lanes,
+                   "chunk": args.chunk, "dispatch_depth": args.depth,
+                   "poisoned": len(poisoned),
+                   "poison_spec": f"lane-nan@{POISON_STEP}"},
+        "clean": {
+            "wall_s": round(clean_wall, 3),
+            "points_per_s": round(clean_tput, 1),
+            "ok": sum(r["status"] == "ok" for r in clean_recs),
+            "rejected": sum(r["status"] == "rejected" for r in clean_recs),
+            "failed": sum(r["status"] not in ("ok", "rejected")
+                          for r in clean_recs),
+        },
+        "chaos": {
+            "wall_s": round(chaos_wall, 3),
+            "healthy_points_per_s": round(chaos_tput, 1),
+            "ok": sum(r["status"] == "ok" for r in chaos_recs),
+            "rejected": sum(r["status"] == "rejected" for r in chaos_recs),
+            "failed": sum(r["status"] not in ("ok", "rejected")
+                          for r in chaos_recs),
+            "nonfinite": sum(r["status"] == "nonfinite" for r in chaos_recs),
+            "lanes_quarantined": s["lanes_quarantined"],
+            "rollbacks": s["rollbacks"],
+            "watchdog_fired": s["watchdog_fired"],
+        },
+        "healthy_throughput_ratio": round(ratio, 4),
+        "healthy_within_10pct": ratio >= 0.9,
+        "bit_identical_healthy_sample": bit_identical,
+        "all_poisoned_quarantined": quarantined_ok,
+        "all_healthy_ok": healthy_ok,
+    }
+    write_atomic(Path(args.out), rec)
+    print(json.dumps(rec, indent=2))
+    passed = (rec["healthy_within_10pct"] and bit_identical
+              and quarantined_ok and healthy_ok
+              and s["lanes_quarantined"] == len(poisoned))
+    print(f"serve_chaos_lab: {'OK' if passed else 'FAILED'} — healthy "
+          f"throughput under {len(poisoned)}/{args.requests} poisoned "
+          f"load at {100 * ratio:.1f}% of clean "
+          f"({rec['chaos']['healthy_points_per_s']:.4g} vs "
+          f"{rec['clean']['points_per_s']:.4g} pts/s scaled); "
+          f"{s['lanes_quarantined']} quarantined; bit-identical healthy "
+          f"sample={bit_identical}")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

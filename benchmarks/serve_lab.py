@@ -99,6 +99,12 @@ def _engine_block(work, wall, eng, records, sample, seq_fields):
         "wall_s": round(wall, 3),
         "points_per_s": round(work / wall, 1),
         "ok": sum(r["status"] == "ok" for r in records),
+        # schema gap fix (ISSUE 5): a regression that starts rejecting or
+        # failing requests must show in the committed artifact, not hide
+        # behind an unchanged throughput number
+        "rejected": sum(r["status"] == "rejected" for r in records),
+        "failed": sum(r["status"] not in ("ok", "rejected")
+                      for r in records),
         "step_compiles": eng.step_compiles,
         "tail_compiles": eng.tail_compiles,
         "compile_s": round(eng.compile_s, 3),
@@ -166,6 +172,8 @@ def main(argv=None) -> int:
     print(json.dumps(rec, indent=2))
     passed = (engine_on["ok"] == args.requests
               and engine_off["ok"] == args.requests
+              and engine_on["rejected"] == engine_on["failed"] == 0
+              and engine_off["rejected"] == engine_off["failed"] == 0
               and rec["bit_identical_sample"]
               and speedup is not None and speedup >= 3.0
               and ab is not None

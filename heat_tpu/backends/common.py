@@ -88,9 +88,11 @@ def aot_compile_chunks(advance, example, sizes, compiled=None):
 
     ``example`` is a single array for the solo drive shape
     (``advance(T, k)``) or a TUPLE of arrays for multi-argument programs
-    (the serve engine's ``advance(fields, r, n, remaining, k)`` — its
-    leaves are donated selectively, which a single pytree argument cannot
-    express); a tuple is splatted into ``lower``.
+    (the serve engine's ``advance(fields, r, n, remaining, k)``, which
+    also returns the per-lane ``(2, L)`` boundary vector of remaining
+    steps + isfinite bits — its leaves are donated selectively, which a
+    single pytree argument cannot express); a tuple is splatted into
+    ``lower``.
     """
     compiled = dict(compiled or {})
     args = example if isinstance(example, tuple) else (example,)

@@ -134,7 +134,8 @@ def build_parser() -> argparse.ArgumentParser:
                        help="JSON Lines: one request object per line, keys "
                             "= HeatConfig physics fields (n, ntime, sigma, "
                             "nu, dom_len, ndim, dtype, ic, bc, bc_value) + "
-                            "optional id; '#' lines are comments")
+                            "optional id and deadline_ms (wall budget from "
+                            "submission); '#' lines are comments")
     serve.add_argument("--lanes", type=int, default=4,
                        help="max concurrent requests per bucket group "
                             "(default 4)")
@@ -156,6 +157,49 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--out-dir", metavar="DIR",
                        help="write each result as DIR/<id>.npz (atomic "
                             "publish); default: results stay in memory")
+    serve.add_argument("--serve-on-nan", dest="serve_on_nan",
+                       choices=["fail", "rollback"], default="fail",
+                       help="per-lane non-finite response (every chunk "
+                            "boundary carries a device-computed isfinite "
+                            "bit per lane): 'fail' (default) quarantines "
+                            "the request — structured 'nonfinite' record, "
+                            "lane freed, co-scheduled lanes untouched; "
+                            "'rollback' restores that lane's last "
+                            "verified-finite boundary snapshot and "
+                            "re-steps it alone (transient poison recovers "
+                            "bit-identically; deterministic blow-ups "
+                            "quarantine after 2 retries)")
+    serve.add_argument("--serve-deadline", dest="serve_deadline",
+                       type=float, metavar="MS",
+                       help="engine-default per-request wall budget in ms "
+                            "from submission (a request's own deadline_ms "
+                            "JSONL field overrides); an over-deadline "
+                            "lane is preempted at its next chunk boundary "
+                            "with status 'deadline', and queued requests "
+                            "past their budget are shed without occupying "
+                            "a lane (default: no deadline)")
+    serve.add_argument("--max-queue", dest="max_queue", type=int,
+                       metavar="N",
+                       help="admission bound: submits beyond N queued "
+                            "requests are shed with a structured "
+                            "'overloaded' rejection instead of growing "
+                            "the queue without bound (default: unbounded)")
+    serve.add_argument("--fetch-watchdog", dest="fetch_watchdog",
+                       type=float, metavar="SECONDS", default=600.0,
+                       help="boundary-fetch watchdog: a chunk-boundary "
+                            "D2H exceeding this fails that bucket "
+                            "group's in-flight and queued requests "
+                            "cleanly instead of hanging serve forever "
+                            "(default 600; 0 = off)")
+    serve.add_argument("--inject", metavar="SPEC",
+                       help="engine-scoped deterministic fault injection "
+                            "(runtime/faults.py grammar) incl. the "
+                            "serve kinds: lane-nan@N[:req=ID] poisons a "
+                            "lane's field once its request has run N "
+                            "steps (no req= poisons every request); "
+                            "fetch-hang[@N]:ms=M hangs the Nth boundary "
+                            "fetch M ms (watchdog exercise). Per-request "
+                            "specs ride each request's own 'inject' key")
     serve.add_argument("--json", action="store_true",
                        help="also print a machine-readable summary line")
 
@@ -400,15 +444,22 @@ def cmd_serve(args) -> int:
         scfg = ServeConfig(lanes=args.lanes, chunk=args.chunk,
                            buckets=buckets, out_dir=args.out_dir,
                            dispatch_depth=parse_dispatch_depth(
-                               args.dispatch_depth))
+                               args.dispatch_depth),
+                           on_nan=args.serve_on_nan,
+                           deadline_ms=args.serve_deadline,
+                           max_queue=args.max_queue,
+                           fetch_timeout_s=(args.fetch_watchdog
+                                            if args.fetch_watchdog else None),
+                           inject=args.inject or "")
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     records, summary = serve_requests(path, scfg)
     ok = sum(1 for r in records if r["status"] == "ok")
+    failed = summary["requests"] - ok - summary.get("rejected", 0)
     master_print(f"served {summary['requests']} request(s): {ok} ok, "
                  f"{summary.get('rejected', 0)} rejected, "
-                 f"{summary.get('error', 0)} failed "
+                 f"{failed} failed "
                  f"({summary['step_compiles']} stepping + "
                  f"{summary['tail_compiles']} tail compile(s), "
                  f"{summary['compile_s']:.3f}s compiling)")
@@ -418,6 +469,16 @@ def cmd_serve(args) -> int:
                  f"{summary['boundary_waits']} boundary wait(s) totaling "
                  f"{summary['boundary_wait_s']:.3f}s, "
                  f"est. device idle {summary['device_idle_s']:.3f}s")
+    faultful = any(summary[k] for k in ("lanes_quarantined", "rollbacks",
+                                        "deadline_misses", "shed",
+                                        "watchdog_fired"))
+    if faultful:
+        master_print(f"fault domains: "
+                     f"{summary['lanes_quarantined']} quarantined, "
+                     f"{summary['rollbacks']} rollback(s), "
+                     f"{summary['deadline_misses']} deadline miss(es), "
+                     f"{summary['shed']} shed, "
+                     f"{summary['watchdog_fired']} watchdog timeout(s)")
     if args.json:
         master_print(_json.dumps(summary, sort_keys=True))
     return 0 if ok == summary["requests"] else 1
@@ -789,6 +850,13 @@ def cmd_info(_args) -> int:
           f"off = sync fallback), {_sd.lanes} lanes (power-of-two tiers), "
           f"chunk {_sd.chunk} (+{tail_size(_sd.chunk)}-step tail program, "
           f"compiled on first use), buckets {','.join(map(str, _sd.buckets))}")
+    print(f"serve fault domains: on-nan={_sd.on_nan} (--serve-on-nan "
+          f"rollback = per-lane restore-and-re-step, 2 retries), "
+          f"deadline={'none' if _sd.deadline_ms is None else _sd.deadline_ms} "
+          f"(--serve-deadline MS / per-request deadline_ms), "
+          f"max-queue={'unbounded' if not _sd.max_queue else _sd.max_queue}, "
+          f"fetch watchdog {_sd.fetch_timeout_s:g}s (per-lane isfinite "
+          f"bits ride every boundary fetch — no extra D2H)")
 
     # persistent compile cache: which programs are already warm (serve
     # buckets, backend advance programs, guard probes all land here) —

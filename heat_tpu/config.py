@@ -244,6 +244,12 @@ def parse_input(path: str | Path) -> HeatConfig:
 _REQUEST_KEYS = ("n", "sigma", "nu", "dom_len", "ntime", "ndim", "dtype",
                  "ic", "bc", "bc_value", "inject")
 
+# Request keys the SCHEDULER owns (never part of the physics config):
+# "id" names the record, "deadline_ms" bounds the request's wall time from
+# submission (overriding the engine-default --serve-deadline) — see
+# serve/scheduler.py.
+_SCHEDULER_KEYS = ("id", "deadline_ms")
+
 
 def parse_dispatch_depth(v) -> int:
     """``--dispatch-depth`` grammar (serve CLI): ``on`` -> 2 (the default
@@ -274,16 +280,16 @@ def parse_dispatch_depth(v) -> int:
 def config_from_request(d) -> HeatConfig:
     """Build a HeatConfig from one parsed serve-request object.
 
-    ``id`` is the scheduler's, everything else must be a known request key;
-    HeatConfig's own __post_init__ then validates values exactly as it does
-    for the CLI, so a request cannot express a config the solo path would
-    reject.
+    ``id`` and ``deadline_ms`` are the scheduler's (_SCHEDULER_KEYS),
+    everything else must be a known request key; HeatConfig's own
+    __post_init__ then validates values exactly as it does for the CLI,
+    so a request cannot express a config the solo path would reject.
     """
-    unknown = set(d) - set(_REQUEST_KEYS) - {"id"}
+    unknown = set(d) - set(_REQUEST_KEYS) - set(_SCHEDULER_KEYS)
     if unknown:
         raise ValueError(
             f"unknown request key(s) {sorted(unknown)}; allowed: "
-            f"{sorted(_REQUEST_KEYS)} (+ optional 'id')")
+            f"{sorted(_REQUEST_KEYS)} (+ optional {sorted(_SCHEDULER_KEYS)})")
     kw = {k: d[k] for k in _REQUEST_KEYS if k in d}
     # JSON numbers arrive untyped: pin the integer fields (a float n would
     # sail through range validation and break shapes much later)

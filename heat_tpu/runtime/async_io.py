@@ -75,6 +75,45 @@ _TRANSIENT_ERRNOS = frozenset({
 })
 
 
+class BoundedFetchTimeout(TimeoutError):
+    """A watchdog-bounded device fetch did not complete in time (wedged
+    device/tunnel). The abandoned daemon thread may still be blocked on
+    the transfer; the caller must treat the fetched-from state as lost."""
+
+
+def bounded_call(fn: Callable[[], object], timeout_s: float,
+                 what: str = "device fetch"):
+    """Run ``fn`` in a daemon thread and wait at most ``timeout_s``.
+
+    The boundary-fetch watchdog of the serving engine: a D2H transfer
+    against a wedged device blocks uninterruptibly, so the only way to
+    bound it is to move the blocking call off the waiting thread and
+    abandon it on timeout (the same abandon-don't-wedge discipline as
+    ``SnapshotWriter.drain``). Exceptions raised by ``fn`` re-raise here;
+    a timeout raises ``BoundedFetchTimeout``."""
+    result: list = [None, None]     # [value, exception]
+    done = threading.Event()
+
+    def runner():
+        try:
+            result[0] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            result[1] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=runner, daemon=True,
+                         name="heat-bounded-fetch")
+    t.start()
+    if not done.wait(timeout_s):
+        raise BoundedFetchTimeout(
+            f"{what} did not complete within {timeout_s:g}s (wedged "
+            f"device fetch?) — abandoning the fetch thread")
+    if result[1] is not None:
+        raise result[1]
+    return result[0]
+
+
 def is_transient(e: BaseException) -> bool:
     """The retry-worthy class: OS-level errors that routinely clear on
     their own. Anything else (fingerprint mismatch, NaN rejection, a
@@ -240,6 +279,7 @@ def lane_snapshot(stacked, lane: int):
     ever blocks on the D2H. One lane, not the stack — a finished 256-side
     lane must not drag the other L-1 lanes' bytes across the link."""
     import jax
+    import numpy as np
 
     if isinstance(stacked, jax.Array):
         return stacked[lane]

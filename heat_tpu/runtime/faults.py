@@ -20,6 +20,24 @@ is a tested subsystem instead of a hope:
 - ``sink-slow:ms=M``         — every checkpoint write sleeps M ms first
                                (backpressure / drain-timeout exercise)
 
+Serve-scoped kinds (the serving engine's per-lane fault domains,
+serve/scheduler.py — ignored by the solo drive loop):
+
+- ``lane-nan@N[:req=ID]``    — poison one cell of a serving lane's field
+                               with NaN once that lane's request has
+                               completed >= N steps (fire-once per
+                               request). In a request's own ``inject``
+                               the fault targets that request; in the
+                               engine-level spec (``heat-tpu serve
+                               --inject`` / env) ``req=ID`` selects one
+                               request id, no ``req=`` poisons every
+                               request. Pairs with ``--serve-on-nan``.
+- ``fetch-hang[@N]:ms=M``    — the first boundary remaining-vector fetch
+                               (the Nth one with ``@N``) sleeps M ms
+                               before transferring: a wedged-device
+                               analog for the boundary fetch watchdog
+                               (fire-once).
+
 Specs come from ``--inject`` (``HeatConfig.inject``) or the
 ``HEAT_TPU_FAULTS`` env var (so ``heat-tpu launch`` workers inherit one
 without CLI plumbing); multiple faults are comma-separated, e.g.
@@ -57,7 +75,7 @@ RESTART_ENV_VAR = "HEAT_TPU_RESTART"
 CRASH_RC = 43
 
 _KINDS = ("crash", "nan", "ckpt-corrupt", "ckpt-truncate",
-          "sink-error", "sink-slow")
+          "sink-error", "sink-slow", "lane-nan", "fetch-hang")
 
 
 @dataclasses.dataclass
@@ -66,8 +84,9 @@ class Fault:
     step: Optional[int] = None  # fires at the first boundary/step >= this
     proc: Optional[int] = None  # None = every process
     times: int = 1              # sink-error: how many writes fail
-    ms: float = 0.0             # sink-slow: per-write delay
+    ms: float = 0.0             # sink-slow / fetch-hang: delay
     restart: int = 0            # incarnation filter (-1 = every incarnation)
+    req: Optional[str] = None   # lane-nan: target request id (None = all)
     fired: bool = False
 
 
@@ -118,15 +137,16 @@ def parse_spec(spec: str) -> List[Fault]:
                 raise ValueError(f"bad step {step_s!r} in fault {entry!r}")
         for kv in filter(None, tail.split(":")):
             key, eq, val = kv.partition("=")
-            if not eq or key not in ("proc", "times", "ms", "restart"):
+            if not eq or key not in ("proc", "times", "ms", "restart", "req"):
                 raise ValueError(
                     f"bad fault param {kv!r} in {entry!r}; keys are "
-                    f"proc=, times=, ms=, restart=")
+                    f"proc=, times=, ms=, restart=, req=")
             try:
-                setattr(f, key, float(val) if key == "ms" else int(val))
+                setattr(f, key, val if key == "req"
+                        else float(val) if key == "ms" else int(val))
             except ValueError:
                 raise ValueError(f"bad value {val!r} for {key} in {entry!r}")
-        if f.kind in ("crash", "nan") and f.step is None:
+        if f.kind in ("crash", "nan", "lane-nan") and f.step is None:
             raise ValueError(f"fault {entry!r} needs a step: '{f.kind}@N'")
         faults.append(f)
     return faults
@@ -172,6 +192,30 @@ class FaultPlan:
                              f"(spec {self.spec!r})")
                 T = _inject_nan(T)
         return T
+
+    # --- serve-scoped faults (serve/scheduler.py lane fault domains) ------
+    def lane_nan_steps(self, req_id: str) -> List[int]:
+        """The step thresholds at which ``req_id``'s serving lane must be
+        poisoned with NaN. Firing state for lane-nan is PER REQUEST and
+        lives in the scheduler (plans are cached per spec string, so two
+        requests sharing one spec must not share a fired flag) — this
+        only answers 'which steps apply to this request'."""
+        return sorted(f.step for f in self._live("lane-nan")
+                      if f.req is None or f.req == req_id)
+
+    def maybe_fetch_hang(self, fetch_index: int) -> None:
+        """Called inside the (watchdog-bounded) boundary fetch: the first
+        live fetch-hang fault whose ``@N`` threshold the fetch counter has
+        reached sleeps ``ms`` and is spent (fire-once — a wedged fetch is
+        a one-shot scenario, and the watchdog that catches it fails the
+        whole group anyway)."""
+        for f in self._live("fetch-hang"):
+            if not f.fired and fetch_index >= (f.step or 0):
+                f.fired = True
+                master_print(f"fault: injected {f.ms:.0f} ms hang on "
+                             f"boundary fetch {fetch_index} "
+                             f"(spec {self.spec!r})")
+                time.sleep(f.ms / 1000.0)
 
     # --- checkpoint-sink faults (runtime.checkpoint.save/save_shards) ----
     def sink_fault(self, step: int) -> None:

@@ -135,6 +135,16 @@ class Timing:
     # fallback it fences the whole chunk and approaches solve_s.
     dispatch_depth: int | None = None
     boundary_wait_s: float | None = None
+    # Per-lane fault-domain accounting (None outside `heat-tpu serve`).
+    # lanes_quarantined: requests failed with a structured `nonfinite`
+    # record (their lane freed, every co-scheduled lane untouched).
+    # rollbacks: --serve-on-nan rollback restore-and-re-step events.
+    # deadline_misses: requests preempted (or shed while queued) past
+    # their deadline_ms budget. shed: submits rejected by --max-queue.
+    lanes_quarantined: int | None = None
+    rollbacks: int | None = None
+    deadline_misses: int | None = None
+    shed: int | None = None
 
     @property
     def per_step_s(self) -> float:
@@ -161,4 +171,10 @@ class Timing:
         if self.dispatch_depth is not None:
             lines.append(f"serve dispatch: depth {self.dispatch_depth}, "
                          f"boundary wait {self.boundary_wait_s or 0.0:.6f}")
+        if self.lanes_quarantined is not None:
+            lines.append(
+                f"serve faults: {self.lanes_quarantined} quarantined, "
+                f"{self.rollbacks or 0} rollback(s), "
+                f"{self.deadline_misses or 0} deadline miss(es), "
+                f"{self.shed or 0} shed")
         return lines

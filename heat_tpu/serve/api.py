@@ -6,16 +6,19 @@ the same-named ``HeatConfig`` fields (``config.config_from_request``):
 
     {"id": "a", "n": 128, "ntime": 500}
     {"id": "b", "n": 300, "ntime": 200, "nu": 0.1, "dtype": "float32",
-     "bc": "ghost", "bc_value": 1.0, "ic": "uniform"}
+     "bc": "ghost", "bc_value": 1.0, "ic": "uniform", "deadline_ms": 5000}
 
-``id`` is optional (auto-assigned ``req-NNNN``); everything else defaults
+``id`` is optional (auto-assigned ``req-NNNN``); ``deadline_ms`` is an
+optional per-request wall budget from submission (overrides the engine
+default ``--serve-deadline``; an over-deadline lane is preempted at its
+next chunk boundary with status ``deadline``); everything else defaults
 to the ``HeatConfig`` defaults. Unknown keys are a per-request rejection
 (typos must not silently serve different physics). The engine pads each
 request up to the smallest configured bucket side and serves same-bucket
 requests as vmapped lanes under dispatch-ahead continuous batching (see
 scheduler.py / engine.py); execution knobs — ``--lanes``, ``--chunk``,
-``--buckets``, ``--dispatch-depth`` — are engine policy, never request
-payload.
+``--buckets``, ``--dispatch-depth``, ``--serve-on-nan``, ``--max-queue``,
+``--fetch-watchdog`` — are engine policy, never request payload.
 """
 
 from __future__ import annotations
@@ -28,12 +31,16 @@ from ..config import HeatConfig, config_from_request
 from .scheduler import Engine, ServeConfig
 
 
-def load_requests(path) -> List[Tuple[Optional[str], Optional[HeatConfig], Optional[str]]]:
-    """Parse a requests JSONL file into ``(id, cfg, parse_error)`` triples.
+def load_requests(path) -> List[Tuple[Optional[str], Optional[HeatConfig],
+                                      Optional[float], Optional[str]]]:
+    """Parse a requests JSONL file into ``(id, cfg, deadline_ms,
+    parse_error)`` tuples.
 
-    A malformed line yields ``(id-or-None, None, reason)`` instead of
-    raising: one bad request must not take down the whole file (the same
-    per-request isolation contract the engine applies at admission).
+    A malformed line yields ``(id-or-None, None, None, reason)`` instead
+    of raising: one bad request must not take down the whole file (the
+    same per-request isolation contract the engine applies at admission).
+    A non-positive ``deadline_ms`` is a parse error (the engine would
+    reject it at submit — fail it at the same per-request granularity).
     """
     out = []
     for lineno, raw in enumerate(Path(path).read_text().splitlines(), 1):
@@ -47,9 +54,16 @@ def load_requests(path) -> List[Tuple[Optional[str], Optional[HeatConfig], Optio
                 raise ValueError(f"request must be a JSON object, got "
                                  f"{type(d).__name__}")
             rid = d.get("id")
-            out.append((rid, config_from_request(d), None))
+            deadline_ms = d.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+                if deadline_ms <= 0:
+                    raise ValueError(
+                        f"deadline_ms must be > 0, got {deadline_ms}")
+            out.append((rid, config_from_request(d), deadline_ms, None))
         except Exception as e:  # noqa: BLE001 — recorded per request
-            out.append((rid, None, f"line {lineno}: {type(e).__name__}: {e}"))
+            out.append((rid, None, None,
+                        f"line {lineno}: {type(e).__name__}: {e}"))
     return out
 
 
@@ -62,7 +76,7 @@ def serve_requests(path, scfg: ServeConfig = ServeConfig(),
     """
     eng = engine or Engine(scfg)
     parse_failures = []
-    for i, (rid, cfg, err) in enumerate(load_requests(path)):
+    for i, (rid, cfg, deadline_ms, err) in enumerate(load_requests(path)):
         if cfg is None:
             rec = {"id": rid or f"line-{i}", "status": "rejected",
                    "error": err}
@@ -72,7 +86,7 @@ def serve_requests(path, scfg: ServeConfig = ServeConfig(),
 
                 json_record("serve_request", **rec)
             continue
-        eng.submit(cfg, request_id=rid)
+        eng.submit(cfg, request_id=rid, deadline_ms=deadline_ms)
     records = eng.results() + parse_failures
     summary = eng.summary()
     summary["requests"] += len(parse_failures)

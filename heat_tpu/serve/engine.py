@@ -56,6 +56,18 @@ per-lane scalars (r, side, remaining) are deliberately NOT donated into
 the chunk program so an old remaining-handle stays valid while newer
 chunks consume the field stack; only the (L, B+2, ...) field buffer —
 the allocation that matters — ping-pongs through donation.
+
+Per-lane fault domains (the ISSUE-5 rework): the chunk program
+additionally reduces each lane's post-chunk field to a per-lane
+``isfinite`` bit and returns a ``(2, L)`` int32 *boundary vector* —
+row 0 the remaining-step counts, row 1 the finite bits — so the health
+verdict rides the boundary fetch the scheduler already pays for, with
+no extra D2H and no change to what the lanes compute (the reduction
+reads the fields; it never writes them, so bit-identity is untouched).
+``fetch_remaining`` optionally wraps the transfer in a watchdog
+(``runtime/async_io.bounded_call``): a wedged device fetch becomes a
+clean ``BoundedFetchTimeout`` the scheduler turns into per-request
+failures instead of a hung ``heat-tpu serve``.
 """
 
 from __future__ import annotations
@@ -170,11 +182,15 @@ def _lane_step(T, r, n, lo: int):
 
 def make_lane_advance(key: BucketKey):
     """The jitted chunk program for one bucket: ``advance(fields, r, n,
-    remaining, k)`` runs ``k`` masked steps over every lane. Only the
-    field stack is donated (the buffer that matters — it ping-pongs like
-    the solo drive loop's double buffer); the per-lane scalars are left
-    undonated on purpose, so a remaining-step handle taken after chunk
-    ``i`` survives while chunks ``i+1..`` are dispatched behind it — the
+    remaining, k)`` runs ``k`` masked steps over every lane and returns
+    the new state plus the ``(2, L)`` boundary vector — per-lane
+    remaining steps stacked with per-lane ``isfinite`` bits, the one
+    array a chunk boundary needs to fetch to judge both progress AND
+    health of every lane. Only the field stack is donated (the buffer
+    that matters — it ping-pongs like the solo drive loop's double
+    buffer); the per-lane scalars and the boundary vector are left
+    undonated on purpose, so a boundary handle taken after chunk ``i``
+    survives while chunks ``i+1..`` are dispatched behind it — the
     foundation of the dispatch-ahead boundary (scheduler.py)."""
     import jax
     import jax.numpy as jnp
@@ -194,7 +210,12 @@ def make_lane_advance(key: BucketKey):
             return f, rem - act.astype(rem.dtype)
 
         fields, remaining = jax.lax.fori_loop(0, k, body, (fields, remaining))
-        return fields, r, n, remaining
+        # per-lane health: one bit per lane, reduced on device — padding
+        # cells hold bc_value (finite) and masking confines a NaN to its
+        # own lane, so a zero bit is that lane's fault and only its own
+        finite = jnp.isfinite(fields).reshape(fields.shape[0], -1).all(axis=1)
+        boundary = jnp.stack([remaining, finite.astype(remaining.dtype)])
+        return fields, r, n, remaining, boundary
 
     return advance
 
@@ -323,29 +344,88 @@ class LaneEngine:
     # --- stepping ---------------------------------------------------------
     def dispatch_chunk(self, k: Optional[int] = None):
         """Enqueue one k-step program (default: the steady chunk) over
-        every lane and return a DEVICE handle to the post-chunk
-        remaining-step vector — no host round trip, no fence. The handle
-        stays valid under later dispatches because the scalar leaves are
-        never donated."""
+        every lane and return a DEVICE handle to the post-chunk ``(2, L)``
+        boundary vector (remaining steps + per-lane finite bits) — no
+        host round trip, no fence. The handle stays valid under later
+        dispatches because it is never donated."""
         fn = self._ensure(self.chunk if k is None else k)
-        self._state = fn(*self._state)
-        return self._state[3]
+        out = fn(*self._state)
+        self._state = out[:4]
+        return out[4]
 
-    def fetch_remaining(self, handle) -> np.ndarray:
-        """The boundary D2H: fetch a remaining-step handle to host. With
-        dispatch depth > 1 the scheduler calls this on a chunk dispatched
-        one or more chunks ago, so the transfer (and the bookkeeping it
-        gates) hides under the chunks queued behind it."""
-        return host_fetch(handle)
+    def fetch_remaining(self, handle, timeout_s: Optional[float] = None,
+                        plan=None, fetch_index: int = 0) -> np.ndarray:
+        """The boundary D2H: fetch a ``(2, L)`` boundary handle to host
+        (row 0 remaining steps, row 1 finite bits). With dispatch depth
+        > 1 the scheduler calls this on a chunk dispatched one or more
+        chunks ago, so the transfer (and the bookkeeping it gates) hides
+        under the chunks queued behind it.
 
-    def step_chunk(self) -> np.ndarray:
-        """Dispatch one steady chunk and immediately fetch its remaining
+        ``timeout_s`` arms the fetch watchdog: the transfer runs in an
+        abandonable thread and a wedged device surfaces as
+        ``async_io.BoundedFetchTimeout`` (the scheduler fails that
+        group's requests cleanly) instead of hanging the serve loop
+        forever. ``plan`` is the active fault plan — the ``fetch-hang``
+        injection sleeps INSIDE the watchdogged region, so chaos tests
+        exercise the exact production path."""
+        def fetch():
+            if plan is not None:
+                plan.maybe_fetch_hang(fetch_index)
+            return host_fetch(handle)
+
+        if timeout_s is None:
+            return fetch()
+        from ..runtime.async_io import bounded_call
+
+        return bounded_call(fetch, timeout_s, "serve boundary fetch")
+
+    def step_chunk(self, timeout_s: Optional[float] = None, plan=None,
+                   fetch_index: int = 0) -> np.ndarray:
+        """Dispatch one steady chunk and immediately fetch its boundary
         vector — the synchronous boundary (``--dispatch-depth off``); the
         fetch doubles as the chunk fence."""
-        return self.fetch_remaining(self.dispatch_chunk())
+        return self.fetch_remaining(self.dispatch_chunk(),
+                                    timeout_s=timeout_s, plan=plan,
+                                    fetch_index=fetch_index)
 
     def remaining(self) -> np.ndarray:
         return np.asarray(self._state[3])
+
+    # --- per-lane fault domains (ISSUE 5) ---------------------------------
+    def poison_lane(self, lane: int, n: int) -> None:
+        """Chaos-only (``lane-nan`` injection): flip the center cell of
+        ``lane``'s request region to NaN. An eager scatter enqueued after
+        the chunks already in flight — deterministic in device order, and
+        never reached without an active fault plan (hot-path invariant:
+        no fault spec, no call)."""
+        import jax.numpy as jnp
+
+        idx = (lane,) + tuple(1 + n // 2 for _ in range(self.key.ndim))
+        f, r, nn, rem = self._state
+        self._state = (f.at[idx].set(jnp.nan), r, nn, rem)
+
+    def snapshot_stack(self):
+        """On-device copy of the whole lane stack (``--serve-on-nan
+        rollback`` bookkeeping): taken right after a chunk dispatch, it
+        freezes that boundary's state while the live buffer keeps
+        ping-ponging through donation; a lane judged finite at that
+        boundary can later be restored from its row."""
+        from ..runtime.async_io import device_snapshot
+
+        return device_snapshot(self._state[0])
+
+    def restore_lane(self, lane: int, buf, r: float, n: int,
+                     steps: int) -> None:
+        """Roll ONE lane back to a verified-finite boundary: reuse the
+        traced-index loader with an on-device row (no H2D, no new
+        compile), resetting the lane's field and its remaining count
+        while every other lane is untouched. ``buf`` is not donated, so
+        the same snapshot row survives a second rollback attempt."""
+        dt = jnp_dtype(self.key.dtype)
+        acc = accum_dtype_for(dt)
+        self._state = self._load(
+            *self._state, np.int32(lane), buf,
+            np.asarray(r, acc), np.int32(n), np.int32(steps))
 
 
 def wall_clock() -> float:

@@ -46,6 +46,31 @@ The serving contract, in the shape of an inference server's scheduler:
   request's record; transient errors still ride the writer's bounded
   in-thread retry, and the engine keeps draining the other lanes either
   way.
+- **Per-lane fault domains** (ISSUE 5): every chunk boundary carries a
+  per-lane ``isfinite`` bit next to the remaining-step vector (computed
+  on device, fetched in the boundary D2H the scheduler already pays —
+  serve/engine.py). A flagged lane is **quarantined**: its record fails
+  with a structured ``nonfinite`` status and approximate step, the lane
+  is freed for the admission queue, and every other lane continues
+  bit-identically (the masking contract confines a NaN to its own lane).
+  ``--serve-on-nan rollback`` instead mirrors ``drive()``'s per-solve
+  contract per lane: each dispatched chunk keeps an on-device snapshot
+  of its post-chunk stack, a lane judged finite at a boundary promotes
+  that snapshot row to its last-good state, and a flagged lane is
+  restored and re-stepped alone — transient poison recovers
+  bit-identically, a deterministic blow-up re-flags and is quarantined
+  after a bounded retry budget. Requests may carry a ``deadline_ms``
+  (engine default ``--serve-deadline``); an over-deadline lane is
+  preempted at its next boundary with status ``deadline`` and still-
+  queued requests past their deadline are shed without ever occupying a
+  lane. ``--max-queue`` bounds admission (excess requests get a
+  structured ``overloaded`` rejection instead of an unbounded queue),
+  and the boundary fetch runs under a watchdog (``--fetch-watchdog``):
+  a wedged device fetch fails that group's in-flight and queued
+  requests cleanly instead of hanging ``heat-tpu serve`` forever.
+  Freed-but-unreplaced lanes keep counting down on device (masked,
+  garbage-stepping at worst) so the host countdown mirror — and the
+  desync cross-check — stay exact without an extra device program.
 
 Per-request structured JSON records (queue wait, steps/s, lane id) go
 through ``runtime/logging``; each request also keeps a python-level record
@@ -67,7 +92,7 @@ import numpy as np
 from ..config import HeatConfig
 from ..grid import initial_condition
 from ..runtime import async_io, faults
-from ..runtime.logging import json_record
+from ..runtime.logging import json_record, master_print
 from .engine import BucketKey, LaneEngine, lane_tier, wall_clock
 
 
@@ -94,6 +119,31 @@ class ServeConfig:
     keep_fields: bool = False  # keep final fields on records even when
                               # writing files (tests / library callers)
     emit_records: bool = True  # print one JSON line per finished request
+    on_nan: str = "fail"      # a lane whose boundary finite bit drops:
+                              # "fail" quarantines the request (structured
+                              # nonfinite record, lane freed); "rollback"
+                              # restores the lane's last verified-finite
+                              # boundary snapshot and re-steps only that
+                              # lane (bounded retries — deterministic
+                              # blow-ups still quarantine)
+    deadline_ms: Optional[float] = None  # engine-default per-request wall
+                              # budget from submit; a request's own
+                              # deadline_ms overrides. Over-deadline lanes
+                              # preempt at their next chunk boundary
+                              # (status "deadline"); None = no deadline
+    max_queue: Optional[int] = None  # admission bound: submits beyond this
+                              # many queued requests are shed with a
+                              # structured "overloaded" rejection;
+                              # None/0 = unbounded
+    fetch_timeout_s: Optional[float] = 600.0  # boundary-fetch watchdog: a
+                              # boundary D2H exceeding this fails the
+                              # group's requests cleanly instead of
+                              # hanging the serve loop (None = off; the
+                              # default mirrors the writer drain bound)
+    inject: str = ""          # engine-scoped fault spec (runtime/faults.py
+                              # grammar incl. the serve kinds lane-nan /
+                              # fetch-hang); per-request specs ride each
+                              # request's own "inject" key
 
     def __post_init__(self):
         if self.lanes < 1:
@@ -105,6 +155,28 @@ class ServeConfig:
                              f"fallback), got {self.dispatch_depth}")
         if not self.buckets or any(b < 3 for b in self.buckets):
             raise ValueError(f"buckets must be sides >= 3, got {self.buckets}")
+        if self.on_nan not in ("fail", "rollback"):
+            raise ValueError(f"on_nan must be 'fail' or 'rollback', "
+                             f"got {self.on_nan!r}")
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0 (None = no "
+                             f"deadline), got {self.deadline_ms}")
+        if self.max_queue is not None and self.max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0 (None/0 = "
+                             f"unbounded), got {self.max_queue}")
+        if self.fetch_timeout_s is not None and self.fetch_timeout_s <= 0:
+            raise ValueError(f"fetch_timeout_s must be > 0 (None = no "
+                             f"watchdog), got {self.fetch_timeout_s}")
+        if self.inject:
+            # fail at construction, not at a boundary mid-drain (same
+            # parse-time contract as HeatConfig.inject)
+            faults.parse_spec(self.inject)
+
+
+# --serve-on-nan rollback: restores a flagged lane at most this many times
+# per request before declaring the blow-up deterministic — the per-lane
+# mirror of backends/common.py's _MAX_ROLLBACKS_PER_STEP contract.
+_MAX_LANE_ROLLBACKS = 2
 
 
 @dataclasses.dataclass
@@ -115,6 +187,10 @@ class Request:
     cfg: HeatConfig
     submit_t: float
     key: Optional[BucketKey] = None   # None once rejected
+    deadline_t: Optional[float] = None  # absolute wall deadline (engine
+                                        # clock), resolved at submit from
+                                        # the request's deadline_ms or the
+                                        # engine default; None = none
 
 
 def _bucket_for(cfg: HeatConfig, buckets) -> Optional[int]:
@@ -161,6 +237,7 @@ class _GroupRunner:
         scfg = outer.scfg
         self.chunk = scfg.chunk
         self.depth = max(1, scfg.dispatch_depth)
+        self.rollback = scfg.on_nan == "rollback"
         self.lanes = lane_tier(min(len(q), scfg.lanes), scfg.lanes)
         self.eng = LaneEngine(key, self.lanes, scfg.chunk,
                               compiled_cache=outer._compiled,
@@ -168,9 +245,16 @@ class _GroupRunner:
         self.occupant: List[Optional[Request]] = [None] * self.lanes
         # first dispatch seq whose chunk covers the lane's CURRENT
         # occupant: an in-flight chunk older than the epoch shows the
-        # PREVIOUS occupant's zeros and must not finish the new one
+        # PREVIOUS occupant's state (zeros, or a quarantined NaN field)
+        # and must not finish — or re-flag — the new one
         self.epoch = [0] * self.lanes
         self.dev_rem = np.zeros(self.lanes, dtype=np.int64)
+        # per-lane fault-domain state, (re)set at each admission:
+        # pending lane-nan poison thresholds, rollback retries left, and
+        # the last verified-finite boundary (stack snapshot, steps left)
+        self.nan_pending: List[List[int]] = [[] for _ in range(self.lanes)]
+        self.rb_left = [0] * self.lanes
+        self.last_good: List[Optional[tuple]] = [None] * self.lanes
         self.seq = 0                        # next dispatch's sequence id
         self.inflight: collections.deque = collections.deque()
         self.idle_from: Optional[float] = None  # group device queue empty
@@ -182,13 +266,24 @@ class _GroupRunner:
         """Swap queued requests into every free lane (continuous
         batching). The IC build + H2D load run on the scheduler thread,
         but with chunks in flight they overlap device compute instead of
-        extending a fence."""
+        extending a fence. Queued requests already past their deadline
+        are shed here — failing fast beats occupying a lane for a result
+        nobody is waiting for."""
+        outer = self.outer
         for lane in range(self.lanes):
-            if self.occupant[lane] is None and self.q:
+            while self.occupant[lane] is None and self.q:
                 req = self.q.popleft()
                 now = wall_clock()
-                rec = self.outer._by_id[req.id]
-                with self.outer._lock:
+                if req.deadline_t is not None and now > req.deadline_t:
+                    outer._fail_request(
+                        req, "deadline",
+                        f"deadline: exceeded its "
+                        f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms "
+                        f"budget while still queued (never admitted)")
+                    outer.deadline_misses += 1
+                    continue
+                rec = outer._by_id[req.id]
+                with outer._lock:
                     rec["lane"] = lane
                     rec["queue_wait_s"] = round(now - req.submit_t, 6)
                     rec["status"] = "running"
@@ -199,20 +294,43 @@ class _GroupRunner:
                 self.occupant[lane] = req
                 self.epoch[lane] = self.seq
                 self.dev_rem[lane] = req.cfg.ntime
+                self.nan_pending[lane] = outer._lane_nan_steps(req)
+                if self.nan_pending[lane]:
+                    outer._has_lane_faults = True  # gates _maybe_poison
+                self.rb_left[lane] = _MAX_LANE_ROLLBACKS
+                self.last_good[lane] = None
 
     def _live_remaining(self) -> List[int]:
         return [int(self.dev_rem[i]) for i, o in enumerate(self.occupant)
                 if o is not None and self.dev_rem[i] > 0]
 
     # --- dispatch side ----------------------------------------------------
+    def _maybe_poison(self) -> None:
+        """lane-nan chaos: poison any occupied lane whose completed-step
+        count (by the host countdown mirror, i.e. after every chunk
+        already dispatched) has reached a pending threshold. Only ever
+        called with an active fault plan — the no-fault hot path never
+        touches this."""
+        for lane, req in enumerate(self.occupant):
+            if req is None or not self.nan_pending[lane]:
+                continue
+            done = req.cfg.ntime - int(self.dev_rem[lane])
+            while self.nan_pending[lane] and done >= self.nan_pending[lane][0]:
+                self.nan_pending[lane].pop(0)   # fire-once per request
+                self.eng.poison_lane(lane, req.cfg.n)
+
     def dispatch_fill(self) -> None:
         """Queue chunk programs until ``dispatch_depth`` are in flight or
         no lane has steps left to run. Pure host->device enqueue: no
-        fetch, no fence."""
+        fetch, no fence (a rollback-mode stack snapshot is a device-side
+        copy, also enqueued without a fence)."""
+        poison = self.outer._has_lane_faults
         while len(self.inflight) < self.depth:
             live = self._live_remaining()
             if not live:
                 break
+            if poison:
+                self._maybe_poison()
             k = self.chunk
             tail = self.eng.tail
             if tail is not None and max(live) <= self.chunk - tail:
@@ -226,23 +344,131 @@ class _GroupRunner:
                 self.outer.device_idle_s += wall_clock() - self.idle_from
                 self.idle_from = None
             np.maximum(self.dev_rem - k, 0, out=self.dev_rem)
+            # rollback mode keeps every in-flight boundary restorable:
+            # the snapshot is promoted to a lane's last_good only once
+            # that boundary's finite bit comes back clean
+            snap = self.eng.snapshot_stack() if self.rollback else None
             self.inflight.append(
-                (self.seq, handle, self.dev_rem.astype(np.int32)))
+                (self.seq, handle, self.dev_rem.astype(np.int32), snap))
             self.seq += 1
             self.outer.chunks_dispatched += 1
 
     # --- boundary side ----------------------------------------------------
-    def process_boundary(self) -> None:
-        """Take one chunk boundary: fetch the OLDEST in-flight remaining
-        vector (the newer chunks keep computing behind the transfer),
-        retire lanes that finished, refill from the queue."""
+    def _fetch(self, handle) -> np.ndarray:
+        """One watchdog-bounded boundary fetch with wall accounting."""
         outer = self.outer
-        if self.inflight:
-            seq, handle, predicted = self.inflight.popleft()
-            t0 = wall_clock()
-            rem = self.eng.fetch_remaining(handle)
+        t0 = wall_clock()
+        try:
+            return self.eng.fetch_remaining(
+                handle, timeout_s=outer.scfg.fetch_timeout_s,
+                plan=outer._plan, fetch_index=outer._fetch_seq)
+        finally:
+            outer._fetch_seq += 1
             outer.boundary_wait_s += wall_clock() - t0
             outer.boundary_waits += 1
+
+    def _judge_lanes(self, seq: int, rem, finite, snap, sync: bool) -> None:
+        """Apply one fetched boundary's verdicts to every lane this
+        boundary is authoritative for (epoch guard: a chunk dispatched
+        before a lane's occupant swap or rollback must not judge the new
+        state). Order per lane: health first (a non-finite result must
+        never be delivered, even one that 'finished'), then completion,
+        then deadline, then last-good promotion."""
+        outer = self.outer
+        now = wall_clock()
+        for lane in range(self.lanes):
+            req = self.occupant[lane]
+            if req is None or seq < self.epoch[lane]:
+                continue
+            if finite is not None and not finite[lane]:
+                self._handle_nonfinite(lane, req, int(rem[lane]), snap)
+            elif rem[lane] == 0:
+                if sync:
+                    outer._finish_sync(self.eng, lane, req, self.writer)
+                else:
+                    outer._finish_async(self.eng, lane, req, self.writer)
+                self.occupant[lane] = None
+            elif req.deadline_t is not None and now > req.deadline_t:
+                done = req.cfg.ntime - int(rem[lane])
+                outer._fail_request(
+                    req, "deadline",
+                    f"deadline: exceeded its "
+                    f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms budget "
+                    f"with ~{done} of {req.cfg.ntime} steps done; lane "
+                    f"{lane} preempted at the chunk boundary", lane=lane)
+                outer.deadline_misses += 1
+                # the lane keeps counting down on device (masked garbage
+                # until refilled) so the host mirror stays exact; a
+                # refill overwrites buffer + countdown wholesale
+                self.occupant[lane] = None
+            elif self.rollback and snap is not None:
+                self.last_good[lane] = (snap, int(rem[lane]))
+
+    def _handle_nonfinite(self, lane: int, req: Request, rem_at: int,
+                          snap) -> None:
+        """One lane's finite bit dropped: restore-and-re-step it alone
+        (rollback mode, budget permitting) or quarantine the request.
+        Either way every other lane is untouched."""
+        outer = self.outer
+        done = req.cfg.ntime - rem_at
+        if self.rollback and self.rb_left[lane] > 0:
+            self.rb_left[lane] -= 1
+            outer.rollbacks += 1
+            if self.last_good[lane] is not None:
+                good_snap, steps_left = self.last_good[lane]
+                master_print(
+                    f"serve on-nan rollback: request {req.id} (lane {lane}) "
+                    f"non-finite at ~step {done}; restoring the last "
+                    f"verified boundary ({steps_left} steps left, attempt "
+                    f"{_MAX_LANE_ROLLBACKS - self.rb_left[lane]}/"
+                    f"{_MAX_LANE_ROLLBACKS})")
+                self.eng.restore_lane(lane, good_snap[lane],
+                                      float(req.cfg.r), req.cfg.n,
+                                      steps_left)
+                self.dev_rem[lane] = steps_left
+            else:
+                # no verified boundary yet: re-admit from the (determin-
+                # istic) initial condition — the first-chunk transient
+                master_print(
+                    f"serve on-nan rollback: request {req.id} (lane {lane}) "
+                    f"non-finite at ~step {done}; re-stepping from the "
+                    f"initial condition (attempt "
+                    f"{_MAX_LANE_ROLLBACKS - self.rb_left[lane]}/"
+                    f"{_MAX_LANE_ROLLBACKS})")
+                T0 = initial_condition(req.cfg)
+                self.eng.load_lane(lane, T0, float(req.cfg.r),
+                                   req.cfg.ntime, req.cfg.bc_value)
+                self.dev_rem[lane] = req.cfg.ntime
+            # boundaries already in flight show the pre-restore (still
+            # poisoned) lane: the epoch bump makes them non-authoritative
+            self.epoch[lane] = self.seq
+            self.last_good[lane] = None
+        else:
+            tried = (f" after {_MAX_LANE_ROLLBACKS} rollbacks "
+                     f"(deterministic blow-up)"
+                     if self.rollback and self.rb_left[lane] == 0 else "")
+            outer._fail_request(
+                req, "nonfinite",
+                f"nonfinite: non-finite field detected at ~step {done} of "
+                f"{req.cfg.ntime} (lane {lane}){tried} — check the CFL "
+                f"bound sigma <= 1/(2*ndim) for this request", lane=lane)
+            outer.lanes_quarantined += 1
+            # free the lane; its NaN field idles masked (and its device
+            # countdown keeps draining, mirrored by dev_rem) until a new
+            # request's load overwrites the whole lane buffer
+            self.occupant[lane] = None
+            self.nan_pending[lane] = []
+            self.last_good[lane] = None
+
+    def process_boundary(self) -> None:
+        """Take one chunk boundary: fetch the OLDEST in-flight boundary
+        vector (the newer chunks keep computing behind the transfer),
+        judge every lane's health/completion/deadline, refill from the
+        queue."""
+        if self.inflight:
+            seq, handle, predicted, snap = self.inflight.popleft()
+            b = self._fetch(handle)
+            rem, finite = b[0], b[1]
             if not self.inflight:
                 self.idle_from = wall_clock()
             if not np.array_equal(rem, predicted):
@@ -251,21 +477,13 @@ class _GroupRunner:
                     f"device remaining {rem.tolist()} != host-predicted "
                     f"{predicted.tolist()} at chunk {seq} — the lane "
                     f"masking contract broke; results cannot be trusted")
-            for lane in range(self.lanes):
-                req = self.occupant[lane]
-                if (req is not None and rem[lane] == 0
-                        and seq >= self.epoch[lane]):
-                    outer._finish_async(self.eng, lane, req, self.writer)
-                    self.occupant[lane] = None
+            self._judge_lanes(seq, rem, finite, snap, sync=False)
         else:
             # nothing in flight and nothing left to step: occupants whose
             # countdown is already settled at zero (ntime=0 admits, or
             # the final boundary was already inspected) retire directly
-            for lane in range(self.lanes):
-                req = self.occupant[lane]
-                if req is not None and self.dev_rem[lane] == 0:
-                    outer._finish_async(self.eng, lane, req, self.writer)
-                    self.occupant[lane] = None
+            self._judge_lanes(self.seq, self.dev_rem, None, None,
+                              sync=False)
         self._fill()
 
     def has_work(self) -> bool:
@@ -274,31 +492,36 @@ class _GroupRunner:
 
     # --- synchronous fallback (--dispatch-depth off) ----------------------
     def run_sync(self) -> None:
-        """The PR-3 shape, kept verbatim for debugging A/Bs: fetch every
-        boundary as its chunk is dispatched (the fetch fences the whole
-        chunk) and extract finished lanes on the scheduler thread. No
-        pipelining, no tail programs."""
+        """The PR-3 shape, kept for debugging A/Bs: fetch every boundary
+        as its chunk is dispatched (the fetch fences the whole chunk) and
+        extract finished lanes on the scheduler thread. No pipelining, no
+        tail programs — but the same per-lane fault domains: the boundary
+        vector carries the finite bits either way, and here the live
+        stack IS the fetched boundary's state, so rollback snapshots are
+        taken after the fetch, from a boundary already judged."""
         outer = self.outer
         while self.has_work():
+            finite = None
+            snap = None
             if self._live_remaining():
+                if outer._has_lane_faults:
+                    self._maybe_poison()
                 t0 = wall_clock()
                 if self.idle_from is not None:
                     # device sat idle from the last fetch's return until
                     # this dispatch — the fence cost the A/B demonstrates
                     outer.device_idle_s += t0 - self.idle_from
-                rem = self.eng.step_chunk()
-                outer.boundary_wait_s += wall_clock() - t0
-                outer.boundary_waits += 1
+                b = self._fetch(self.eng.dispatch_chunk())
+                rem, finite = b[0], b[1]
                 outer.chunks_dispatched += 1
                 self.idle_from = wall_clock()
                 np.maximum(self.dev_rem - self.chunk, 0, out=self.dev_rem)
+                if self.rollback:
+                    snap = self.eng.snapshot_stack()
             else:
                 rem = self.dev_rem
-            for lane in range(self.lanes):
-                req = self.occupant[lane]
-                if req is not None and rem[lane] == 0:
-                    outer._finish_sync(self.eng, lane, req, self.writer)
-                    self.occupant[lane] = None
+            self._judge_lanes(self.seq, rem, finite, snap, sync=True)
+            self.seq += 1
             self._fill()
 
 
@@ -342,6 +565,19 @@ class Engine:
         self.device_idle_s = 0.0     # est. device idle: per-group gaps with
                                      # nothing in flight at a boundary
         self.timing = None           # runtime.timing.Timing of the last run
+        # per-lane fault-domain observability (ISSUE 5)
+        self.lanes_quarantined = 0   # requests failed nonfinite
+        self.rollbacks = 0           # per-lane restore-and-re-step events
+        self.deadline_misses = 0     # requests preempted/shed past deadline
+        self.shed = 0                # submits rejected by --max-queue
+        self.watchdog_fired = 0      # boundary-fetch watchdog timeouts
+        # engine-scoped fault plan (scfg.inject / HEAT_TPU_FAULTS); None on
+        # every normal run — the hot loop then does no fault work at all
+        self._plan = faults.plan_for(scfg)
+        self._has_lane_faults = False  # flips on when a poisoned request
+                                       # is admitted (gates _maybe_poison)
+        self._fetch_seq = 0            # boundary-fetch counter (fetch-hang
+                                       # @N addressing)
 
     def _note_compile(self, k: int, seconds: float) -> None:
         if k == self.scfg.chunk:
@@ -351,17 +587,26 @@ class Engine:
         self.compile_s += seconds
 
     # --- admission --------------------------------------------------------
-    def submit(self, cfg: HeatConfig, request_id: Optional[str] = None) -> str:
+    def submit(self, cfg: HeatConfig, request_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> str:
         """Admit one request; returns its id. Unservable requests become
-        status='rejected' records instead of raising (see module doc)."""
+        status='rejected' records instead of raising (see module doc).
+        ``deadline_ms`` (request JSONL field of the same name) bounds the
+        request's wall time from submission; it overrides the engine
+        default ``ServeConfig.deadline_ms``."""
         rid = request_id or f"req-{self._seq:04d}"
         self._seq += 1
         if rid in self._by_id:
             raise ValueError(f"duplicate request id {rid!r}")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        deadline_ms = (deadline_ms if deadline_ms is not None
+                       else self.scfg.deadline_ms)
         rec = {"id": rid, "n": cfg.n, "ndim": cfg.ndim, "ntime": cfg.ntime,
                "dtype": cfg.dtype, "bc": cfg.bc, "status": "queued",
                "bucket": None, "lane": None, "queue_wait_s": None,
-               "solve_s": None, "steps_per_s": None, "error": None}
+               "solve_s": None, "steps_per_s": None, "error": None,
+               "deadline_ms": deadline_ms}
         self._records.append(rec)
         self._by_id[rid] = rec
         if cfg.bc == "periodic":
@@ -375,17 +620,87 @@ class Engine:
                               f"exceeds the biggest bucket "
                               f"{max(self.scfg.buckets)}")
             return rid
+        if self.scfg.max_queue:
+            queued = sum(len(q) for q in self._queues.values())
+            if queued >= self.scfg.max_queue:
+                self.shed += 1
+                self._reject(rec, f"overloaded: admission queue full "
+                                  f"({queued} queued >= --max-queue "
+                                  f"{self.scfg.max_queue}); resubmit later")
+                return rid
         key = BucketKey(ndim=cfg.ndim, n=b, dtype=cfg.dtype, bc=cfg.bc)
         rec["bucket"] = b
+        submit_t = wall_clock()
         self._queues.setdefault(key, collections.deque()).append(
-            Request(id=rid, cfg=cfg, submit_t=wall_clock(), key=key))
+            Request(id=rid, cfg=cfg, submit_t=submit_t, key=key,
+                    deadline_t=(submit_t + deadline_ms / 1e3
+                                if deadline_ms is not None else None)))
         return rid
+
+    def _lane_nan_steps(self, req: Request) -> List[int]:
+        """Poison thresholds for one admitted request: the union of its
+        own plan's and the engine plan's applicable lane-nan steps (the
+        two can be the SAME cached plan object — dedupe by identity so a
+        shared spec doesn't double-fire)."""
+        plans = {id(p): p for p in (faults.plan_for(req.cfg), self._plan)
+                 if p is not None}
+        steps: set = set()
+        for p in plans.values():
+            steps.update(p.lane_nan_steps(req.id))
+        return sorted(steps)
 
     def _reject(self, rec: dict, reason: str) -> None:
         with self._lock:
             rec["status"] = "rejected"
             rec["error"] = reason
         self._emit(rec)
+
+    def _fail_request(self, req: Request, status: str, reason: str,
+                      lane: Optional[int] = None) -> None:
+        """Fail ONE request with a structured status (nonfinite /
+        deadline / error) — the per-lane fault-domain exit: the record
+        carries the reason, the engine keeps serving everyone else."""
+        rec = self._by_id[req.id]
+        now = wall_clock()
+        with self._lock:
+            start = rec.pop("_start_t", None)
+            if start is not None:
+                rec["solve_s"] = round(now - start, 6)
+            if rec["queue_wait_s"] is None:
+                rec["queue_wait_s"] = round(now - req.submit_t, 6)
+            if lane is not None:
+                rec["lane"] = lane
+            rec["status"] = status
+            rec["error"] = reason
+        self._emit(rec)
+
+    def _fail_group(self, runner: "_GroupRunner", exc: BaseException) -> None:
+        """The boundary-fetch watchdog fired for one bucket group: its
+        device state is unreadable (a wedged fetch means every newer
+        chunk is suspect too), so every in-flight occupant and every
+        still-queued request of THIS group fails with a structured
+        record — and the other groups keep draining. This is the
+        fail-clean alternative to `heat-tpu serve` hanging forever on
+        one dead fetch."""
+        self.watchdog_fired += 1
+        master_print(f"serve fetch watchdog: bucket {runner.key} boundary "
+                     f"fetch hung ({exc}); failing the group's "
+                     f"{sum(o is not None for o in runner.occupant)} "
+                     f"in-flight and {len(runner.q)} queued request(s)")
+        for lane, req in enumerate(runner.occupant):
+            if req is not None:
+                self._fail_request(
+                    req, "error",
+                    f"fetch-watchdog: {exc} — lane {lane}'s group state "
+                    f"is unreadable; request failed cleanly", lane=lane)
+                runner.occupant[lane] = None
+        while runner.q:
+            req = runner.q.popleft()
+            self._fail_request(
+                req, "error",
+                f"fetch-watchdog: {exc} — request was still queued when "
+                f"its bucket group's boundary fetch hung")
+        runner.inflight.clear()
 
     def _emit(self, rec: dict) -> None:
         """Emit one request record as a JSON line. Called from the
@@ -416,7 +731,10 @@ class Engine:
                 # synchronous debugging fallback: groups drain one at a
                 # time with a fence at every boundary (the PR-3 shape)
                 for r in runners:
-                    r.run_sync()
+                    try:
+                        r.run_sync()
+                    except async_io.BoundedFetchTimeout as e:
+                        self._fail_group(r, e)
             else:
                 live = [r for r in runners if r.has_work()]
                 while live:
@@ -427,22 +745,38 @@ class Engine:
                         r.dispatch_fill()
                     nxt = []
                     for r in live:
-                        r.process_boundary()
-                        r.dispatch_fill()   # refilled lanes step while the
-                                            # other groups take boundaries
+                        try:
+                            r.process_boundary()
+                            r.dispatch_fill()  # refilled lanes step while
+                                               # other groups take
+                                               # boundaries
+                        except async_io.BoundedFetchTimeout as e:
+                            # the watchdog is a GROUP fault domain: fail
+                            # this group's requests, keep draining the rest
+                            self._fail_group(r, e)
+                            continue
                         if r.has_work():
                             nxt.append(r)
                     live = nxt
-        finally:
-            # every queued writeback lands (or fails per-request) before
-            # results are reported; per-request jobs swallow their own
-            # failures, so a surviving writer error here is a real bug
-            writer.drain()
+        except BaseException:
+            # drain-on-exception: every writeback already queued still
+            # lands (or fails per-request) — no orphan *.tmp, no dropped
+            # result — but a writer error must not mask the scheduler
+            # error already propagating
+            writer.drain(raise_errors=False)
+            raise
+        # normal exit: per-request jobs swallow their own failures, so a
+        # surviving writer error here is a real bug and must surface
+        writer.drain()
         wall = wall_clock() - t0
         self.timing = Timing(total_s=wall, solve_s=wall,
                              compile_s=self.compile_s,
                              dispatch_depth=self.scfg.dispatch_depth,
-                             boundary_wait_s=round(self.boundary_wait_s, 6))
+                             boundary_wait_s=round(self.boundary_wait_s, 6),
+                             lanes_quarantined=self.lanes_quarantined,
+                             rollbacks=self.rollbacks,
+                             deadline_misses=self.deadline_misses,
+                             shed=self.shed)
         return list(self._records)
 
     def results(self) -> List[dict]:
@@ -531,4 +865,9 @@ class Engine:
                 "tail_chunks": self.tail_chunks,
                 "boundary_waits": self.boundary_waits,
                 "boundary_wait_s": round(self.boundary_wait_s, 6),
-                "device_idle_s": round(self.device_idle_s, 6)}
+                "device_idle_s": round(self.device_idle_s, 6),
+                "lanes_quarantined": self.lanes_quarantined,
+                "rollbacks": self.rollbacks,
+                "deadline_misses": self.deadline_misses,
+                "shed": self.shed,
+                "watchdog_fired": self.watchdog_fired}

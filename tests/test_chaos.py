@@ -70,6 +70,81 @@ def test_supervisor_restarts_after_worker_crash_bit_identical(tmp_cwd, capfd):
             "heat_shards_step00000002.proc0001.npz"} <= names
 
 
+def test_serve_chaos_wave_quarantine_watchdog_e2e(tmp_cwd, capsys):
+    """Serve per-lane fault domains e2e (ISSUE 5), full-fidelity: a
+    24-request wave with ~10% lane-nan poison drains with every healthy
+    request ok and bit-identical to a clean run of the same wave; a
+    rollback rerun recovers the poisoned requests too; and a fetch-hang
+    beyond the watchdog still exits with a record for every request."""
+    import json
+
+    from heat_tpu.backends import solve
+    from heat_tpu.config import HeatConfig
+    from heat_tpu.runtime import faults
+
+    reqs = tmp_cwd / "reqs.jsonl"
+    lines = []
+    for i in range(24):
+        n = (16, 24, 32)[i % 3]
+        lines.append({"id": f"r{i}", "n": n, "ntime": 48 + 8 * (i % 2),
+                      "dtype": "float64"})
+    reqs.write_text("".join(json.dumps(d) + "\n" for d in lines))
+    poisoned = {"r9", "r19"}
+    inject = ",".join(f"lane-nan@20:req={r}" for r in sorted(poisoned))
+    base = ["serve", "--requests", "reqs.jsonl", "--buckets", "32",
+            "--chunk", "8", "--lanes", "4"]
+
+    def records(out):
+        return {r["id"]: r for r in
+                (json.loads(l) for l in out.splitlines()
+                 if l.startswith("{") and '"serve_request"' in l)}
+
+    faults.reset()
+    assert main([*base, "--out-dir", "clean"]) == 0
+    clean = records(capsys.readouterr().out)
+    assert all(r["status"] == "ok" for r in clean.values())
+
+    faults.reset()
+    assert main([*base, "--out-dir", "chaos", "--inject", inject]) == 1
+    chaos = records(capsys.readouterr().out)
+    assert len(chaos) == 24
+    for rid, rec in chaos.items():
+        if rid in poisoned:
+            assert rec["status"] == "nonfinite", rec
+            assert not (tmp_cwd / "chaos" / f"{rid}.npz").exists()
+        else:
+            assert rec["status"] == "ok", rec
+            with np.load(tmp_cwd / "chaos" / f"{rid}.npz") as zc, \
+                    np.load(tmp_cwd / "clean" / f"{rid}.npz") as zl:
+                np.testing.assert_array_equal(zc["T"], zl["T"])
+
+    # rollback rerun: the fire-once poison is transient, so every request
+    # recovers — the poisoned ones bit-identical to their solo runs
+    faults.reset()
+    assert main([*base, "--out-dir", "healed", "--inject", inject,
+                 "--serve-on-nan", "rollback"]) == 0
+    healed = records(capsys.readouterr().out)
+    assert all(r["status"] == "ok" for r in healed.values())
+    for rid in poisoned:
+        d = next(l for l in lines if l["id"] == rid)
+        solo = solve(HeatConfig(n=d["n"], ntime=d["ntime"],
+                                dtype="float64")).T
+        with np.load(tmp_cwd / "healed" / f"{rid}.npz") as z:
+            np.testing.assert_array_equal(z["T"], solo)
+
+    # wedged boundary fetch: the watchdog fails the group cleanly and the
+    # CLI still exits (rc=1, not a hang) with a record for every request
+    faults.reset()
+    assert main([*base, "--inject", "fetch-hang:ms=3000",
+                 "--fetch-watchdog", "0.5"]) == 1
+    out = capsys.readouterr().out
+    wedged = records(out)
+    assert len(wedged) == 24
+    assert all(r["status"] == "error"
+               and "fetch-watchdog" in r["error"] for r in wedged.values())
+    assert "1 watchdog timeout(s)" in out
+
+
 def test_corrupt_newest_shard_checkpoint_falls_back(tmp_cwd, capfd):
     """Resume integrity over a real world: damage the newest shard file of
     one process; the relaunch must quarantine it, agree on the next-older

@@ -55,6 +55,64 @@ def test_config_validates_inject_spec_at_parse_time():
     HeatConfig(inject="nan@6")  # valid spec constructs fine
 
 
+def test_parse_spec_serve_kinds_grammar():
+    """The serve-scoped kinds (ISSUE 5): lane-nan needs a step and takes
+    an optional req= target; fetch-hang takes ms= and an optional @N
+    fetch index."""
+    fs = faults.parse_spec("lane-nan@5:req=abc,lane-nan@9,"
+                           "fetch-hang@2:ms=250,fetch-hang:ms=10")
+    assert [f.kind for f in fs] == ["lane-nan", "lane-nan",
+                                    "fetch-hang", "fetch-hang"]
+    assert fs[0].step == 5 and fs[0].req == "abc"
+    assert fs[1].step == 9 and fs[1].req is None
+    assert fs[2].step == 2 and fs[2].ms == 250.0
+    assert fs[3].step is None and fs[3].ms == 10.0
+    with pytest.raises(ValueError, match="needs a step"):
+        faults.parse_spec("lane-nan:req=a")
+
+
+def test_lane_nan_steps_filter_by_request_id():
+    """req=-targeted faults apply only to that id; untargeted ones apply
+    to every request. Firing state lives in the scheduler (per request),
+    so the plan only answers thresholds."""
+    plan = faults.FaultPlan("lane-nan@5,lane-nan@9:req=b")
+    assert plan.lane_nan_steps("a") == [5]
+    assert plan.lane_nan_steps("b") == [5, 9]
+    # asking twice must not consume anything
+    assert plan.lane_nan_steps("b") == [5, 9]
+
+
+def test_fetch_hang_fires_once_at_threshold():
+    plan = faults.FaultPlan("fetch-hang@2:ms=1")
+    plan.maybe_fetch_hang(0)
+    plan.maybe_fetch_hang(1)
+    assert not plan.faults[0].fired       # below the @2 threshold
+    plan.maybe_fetch_hang(2)
+    assert plan.faults[0].fired           # fired (and slept 1 ms)
+
+
+def test_plan_for_none_hot_path_including_serve_kinds(monkeypatch):
+    """Satellite (ISSUE 5): with no --inject/HEAT_TPU_FAULTS the fault
+    layer stays entirely out of the hot path — plan_for is None for solo
+    AND serve configs, and a serve Engine built without a spec carries no
+    plan and no lane-fault gate."""
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    from heat_tpu.serve import Engine, ServeConfig
+
+    assert faults.plan_for(HeatConfig()) is None
+    assert faults.plan_for(ServeConfig()) is None
+    eng = Engine(ServeConfig(emit_records=False))
+    assert eng._plan is None and eng._has_lane_faults is False
+    # serve-scoped kinds are opt-in exactly like the others
+    assert faults.plan_for(
+        ServeConfig(inject="lane-nan@3")) is not None
+    assert faults.plan_for(
+        HeatConfig(inject="fetch-hang:ms=5")) is not None
+    # and env-channel specs with serve kinds activate without cfg plumbing
+    monkeypatch.setenv(faults.ENV_VAR, "lane-nan@3")
+    assert faults.plan_for(ServeConfig()) is not None
+
+
 def test_plan_for_is_strictly_opt_in(monkeypatch):
     monkeypatch.delenv(faults.ENV_VAR, raising=False)
     assert faults.plan_for(HeatConfig()) is None

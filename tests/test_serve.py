@@ -507,3 +507,331 @@ def test_serve_cli_missing_file(tmp_cwd, capsys):
     rc = main(["serve", "--requests", "nope.jsonl"])
     assert rc == 2
     assert "not found" in capsys.readouterr().err
+
+
+# --- per-lane fault domains (ISSUE 5) ---------------------------------------
+
+
+def _run_wave(tmp_path, tag, cfgs, **kw):
+    """Drain one wave through a fresh engine writing <tag>/ npz files;
+    returns (engine, {id: record})."""
+    faults.reset()
+    out = tmp_path / tag
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(32,), out_dir=str(out),
+                       keep_fields=True, **kw))
+    ids = [eng.submit(cfg, request_id=f"r{i}")
+           for i, cfg in enumerate(cfgs)]
+    recs = {r["id"]: r for r in eng.results()}
+    return eng, recs, out, ids
+
+
+QUAR_WAVE = [HeatConfig(n=16, ntime=40, dtype="float64"),
+             HeatConfig(n=24, ntime=56, dtype="float64", ic="hat_small"),
+             HeatConfig(n=16, ntime=40, dtype="float64", nu=0.1),
+             HeatConfig(n=20, ntime=24, dtype="float64", bc="ghost",
+                        ic="uniform")]
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_lane_nan_quarantine_isolates_poisoned_lane(tmp_path, depth):
+    """Acceptance: a lane-nan-poisoned lane fails ONLY its own record
+    (structured nonfinite status + approximate step) while every
+    co-scheduled lane's .npz output stays bit-identical to a clean run —
+    at dispatch depths 0 (sync fallback) and 2 (pipelined)."""
+    _, clean, _, _ = _run_wave(tmp_path, f"clean{depth}", QUAR_WAVE,
+                               dispatch_depth=depth)
+    eng, chaos, out, _ = _run_wave(tmp_path, f"chaos{depth}", QUAR_WAVE,
+                                   dispatch_depth=depth,
+                                   inject="lane-nan@10:req=r1")
+    bad = chaos["r1"]
+    assert bad["status"] == "nonfinite"
+    assert "non-finite field detected at ~step" in bad["error"]
+    assert not (out / "r1.npz").exists()  # a NaN field never persists
+    assert eng.lanes_quarantined == 1 and eng.summary()["nonfinite"] == 1
+    for rid in ("r0", "r2", "r3"):
+        assert chaos[rid]["status"] == "ok"
+        with np.load(out / f"{rid}.npz") as z:
+            np.testing.assert_array_equal(z["T"], clean[rid]["T"])
+    # and the healthy lanes equal their solo runs too (not just clean-run
+    # equal: the quarantine must not perturb the masking contract)
+    np.testing.assert_array_equal(chaos["r0"]["T"], solve(QUAR_WAVE[0]).T)
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_serve_on_nan_rollback_recovers_transient_poison(tmp_path, depth):
+    """Acceptance: --serve-on-nan rollback restores the flagged lane's
+    last verified-finite boundary and re-steps it ALONE; the lane-nan
+    injection fires once per request, so the re-step is clean and the
+    final field is bit-identical to an unpoisoned run."""
+    eng, recs, _, _ = _run_wave(tmp_path, f"rb{depth}", QUAR_WAVE,
+                                dispatch_depth=depth, on_nan="rollback",
+                                inject="lane-nan@10:req=r1")
+    assert eng.rollbacks == 1 and eng.lanes_quarantined == 0
+    for i, cfg in enumerate(QUAR_WAVE):
+        assert recs[f"r{i}"]["status"] == "ok", recs[f"r{i}"]
+        np.testing.assert_array_equal(recs[f"r{i}"]["T"], solve(cfg).T)
+
+
+def test_rollback_deterministic_blowup_quarantines_after_budget(tmp_path):
+    """A genuinely unstable request (sigma far past the FTCS bound)
+    re-flags after every rollback: the bounded retry budget must declare
+    it deterministic and quarantine it, while its lane-mate finishes."""
+    cfgs = [HeatConfig(n=16, ntime=200, dtype="float32", sigma=9.0),
+            HeatConfig(n=16, ntime=40, dtype="float32")]
+    eng, recs, _, _ = _run_wave(tmp_path, "boom", cfgs, on_nan="rollback")
+    assert recs["r0"]["status"] == "nonfinite"
+    assert "after 2 rollbacks (deterministic blow-up)" in recs["r0"]["error"]
+    assert eng.rollbacks == 2 and eng.lanes_quarantined == 1
+    assert recs["r1"]["status"] == "ok"
+    np.testing.assert_array_equal(
+        np.asarray(recs["r1"]["T"], np.float32),
+        np.asarray(solve(cfgs[1]).T, np.float32))
+
+
+def test_boundary_vector_carries_per_lane_finite_bits():
+    """Engine-level unit: the chunk program's (2, L) boundary vector
+    flags exactly the poisoned lane — no extra D2H beyond the boundary
+    fetch the scheduler already pays."""
+    key = BucketKey(2, 16, "float64", "edges")
+    eng = LaneEngine(key, 2, 4)
+    from heat_tpu.grid import initial_condition
+
+    cfg = HeatConfig(n=16, ntime=8, dtype="float64")
+    for lane in (0, 1):
+        eng.load_lane(lane, initial_condition(cfg), cfg.r, 8, cfg.bc_value)
+    eng.poison_lane(0, cfg.n)
+    b = eng.step_chunk()
+    assert b.shape == (2, 2)
+    assert list(b[0]) == [4, 4]        # remaining: both stepped the chunk
+    assert list(b[1]) == [0, 1]        # finite bits: only lane 0 flagged
+
+
+def test_deadline_sheds_queued_request_without_admitting():
+    """A queued request already past its deadline is shed at admission
+    time (status deadline, 'never admitted') — it must not occupy a lane
+    for a result nobody is waiting for. The lane-holder is unaffected."""
+    eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,)))
+    slow = eng.submit(HeatConfig(n=16, ntime=40, dtype="float64"))
+    doomed = eng.submit(HeatConfig(n=16, ntime=40, dtype="float64"),
+                        deadline_ms=1e-6)
+    recs = {r["id"]: r for r in eng.results()}
+    assert recs[slow]["status"] == "ok"
+    assert recs[doomed]["status"] == "deadline"
+    assert "never admitted" in recs[doomed]["error"]
+    assert eng.deadline_misses == 1
+
+
+def test_deadline_preempts_running_lane_at_boundary(monkeypatch):
+    """A lane whose request blows its deadline mid-flight is preempted at
+    its NEXT chunk boundary (status deadline, approximate step count) and
+    the freed lane admits the next queued request. Driven by a fake wall
+    clock (1 s per reading) so the preemption step is deterministic-ish
+    and the test never sleeps."""
+    import heat_tpu.serve.scheduler as sched_mod
+
+    t = {"now": 0.0}
+
+    def fake_clock():
+        t["now"] += 1.0
+        return t["now"]
+
+    monkeypatch.setattr(sched_mod, "wall_clock", fake_clock)
+    eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,)))
+    # 20 s budget: survives admission (a few clock reads) but not the
+    # per-boundary reads of a 10-chunk solve
+    doomed = eng.submit(HeatConfig(n=16, ntime=80, dtype="float64"),
+                        deadline_ms=20_000.0)
+    follower = eng.submit(HeatConfig(n=16, ntime=8, dtype="float64"))
+    recs = {r["id"]: r for r in eng.results()}
+    assert recs[doomed]["status"] == "deadline"
+    assert "preempted at the chunk boundary" in recs[doomed]["error"]
+    assert recs[follower]["status"] == "ok"  # freed lane kept serving
+    assert eng.deadline_misses == 1
+
+
+def test_engine_default_deadline_and_per_request_override():
+    """ServeConfig.deadline_ms is the engine default; a request's own
+    deadline_ms overrides it in both directions."""
+    eng = Engine(quiet(lanes=2, chunk=4, buckets=(16,), deadline_ms=1e-6))
+    doomed = eng.submit(HeatConfig(n=8, ntime=4, dtype="float64"))
+    saved = eng.submit(HeatConfig(n=8, ntime=4, dtype="float64"),
+                       deadline_ms=120_000.0)
+    recs = {r["id"]: r for r in eng.results()}
+    assert recs[doomed]["status"] == "deadline"
+    assert recs[saved]["status"] == "ok"
+    assert recs[saved]["deadline_ms"] == 120_000.0
+
+
+def test_max_queue_sheds_with_structured_overloaded_rejection():
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,), max_queue=2))
+    rids = [eng.submit(HeatConfig(n=8, ntime=4, dtype="float64"))
+            for _ in range(5)]
+    recs = {r["id"]: r for r in eng.results()}
+    statuses = [recs[r]["status"] for r in rids]
+    assert statuses == ["ok", "ok", "rejected", "rejected", "rejected"]
+    for r in rids[2:]:
+        assert "overloaded" in recs[r]["error"]
+    assert eng.shed == 3 and eng.summary()["shed"] == 3
+    # the shed requests never held a lane or a queue slot: a later wave
+    # still serves (the guardrail protects the engine, not one batch)
+    again = eng.submit(HeatConfig(n=8, ntime=4, dtype="float64"))
+    assert {r["id"]: r for r in eng.results()}[again]["status"] == "ok"
+
+
+def test_fetch_watchdog_fails_group_cleanly_others_drain(tmp_path):
+    """Acceptance: a fetch-hang beyond the watchdog fails the affected
+    GROUP's requests with structured records — and the engine still
+    returns a record for every request, with other bucket groups
+    draining normally (no hang)."""
+    faults.reset()
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(16,),
+                       inject="fetch-hang:ms=1500", fetch_timeout_s=0.2))
+    # f64 group submitted first -> its boundary fetch comes first -> hangs
+    hung = [eng.submit(HeatConfig(n=16, ntime=24, dtype="float64"))
+            for _ in range(3)]
+    fine = [eng.submit(HeatConfig(n=16, ntime=24, dtype="float32"))
+            for _ in range(2)]
+    recs = {r["id"]: r for r in eng.results()}
+    assert len(recs) == 5  # a record for EVERY request — nothing dropped
+    for rid in hung:
+        assert recs[rid]["status"] == "error"
+        assert "fetch-watchdog" in recs[rid]["error"]
+    for rid in fine:
+        assert recs[rid]["status"] == "ok"
+    assert eng.watchdog_fired == 1
+
+
+def test_fetch_watchdog_fires_in_sync_fallback_too():
+    faults.reset()
+    eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,), dispatch_depth=0,
+                       inject="fetch-hang:ms=1500", fetch_timeout_s=0.2))
+    rid = eng.submit(HeatConfig(n=16, ntime=24, dtype="float64"))
+    recs = {r["id"]: r for r in eng.results()}
+    assert recs[rid]["status"] == "error"
+    assert "fetch-watchdog" in recs[rid]["error"]
+
+
+def test_drain_on_exception_no_orphan_tmp_and_error_surfaces(tmp_path):
+    """Satellite: an exception mid-Engine.run must still drain the
+    SnapshotWriter — the already-finished request's file lands, no
+    orphan *.tmp remains, and the scheduler's error (not a writer
+    artifact) is what propagates."""
+    out = tmp_path / "results"
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,), out_dir=str(out)))
+    done = eng.submit(HeatConfig(n=16, ntime=4, dtype="float64"))
+    eng.submit(HeatConfig(n=16, ntime=400, dtype="float64"))
+    real = LaneEngine.dispatch_chunk
+    calls = {"n": 0}
+
+    def flaky_dispatch(self, k=None):
+        calls["n"] += 1
+        if calls["n"] > 4:
+            raise RuntimeError("chaos: device fell over mid-run")
+        return real(self, k)
+
+    try:
+        LaneEngine.dispatch_chunk = flaky_dispatch
+        with pytest.raises(RuntimeError, match="device fell over"):
+            eng.run()
+    finally:
+        LaneEngine.dispatch_chunk = real
+    recs = {r["id"]: r for r in eng._records}
+    assert recs[done]["status"] == "ok"
+    assert (out / f"{done}.npz").exists()      # writer drained, not killed
+    assert not list(out.glob("*.tmp"))         # no torn temp files
+
+
+def test_serve_config_validates_fault_domain_knobs():
+    with pytest.raises(ValueError, match="on_nan"):
+        ServeConfig(on_nan="retry")
+    with pytest.raises(ValueError, match="deadline_ms"):
+        ServeConfig(deadline_ms=0)
+    with pytest.raises(ValueError, match="max_queue"):
+        ServeConfig(max_queue=-1)
+    with pytest.raises(ValueError, match="fetch_timeout_s"):
+        ServeConfig(fetch_timeout_s=0)
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        ServeConfig(inject="bogus@3")
+    eng = Engine(quiet())
+    with pytest.raises(ValueError, match="deadline_ms"):
+        eng.submit(HeatConfig(n=8, ntime=1), deadline_ms=-5)
+
+
+def test_summary_and_timing_surface_fault_domain_counters(tmp_path):
+    eng, _, _, _ = _run_wave(tmp_path, "counters", QUAR_WAVE,
+                             inject="lane-nan@10:req=r1")
+    s = eng.summary()
+    assert s["lanes_quarantined"] == 1
+    assert set(s) >= {"rollbacks", "deadline_misses", "shed",
+                      "watchdog_fired"}
+    assert eng.timing.lanes_quarantined == 1
+    assert any("serve faults" in l for l in eng.timing.report_lines())
+
+
+def test_config_from_request_accepts_scheduler_keys():
+    cfg = config_from_request({"id": "x", "n": 16, "ntime": 4,
+                               "deadline_ms": 2000})
+    assert cfg.n == 16  # deadline_ms is the scheduler's, not physics
+
+
+def test_serve_jsonl_deadline_ms_field(tmp_path):
+    from heat_tpu.serve.api import load_requests
+
+    p = tmp_path / "reqs.jsonl"
+    p.write_text('{"id": "a", "n": 16, "ntime": 4, "deadline_ms": 2000}\n'
+                 '{"id": "b", "n": 16, "ntime": 4}\n'
+                 '{"id": "c", "n": 16, "ntime": 4, "deadline_ms": -3}\n')
+    rows = load_requests(p)
+    assert rows[0][0] == "a" and rows[0][2] == 2000.0 and rows[0][3] is None
+    assert rows[1][2] is None
+    assert rows[2][1] is None and "deadline_ms" in rows[2][3]
+
+
+def test_serve_cli_fault_domain_flags(tmp_cwd, capsys):
+    from heat_tpu.cli import main
+
+    reqs = tmp_cwd / "reqs.jsonl"
+    reqs.write_text(
+        '{"id": "good", "n": 16, "ntime": 24, "dtype": "float64"}\n'
+        '{"id": "bad", "n": 16, "ntime": 24, "dtype": "float64"}\n')
+    rc = main(["serve", "--requests", "reqs.jsonl", "--buckets", "16",
+               "--chunk", "8", "--inject", "lane-nan@10:req=bad",
+               "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1  # a quarantined request is a nonzero exit
+    records = {r["id"]: r for r in
+               (json.loads(l) for l in out.splitlines()
+                if l.startswith("{") and '"serve_request"' in l)}
+    assert records["bad"]["status"] == "nonfinite"
+    assert records["good"]["status"] == "ok"
+    assert "fault domains: 1 quarantined" in out
+    summary = json.loads([l for l in out.splitlines()
+                          if '"lanes_quarantined"' in l][-1])
+    assert summary["lanes_quarantined"] == 1
+    # rollback mode recovers the same wave (lane-nan fires once/request)
+    rc = main(["serve", "--requests", "reqs.jsonl", "--buckets", "16",
+               "--chunk", "8", "--inject", "lane-nan@10:req=bad",
+               "--serve-on-nan", "rollback"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "2 ok" in out and "1 rollback(s)" in out
+
+
+def test_serve_cli_max_queue_and_deadline(tmp_cwd, capsys):
+    from heat_tpu.cli import main
+
+    reqs = tmp_cwd / "reqs.jsonl"
+    reqs.write_text("".join(
+        f'{{"id": "r{i}", "n": 16, "ntime": 8, "dtype": "float64"}}\n'
+        for i in range(4)))
+    rc = main(["serve", "--requests", "reqs.jsonl", "--buckets", "16",
+               "--chunk", "4", "--max-queue", "2"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "2 ok" in out and "2 rejected" in out and "2 shed" in out
+    # a sub-millisecond engine-default deadline sheds everything
+    rc = main(["serve", "--requests", "reqs.jsonl", "--buckets", "16",
+               "--chunk", "4", "--serve-deadline", "0.0001"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "4 deadline miss(es)" in out

@@ -56,6 +56,26 @@ def test_report_lines_serve_dispatch_only_when_serving():
     assert "depth 0" in line and "boundary wait 0.000000" in line
 
 
+def test_report_lines_serve_faults_only_when_fault_domains_ran():
+    solo = Timing(total_s=1.0, solve_s=0.5, steps=4, points=16)
+    assert not any("serve faults" in l for l in solo.report_lines())
+
+    served = Timing(total_s=1.0, solve_s=1.0, dispatch_depth=2,
+                    lanes_quarantined=2, rollbacks=1, deadline_misses=3,
+                    shed=4)
+    (line,) = [l for l in served.report_lines() if "serve faults" in l]
+    assert ("2 quarantined" in line and "1 rollback(s)" in line
+            and "3 deadline miss(es)" in line and "4 shed" in line)
+
+    # a clean serve run still reports the zero counters (0 is data; the
+    # None defaults are what suppress the line), and None partners render
+    # as zero rather than crash the format
+    clean = Timing(total_s=1.0, solve_s=1.0, dispatch_depth=2,
+                   lanes_quarantined=0, rollbacks=None)
+    (line,) = [l for l in clean.report_lines() if "serve faults" in l]
+    assert "0 quarantined" in line and "0 rollback(s)" in line
+
+
 def test_compile_line_present_only_when_compiled():
     with_c = Timing(total_s=1.0, compile_s=0.3, solve_s=0.5, steps=1, points=1)
     without = Timing(total_s=1.0, compile_s=0.0, solve_s=0.5, steps=1, points=1)
