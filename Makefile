@@ -8,7 +8,7 @@ SHELL := /bin/bash
 .PHONY: test tier1 chaos lint bench bench-all bench-smoke chip-check \
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
-        serve-lab serve-chaos-lab native run viz clean
+        serve-lab serve-chaos-lab frontend-lab native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -82,6 +82,10 @@ serve-lab:             # serving A/B: dispatch-ahead vs sync fallback vs
 serve-chaos-lab:       # serving chaos A/B: clean wave vs ~10% lane-nan
                        # poisoned (quarantine cost on healthy tenants)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_chaos_lab.py
+
+frontend-lab:          # online front-end A/B: Poisson arrivals, EDF vs
+                       # FIFO deadline-hit rate + policy-layer cost check
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_frontend_lab.py
 
 sweep:                 # flap-tolerant full chip queue
 	bash benchmarks/watch_and_sweep.sh

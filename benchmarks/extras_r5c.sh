@@ -105,4 +105,11 @@ phase serve_lab        1200 env JAX_PLATFORMS=cpu python benchmarks/serve_lab.py
 # within 10% of the clean run and a healthy sample stays bit-identical.
 # CPU-world: runs with the tunnel down.
 phase serve_chaos_lab  1200 env JAX_PLATFORMS=cpu python benchmarks/serve_chaos_lab.py
+# Serving front-end A/B (ISSUE 6): open-loop Poisson arrivals into the
+# ONLINE engine under --policy edf vs fifo (same seeded schedule, real
+# backlog at 3x the measured service rate) — EDF must meet >= FIFO's
+# deadline-hit rate — plus an offline policy-layer drain that must stay
+# within 5% of serve_lab.json's engine throughput (the front-end adds
+# no hot-loop cost). CPU-world: runs with the tunnel down.
+phase serve_frontend_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_frontend_lab.py
 echo "=== extras_r5c done at $(date)"

@@ -1,0 +1,215 @@
+"""Serving front-end A/B: online Poisson arrivals, EDF vs FIFO, plus a
+front-end-cost check against the PR-5 offline drain.
+
+Two claims, one harness:
+
+- **The front-end adds no hot-loop cost** (ISSUE 6 acceptance): draining
+  the PR-3 64-request population through the policy layer (fifo queue,
+  admission trace, per-class histograms all live) must stay within 5% of
+  the committed ``serve_lab.json`` engine aggregate throughput — the
+  policy extraction is bookkeeping on the admission path, never on the
+  chunk boundary.
+- **Deadlines shape admission, not just shedding**: the SAME seeded
+  open-loop Poisson arrival schedule (a burst at ~2x the measured service
+  rate, so a real backlog forms) is fed to a *running* online engine
+  twice — ``--policy fifo`` vs ``--policy edf``. Requests carry SLO
+  classes (1/4 interactive with a tight deadline, 1/4 standard with a
+  looser one, 1/2 batch undated); under backlog FIFO serves in arrival
+  order and late-arriving dated requests blow their budgets, while EDF
+  admits them first. The artifact records per-class p50/p95/p99 latency
+  (from the same histograms ``/metrics`` exports) and the deadline-hit
+  rate per policy; EDF >= FIFO is the pass criterion.
+
+Arrivals are open-loop (submission times fixed up front, independent of
+completions — the "millions of users" shape), deterministic via a seeded
+RNG. The online engine starts at tier 1 and grows lanes as the burst
+builds, so the run also exercises the lane-growth path end to end.
+
+    JAX_PLATFORMS=cpu python benchmarks/serve_frontend_lab.py [--requests 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+from pathlib import Path
+
+from _util import write_atomic
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+BASELINE = Path(__file__).parent / "serve_lab.json"
+
+
+def build_requests(count: int):
+    """The PR-3/PR-5 serve_lab population (import, not copy — the labs
+    must measure the same work)."""
+    import serve_lab
+
+    return serve_lab.build_requests(count)
+
+
+def classify(i: int, n_requests: int, drain_s: float):
+    """Deterministic SLO assignment: i%4==0 interactive (tight deadline),
+    i%4==2 standard (looser), else batch (undated). Deadlines scale with
+    the measured offline drain (which includes the compile cost an online
+    cold start also pays) so the lab stresses the same way on any host
+    speed: the 3x-rate burst makes the whole online run span roughly
+    2-3 drain walls, so a ~1.2x budget is meetable only by jumping the
+    queue — EDF's move — while FIFO's arrival order leaves late dated
+    requests far past it."""
+    if i % 4 == 0:
+        return "interactive", 1.2 * drain_s * 1e3
+    if i % 4 == 2:
+        return "standard", 2.0 * drain_s * 1e3
+    return "batch", None
+
+
+def run_offline(reqs, lanes, chunk):
+    from heat_tpu.serve import Engine, ServeConfig
+
+    eng = Engine(ServeConfig(lanes=lanes, chunk=chunk, buckets=(32, 48),
+                             emit_records=False))
+    t0 = time.perf_counter()
+    for cfg in reqs:
+        eng.submit(cfg)
+    records = eng.results()
+    wall = time.perf_counter() - t0
+    ok = sum(r["status"] == "ok" for r in records)
+    return wall, ok, eng
+
+
+def run_online(reqs, schedule, policy, lanes, chunk, drain_s):
+    """Feed the seeded arrival schedule into a RUNNING engine under one
+    policy; returns (records-by-status counts, per-class quantiles,
+    deadline hit rate, engine)."""
+    from heat_tpu.serve import Engine, ServeConfig
+
+    eng = Engine(ServeConfig(lanes=lanes, chunk=chunk, buckets=(32, 48),
+                             emit_records=False, policy=policy)).start()
+    ids, dated = [], []
+    t0 = time.perf_counter()
+    for (arrival, i, cfg) in schedule:
+        delay = arrival - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        cls, deadline_ms = classify(i, len(reqs), drain_s)
+        rid = eng.submit(cfg, request_id=f"{policy}-{i:03d}",
+                         deadline_ms=deadline_ms, slo_class=cls,
+                         tenant="lab")
+        ids.append(rid)
+        if deadline_ms is not None:
+            dated.append(rid)
+    recs = {}
+    for rid in ids:
+        recs[rid] = eng.wait(rid, timeout=600)
+        assert recs[rid] is not None, f"timed out waiting for {rid}"
+    wall = time.perf_counter() - t0
+    eng.shutdown(timeout=600)
+    statuses = {}
+    for r in recs.values():
+        statuses[r["status"]] = statuses.get(r["status"], 0) + 1
+    hits = sum(recs[rid]["status"] == "ok" for rid in dated)
+    quantiles = {
+        cls: {q: h.quantile(p) for q, p in
+              (("p50", 0.5), ("p95", 0.95), ("p99", 0.99))}
+        for cls, h in sorted(eng.lat_hist.items())}
+    return {
+        "policy": policy,
+        "wall_s": round(wall, 3),
+        "statuses": statuses,
+        "deadline_carrying": len(dated),
+        "deadline_hits": hits,
+        "deadline_hit_rate": round(hits / len(dated), 4) if dated else None,
+        "deadline_misses": eng.deadline_misses,
+        "lane_grows": eng.lane_grows,
+        "latency_quantiles_s": quantiles,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--lanes", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=20260804)
+    ap.add_argument("--out", default=str(Path(__file__).parent
+                                         / "serve_frontend_lab.json"))
+    args = ap.parse_args(argv)
+
+    reqs = build_requests(args.requests)
+    work = sum(cfg.points * cfg.ntime for cfg in reqs)
+
+    # offline drain through the policy layer: best of 3 (each engine pays
+    # its own compiles, exactly like the committed serve_lab baseline run)
+    offline = [run_offline(reqs, args.lanes, args.chunk) for _ in range(3)]
+    off_wall = min(w for w, _, _ in offline)
+    off_ok = offline[0][1]
+    off_pps = work / off_wall
+
+    baseline_pps = baseline_ratio = None
+    if BASELINE.exists() and args.requests == 64:
+        base = json.loads(BASELINE.read_text())
+        baseline_pps = base["engine"]["points_per_s"]
+        baseline_ratio = round(off_pps / baseline_pps, 4)
+
+    # seeded open-loop Poisson burst at ~3x the measured service rate:
+    # a genuine backlog, identical arrival instants for both policies
+    rng = random.Random(args.seed)
+    rate = 3.0 * args.requests / max(off_wall, 1e-3)
+    t = 0.0
+    schedule = []
+    for i, cfg in enumerate(reqs):
+        schedule.append((t, i, cfg))
+        t += rng.expovariate(rate)
+    fifo = run_online(reqs, schedule, "fifo", args.lanes, args.chunk,
+                      off_wall)
+    edf = run_online(reqs, schedule, "edf", args.lanes, args.chunk,
+                     off_wall)
+
+    rec = {
+        "bench": "serve_frontend_lab",
+        "config": {"requests": args.requests, "lanes": args.lanes,
+                   "chunk": args.chunk, "buckets": [32, 48],
+                   "seed": args.seed,
+                   "arrival_rate_req_per_s": round(rate, 1),
+                   "deadline_policy": "interactive 0.5x / standard 0.8x "
+                                      "of the offline drain wall; batch "
+                                      "undated"},
+        "work_cell_steps": work,
+        "offline_drain": {
+            "wall_s": round(off_wall, 3),
+            "points_per_s": round(off_pps, 1),
+            "ok": off_ok,
+            "baseline_points_per_s": baseline_pps,
+            "vs_serve_lab_engine": baseline_ratio,
+        },
+        "online_fifo": fifo,
+        "online_edf": edf,
+        "edf_vs_fifo_hit_rate_delta": (
+            round(edf["deadline_hit_rate"] - fifo["deadline_hit_rate"], 4)
+            if edf["deadline_hit_rate"] is not None else None),
+    }
+    write_atomic(Path(args.out), rec)
+    print(json.dumps(rec, indent=2))
+    passed = (off_ok == args.requests
+              and edf["deadline_hit_rate"] is not None
+              and edf["deadline_hit_rate"] >= fifo["deadline_hit_rate"]
+              and (baseline_ratio is None or baseline_ratio >= 0.95))
+    print(f"serve_frontend_lab: {'OK' if passed else 'FAILED'} — offline "
+          f"drain {off_pps:.3g} pts/s"
+          + (f" ({100 * baseline_ratio:.1f}% of serve_lab engine)"
+             if baseline_ratio is not None else "")
+          + f"; deadline hit rate EDF {edf['deadline_hit_rate']} vs FIFO "
+            f"{fifo['deadline_hit_rate']} "
+            f"(+{rec['edf_vs_fifo_hit_rate_delta']}); lane grows "
+            f"fifo={fifo['lane_grows']} edf={edf['lane_grows']}")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,12 +130,45 @@ def build_parser() -> argparse.ArgumentParser:
              "requests as continuously-batched vmapped lanes (same-bucket "
              "requests step in one compiled program; finished lanes are "
              "swapped for queued requests without recompiling)")
-    serve.add_argument("--requests", required=True, metavar="FILE.jsonl",
+    serve.add_argument("--requests", metavar="FILE.jsonl",
                        help="JSON Lines: one request object per line, keys "
                             "= HeatConfig physics fields (n, ntime, sigma, "
                             "nu, dom_len, ndim, dtype, ic, bc, bc_value) + "
-                            "optional id and deadline_ms (wall budget from "
-                            "submission); '#' lines are comments")
+                            "optional id, deadline_ms (wall budget from "
+                            "submission), tenant, and class "
+                            "(interactive|standard|batch); '#' lines are "
+                            "comments. Optional when --listen is given "
+                            "(then it pre-loads the file before serving)")
+    serve.add_argument("--listen", metavar="HOST:PORT",
+                       help="run as a long-running online gateway instead "
+                            "of a one-shot drain: POST /v1/solve admits "
+                            "request lines into the running engine "
+                            "(streamed records back), GET /metrics, "
+                            "/healthz, POST /drainz for graceful drain. "
+                            "Port 0 picks an ephemeral port (printed). "
+                            "The process runs until /drainz completes "
+                            "(or Ctrl-C, which drains)")
+    serve.add_argument("--policy", choices=["fifo", "edf", "fair"],
+                       default="fifo",
+                       help="admission ordering (default fifo = submit "
+                            "order, bit-identical to previous releases): "
+                            "'edf' = SLO-class priority then earliest-"
+                            "deadline-first within a class (deadline_ms "
+                            "shapes who runs next, not just shedding); "
+                            "'fair' = weighted fair share across tenants "
+                            "(EDF within each tenant)")
+    serve.add_argument("--tenant-weights", dest="tenant_weights",
+                       metavar="NAME=W,...",
+                       help="fair-share weights per tenant (policy=fair), "
+                            "e.g. 'acme=4,free-tier=1'; unlisted tenants "
+                            "weigh 1.0")
+    serve.add_argument("--tenant-quota", dest="tenant_quota", type=int,
+                       metavar="N",
+                       help="per-tenant admission sub-quota: one tenant "
+                            "may hold at most N queued requests (excess "
+                            "gets a structured 'overloaded' rejection — "
+                            "HTTP 429 — even when the global --max-queue "
+                            "still has room)")
     serve.add_argument("--lanes", type=int, default=4,
                        help="max concurrent requests per bucket group "
                             "(default 4)")
@@ -422,40 +455,10 @@ def _process_index() -> int:
     return jax.process_index()
 
 
-def cmd_serve(args) -> int:
-    """Drain a JSONL request file through the batched serving engine.
-
-    Per-request structured records stream as JSON lines while lanes
-    finish; the exit code is 0 only when every request served cleanly
-    (a rejected or failed request is that request's record AND a nonzero
-    exit, so batch drivers notice without parsing records).
-    """
+def _serve_report(summary, ok: int, args) -> None:
+    """The shared end-of-serve report (offline drain + drained gateway)."""
     import json as _json
 
-    from .config import parse_dispatch_depth
-    from .serve import ServeConfig, serve_requests
-
-    path = Path(args.requests)
-    if not path.exists():
-        print(f"error: {path} not found", file=sys.stderr)
-        return 2
-    try:
-        buckets = tuple(int(b) for b in str(args.buckets).split(",") if b)
-        scfg = ServeConfig(lanes=args.lanes, chunk=args.chunk,
-                           buckets=buckets, out_dir=args.out_dir,
-                           dispatch_depth=parse_dispatch_depth(
-                               args.dispatch_depth),
-                           on_nan=args.serve_on_nan,
-                           deadline_ms=args.serve_deadline,
-                           max_queue=args.max_queue,
-                           fetch_timeout_s=(args.fetch_watchdog
-                                            if args.fetch_watchdog else None),
-                           inject=args.inject or "")
-    except ValueError as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 2
-    records, summary = serve_requests(path, scfg)
-    ok = sum(1 for r in records if r["status"] == "ok")
     failed = summary["requests"] - ok - summary.get("rejected", 0)
     master_print(f"served {summary['requests']} request(s): {ok} ok, "
                  f"{summary.get('rejected', 0)} rejected, "
@@ -464,6 +467,7 @@ def cmd_serve(args) -> int:
                  f"{summary['tail_compiles']} tail compile(s), "
                  f"{summary['compile_s']:.3f}s compiling)")
     master_print(f"dispatch: depth {summary['dispatch_depth']}, "
+                 f"policy {summary['policy']}, "
                  f"{summary['chunks_dispatched']} chunk(s) "
                  f"({summary['tail_chunks']} tail), "
                  f"{summary['boundary_waits']} boundary wait(s) totaling "
@@ -481,6 +485,96 @@ def cmd_serve(args) -> int:
                      f"{summary['watchdog_fired']} watchdog timeout(s)")
     if args.json:
         master_print(_json.dumps(summary, sort_keys=True))
+
+
+def cmd_serve(args) -> int:
+    """Drain a JSONL request file through the batched serving engine —
+    or, with ``--listen``, run the long-lived online gateway over it.
+
+    Offline: per-request structured records stream as JSON lines while
+    lanes finish; the exit code is 0 only when every request served
+    cleanly (a rejected or failed request is that request's record AND a
+    nonzero exit, so batch drivers notice without parsing records).
+    Online: the process serves HTTP until ``POST /drainz`` completes (or
+    Ctrl-C, which triggers the same graceful drain), then prints the
+    same summary over everything it served.
+    """
+    from .config import parse_dispatch_depth, parse_listen, \
+        parse_tenant_weights
+    from .serve import Engine, ServeConfig, serve_requests
+
+    path = None
+    if args.requests is not None:
+        path = Path(args.requests)
+        if not path.exists():
+            print(f"error: {path} not found", file=sys.stderr)
+            return 2
+    elif args.listen is None:
+        print("error: need --requests FILE.jsonl, --listen HOST:PORT, "
+              "or both", file=sys.stderr)
+        return 2
+    try:
+        buckets = tuple(int(b) for b in str(args.buckets).split(",") if b)
+        listen = parse_listen(args.listen) if args.listen else None
+        scfg = ServeConfig(lanes=args.lanes, chunk=args.chunk,
+                           buckets=buckets, out_dir=args.out_dir,
+                           dispatch_depth=parse_dispatch_depth(
+                               args.dispatch_depth),
+                           on_nan=args.serve_on_nan,
+                           deadline_ms=args.serve_deadline,
+                           max_queue=args.max_queue,
+                           fetch_timeout_s=(args.fetch_watchdog
+                                            if args.fetch_watchdog else None),
+                           inject=args.inject or "",
+                           policy=args.policy,
+                           tenant_weights=parse_tenant_weights(
+                               args.tenant_weights or ""),
+                           tenant_quota=args.tenant_quota)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if listen is None:
+        records, summary = serve_requests(path, scfg)
+        ok = sum(1 for r in records if r["status"] == "ok")
+        _serve_report(summary, ok, args)
+        return 0 if ok == summary["requests"] else 1
+
+    # --- online gateway mode ---------------------------------------------
+    from .serve import Gateway, load_requests, submit_parsed
+
+    eng = Engine(scfg)
+    parse_failures = 0
+    if path is not None:
+        for row in load_requests(path):
+            if row.cfg is None:
+                parse_failures += 1
+                master_print(f"serve: rejected request line: {row.error}")
+            else:
+                submit_parsed(eng, row)
+    gw = Gateway(eng, listen[0], listen[1]).start()
+    master_print(f"gateway listening on http://{gw.address} — "
+                 f"POST /v1/solve (NDJSON), GET /v1/requests/<id>, "
+                 f"/healthz, /metrics; POST /drainz to drain "
+                 f"(policy {scfg.policy})")
+    try:
+        gw.wait_drained()
+    except KeyboardInterrupt:
+        master_print("gateway: interrupt — draining (in-flight lanes "
+                     "finish; Ctrl-C again to abandon)")
+        gw.request_drain()
+        gw.wait_drained()
+    summary = eng.summary()
+    summary["requests"] += parse_failures
+    if parse_failures:
+        summary["rejected"] = summary.get("rejected", 0) + parse_failures
+    ok = summary.get("ok", 0)
+    _serve_report(summary, ok, args)
+    gw.close()
+    if eng.loop_error is not None:
+        print(f"error: scheduler loop failed: {eng.loop_error}",
+              file=sys.stderr)
+        return 1
     return 0 if ok == summary["requests"] else 1
 
 
@@ -857,6 +951,20 @@ def cmd_info(_args) -> int:
           f"max-queue={'unbounded' if not _sd.max_queue else _sd.max_queue}, "
           f"fetch watchdog {_sd.fetch_timeout_s:g}s (per-lane isfinite "
           f"bits ride every boundary fetch — no extra D2H)")
+
+    # online gateway defaults (`heat-tpu serve --listen HOST:PORT`): the
+    # admission policy and SLO-class table requests are validated against
+    from .config import SLO_CLASSES
+
+    _classes = ">".join(sorted(SLO_CLASSES, key=SLO_CLASSES.get))
+    print(f"serve gateway defaults: policy={_sd.policy} (--policy "
+          f"edf = deadline-aware admission, fair = weighted fair share "
+          f"across tenants), classes {_classes} (request 'class' field), "
+          f"tenant quota "
+          f"{'unbounded' if not _sd.tenant_quota else _sd.tenant_quota} "
+          f"(--tenant-quota), endpoints POST /v1/solve + "
+          f"GET /v1/requests/<id> /healthz /metrics, POST /drainz "
+          f"(graceful drain; overload answers 429 + Retry-After)")
 
     # persistent compile cache: which programs are already warm (serve
     # buckets, backend advance programs, guard probes all land here) —

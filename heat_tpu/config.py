@@ -246,9 +246,89 @@ _REQUEST_KEYS = ("n", "sigma", "nu", "dom_len", "ntime", "ndim", "dtype",
 
 # Request keys the SCHEDULER owns (never part of the physics config):
 # "id" names the record, "deadline_ms" bounds the request's wall time from
-# submission (overriding the engine-default --serve-deadline) — see
-# serve/scheduler.py.
-_SCHEDULER_KEYS = ("id", "deadline_ms")
+# submission (overriding the engine-default --serve-deadline), "tenant"
+# names the submitting tenant (fair-share accounting + per-tenant quotas)
+# and "class" picks the SLO class — see serve/scheduler.py + serve/policy.py.
+_SCHEDULER_KEYS = ("id", "deadline_ms", "tenant", "class")
+
+# SLO classes of the serving front-end, name -> admission priority (lower
+# is more urgent). The class is a *scheduler* field: it shapes admission
+# order (serve/policy.py edf/fair policies) and labels the /metrics
+# latency histograms; it never reaches the physics. Defined here because
+# this module is the one validation chokepoint for request payloads —
+# JSONL (serve/api.py) and HTTP (serve/gateway.py) both funnel through
+# validate_slo_fields, so a typoed class can never silently serve at the
+# wrong tier.
+SLO_CLASSES = {"interactive": 0, "standard": 1, "batch": 2}
+DEFAULT_SLO_CLASS = "standard"
+DEFAULT_TENANT = "default"
+
+_TENANT_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+
+def validate_slo_fields(tenant, slo_class) -> Tuple[str, str]:
+    """Validate (and default) a request's tenant/class pair.
+
+    Raised errors are per-request rejections at both front doors (JSONL
+    parse, HTTP admission) — the same loud-typo contract as
+    config_from_request's unknown-key check."""
+    tenant = DEFAULT_TENANT if tenant is None else str(tenant)
+    if not _TENANT_RE.match(tenant):
+        raise ValueError(
+            f"tenant must match {_TENANT_RE.pattern} (1-64 chars of "
+            f"[A-Za-z0-9._-]), got {tenant!r}")
+    slo_class = DEFAULT_SLO_CLASS if slo_class is None else str(slo_class)
+    if slo_class not in SLO_CLASSES:
+        raise ValueError(
+            f"class must be one of {sorted(SLO_CLASSES)} (priority order "
+            f"{sorted(SLO_CLASSES, key=SLO_CLASSES.get)}), got {slo_class!r}")
+    return tenant, slo_class
+
+
+def parse_listen(s) -> Tuple[str, int]:
+    """``--listen HOST:PORT`` grammar: ':0' / '0' pick an ephemeral port,
+    a bare port listens on 127.0.0.1 (the gateway is a front-end, not an
+    exposed-by-default service)."""
+    text = str(s).strip()
+    host, sep, port_s = text.rpartition(":")
+    if not sep:
+        host, port_s = "", text
+    host = host or "127.0.0.1"
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise ValueError(
+            f"--listen must be HOST:PORT (port an integer), got {s!r}"
+        ) from None
+    if not 0 <= port <= 65535:
+        raise ValueError(f"--listen port must be in [0, 65535], got {port}")
+    return host, port
+
+
+def parse_tenant_weights(s) -> Tuple[Tuple[str, float], ...]:
+    """``--tenant-weights a=4,b=1`` -> (("a", 4.0), ("b", 1.0)). Unlisted
+    tenants weigh 1.0 (serve/policy.py FairShareQueue)."""
+    out = []
+    for tok in str(s).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        name, sep, w = tok.partition("=")
+        if not sep:
+            raise ValueError(
+                f"--tenant-weights entries must be NAME=WEIGHT, got {tok!r}")
+        tenant, _ = validate_slo_fields(name.strip(), None)
+        try:
+            weight = float(w)
+        except ValueError:
+            raise ValueError(
+                f"--tenant-weights weight must be a number, got {w!r}"
+            ) from None
+        if not weight > 0:
+            raise ValueError(
+                f"--tenant-weights weight must be > 0, got {weight}")
+        out.append((tenant, weight))
+    return tuple(out)
 
 
 def parse_dispatch_depth(v) -> int:

@@ -135,6 +135,10 @@ class Timing:
     # fallback it fences the whole chunk and approaches solve_s.
     dispatch_depth: int | None = None
     boundary_wait_s: float | None = None
+    # Admission policy of the run (serve/policy.py: fifo | edf | fair) —
+    # reported on the dispatch line because two serve runs are only
+    # comparable when their admission ordering matched.
+    serve_policy: str | None = None
     # Per-lane fault-domain accounting (None outside `heat-tpu serve`).
     # lanes_quarantined: requests failed with a structured `nonfinite`
     # record (their lane freed, every co-scheduled lane untouched).
@@ -169,8 +173,11 @@ class Timing:
             lines.append(f"async I/O overlap: {self.overlap_s:.6f} hidden, "
                          f"{self.io_wait_s or 0.0:.6f} blocked")
         if self.dispatch_depth is not None:
+            pol = (f", policy {self.serve_policy}"
+                   if self.serve_policy else "")
             lines.append(f"serve dispatch: depth {self.dispatch_depth}, "
-                         f"boundary wait {self.boundary_wait_s or 0.0:.6f}")
+                         f"boundary wait {self.boundary_wait_s or 0.0:.6f}"
+                         f"{pol}")
         if self.lanes_quarantined is not None:
             lines.append(
                 f"serve faults: {self.lanes_quarantined} quarantined, "

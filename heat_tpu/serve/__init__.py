@@ -22,9 +22,26 @@ paper's FTCS stencil:
   hides under another's compute.
 - ``api.py``       — the request JSONL contract and the ``heat-tpu
   serve`` entry point.
+- ``policy.py``    — pluggable admission ordering (fifo | edf | fair):
+  per-tenant SLO classes, weighted fair share, deadline-aware admission.
+- ``gateway.py``   — the online HTTP front-end (``serve --listen``):
+  streaming admission into a running engine, 429/Retry-After
+  backpressure, graceful drain, and the /metrics surface.
 """
 
 from .engine import (BucketKey, LaneEngine, lane_buffer,  # noqa: F401
                      lane_tier, tail_size)
-from .scheduler import Engine, Request, ServeConfig  # noqa: F401
-from .api import load_requests, serve_requests  # noqa: F401
+from .scheduler import (Engine, Request, ServeConfig,  # noqa: F401
+                        TERMINAL_STATUSES)
+from .api import (ParsedRequest, load_requests,  # noqa: F401
+                  parse_request_obj, serve_requests, submit_parsed)
+
+
+def __getattr__(name):
+    # Gateway imports lazily: the offline drain must not pay for (or
+    # depend on) the HTTP stack it never uses.
+    if name in ("Gateway", "render_metrics"):
+        from . import gateway
+
+        return getattr(gateway, name)
+    raise AttributeError(name)

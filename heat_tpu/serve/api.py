@@ -1,4 +1,5 @@
-"""Request JSONL contract + the ``heat-tpu serve`` entry point.
+"""Request contract (JSONL file + HTTP body lines) and the offline
+``heat-tpu serve`` entry point.
 
 A requests file is JSON Lines: one JSON object per line, blank lines and
 ``#`` comment lines ignored. Each object is a solve request; keys map to
@@ -6,65 +7,114 @@ the same-named ``HeatConfig`` fields (``config.config_from_request``):
 
     {"id": "a", "n": 128, "ntime": 500}
     {"id": "b", "n": 300, "ntime": 200, "nu": 0.1, "dtype": "float32",
-     "bc": "ghost", "bc_value": 1.0, "ic": "uniform", "deadline_ms": 5000}
+     "bc": "ghost", "bc_value": 1.0, "ic": "uniform", "deadline_ms": 5000,
+     "tenant": "acme", "class": "interactive"}
 
 ``id`` is optional (auto-assigned ``req-NNNN``); ``deadline_ms`` is an
 optional per-request wall budget from submission (overrides the engine
 default ``--serve-deadline``; an over-deadline lane is preempted at its
-next chunk boundary with status ``deadline``); everything else defaults
-to the ``HeatConfig`` defaults. Unknown keys are a per-request rejection
-(typos must not silently serve different physics). The engine pads each
-request up to the smallest configured bucket side and serves same-bucket
-requests as vmapped lanes under dispatch-ahead continuous batching (see
-scheduler.py / engine.py); execution knobs — ``--lanes``, ``--chunk``,
-``--buckets``, ``--dispatch-depth``, ``--serve-on-nan``, ``--max-queue``,
-``--fetch-watchdog`` — are engine policy, never request payload.
+next chunk boundary with status ``deadline`` — and under ``--policy edf``
+the deadline also shapes *admission order*); ``tenant`` and ``class``
+(``config.SLO_CLASSES``: interactive | standard | batch) are the SLO
+fields the fair-share/EDF policies and the per-tenant quota key on.
+Everything else defaults to the ``HeatConfig`` defaults. Unknown keys are
+a per-request rejection (typos must not silently serve different
+physics). The engine pads each request up to the smallest configured
+bucket side and serves same-bucket requests as vmapped lanes under
+dispatch-ahead continuous batching (see scheduler.py / engine.py);
+execution knobs — ``--lanes``, ``--chunk``, ``--buckets``,
+``--dispatch-depth``, ``--serve-on-nan``, ``--max-queue``,
+``--fetch-watchdog``, ``--policy``, ``--tenant-weights``,
+``--tenant-quota`` — are engine policy, never request payload.
+
+The HTTP gateway (serve/gateway.py) POSTs the exact same line format to
+``/v1/solve``; both front doors parse through ``parse_request_obj`` so a
+request means one thing no matter how it arrives.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 from pathlib import Path
 from typing import List, Optional, Tuple
 
-from ..config import HeatConfig, config_from_request
+from ..config import (HeatConfig, config_from_request, validate_slo_fields)
 from .scheduler import Engine, ServeConfig
 
 
-def load_requests(path) -> List[Tuple[Optional[str], Optional[HeatConfig],
-                                      Optional[float], Optional[str]]]:
-    """Parse a requests JSONL file into ``(id, cfg, deadline_ms,
-    parse_error)`` tuples.
+@dataclasses.dataclass
+class ParsedRequest:
+    """One parsed request line: either a submittable (cfg + scheduler
+    fields) or a per-line parse failure (``error`` set, cfg None)."""
 
-    A malformed line yields ``(id-or-None, None, None, reason)`` instead
-    of raising: one bad request must not take down the whole file (the
-    same per-request isolation contract the engine applies at admission).
-    A non-positive ``deadline_ms`` is a parse error (the engine would
-    reject it at submit — fail it at the same per-request granularity).
+    id: Optional[str] = None
+    cfg: Optional[HeatConfig] = None
+    deadline_ms: Optional[float] = None
+    tenant: Optional[str] = None
+    slo_class: Optional[str] = None
+    error: Optional[str] = None
+
+
+def parse_request_obj(d) -> ParsedRequest:
+    """Validate one request object (already JSON-decoded) into a
+    ``ParsedRequest``. Never raises: a malformed request is that
+    request's rejection, not its neighbors' (the per-request isolation
+    contract both the JSONL file and the HTTP batch body rely on)."""
+    rid = None
+    try:
+        if not isinstance(d, dict):
+            raise ValueError(f"request must be a JSON object, got "
+                             f"{type(d).__name__}")
+        rid = d.get("id")
+        if rid is not None:
+            rid = str(rid)
+        deadline_ms = d.get("deadline_ms")
+        if deadline_ms is not None:
+            deadline_ms = float(deadline_ms)
+            if deadline_ms <= 0:
+                raise ValueError(
+                    f"deadline_ms must be > 0, got {deadline_ms}")
+        tenant, slo_class = validate_slo_fields(d.get("tenant"),
+                                                d.get("class"))
+        return ParsedRequest(id=rid, cfg=config_from_request(d),
+                             deadline_ms=deadline_ms, tenant=tenant,
+                             slo_class=slo_class)
+    except Exception as e:  # noqa: BLE001 — recorded per request
+        return ParsedRequest(id=rid, error=f"{type(e).__name__}: {e}")
+
+
+def load_requests(path) -> List[ParsedRequest]:
+    """Parse a requests JSONL file into ``ParsedRequest`` rows.
+
+    A malformed line yields a row with ``error`` set instead of raising:
+    one bad request must not take down the whole file (the same
+    per-request isolation contract the engine applies at admission).
     """
     out = []
     for lineno, raw in enumerate(Path(path).read_text().splitlines(), 1):
         line = raw.strip()
         if not line or line.startswith("#"):
             continue
-        rid = None
         try:
             d = json.loads(line)
-            if not isinstance(d, dict):
-                raise ValueError(f"request must be a JSON object, got "
-                                 f"{type(d).__name__}")
-            rid = d.get("id")
-            deadline_ms = d.get("deadline_ms")
-            if deadline_ms is not None:
-                deadline_ms = float(deadline_ms)
-                if deadline_ms <= 0:
-                    raise ValueError(
-                        f"deadline_ms must be > 0, got {deadline_ms}")
-            out.append((rid, config_from_request(d), deadline_ms, None))
         except Exception as e:  # noqa: BLE001 — recorded per request
-            out.append((rid, None, None,
-                        f"line {lineno}: {type(e).__name__}: {e}"))
+            out.append(ParsedRequest(
+                error=f"line {lineno}: {type(e).__name__}: {e}"))
+            continue
+        row = parse_request_obj(d)
+        if row.error is not None:
+            row.error = f"line {lineno}: {row.error}"
+        out.append(row)
     return out
+
+
+def submit_parsed(eng: Engine, row: ParsedRequest) -> str:
+    """Submit one successfully parsed row (shared by the offline drain
+    and the gateway). ``row.cfg`` must be set."""
+    return eng.submit(row.cfg, request_id=row.id,
+                      deadline_ms=row.deadline_ms, tenant=row.tenant,
+                      slo_class=row.slo_class)
 
 
 def serve_requests(path, scfg: ServeConfig = ServeConfig(),
@@ -76,17 +126,17 @@ def serve_requests(path, scfg: ServeConfig = ServeConfig(),
     """
     eng = engine or Engine(scfg)
     parse_failures = []
-    for i, (rid, cfg, deadline_ms, err) in enumerate(load_requests(path)):
-        if cfg is None:
-            rec = {"id": rid or f"line-{i}", "status": "rejected",
-                   "error": err}
+    for i, row in enumerate(load_requests(path)):
+        if row.cfg is None:
+            rec = {"id": row.id or f"line-{i}", "status": "rejected",
+                   "error": row.error}
             parse_failures.append(rec)
             if scfg.emit_records:
                 from ..runtime.logging import json_record
 
                 json_record("serve_request", **rec)
             continue
-        eng.submit(cfg, request_id=rid, deadline_ms=deadline_ms)
+        submit_parsed(eng, row)
     records = eng.results() + parse_failures
     summary = eng.summary()
     summary["requests"] += len(parse_failures)

@@ -1,0 +1,441 @@
+"""Online serving gateway: streaming HTTP admission over the lane engine.
+
+``heat-tpu serve --listen HOST:PORT`` turns the PR-3..5 batch drain into
+a long-running service. The engine's scheduler runs on its own thread
+(``Engine.start()``); this module is the stdlib-only front door that
+feeds it while lanes run and exposes the operational surface an online
+system owes its operators:
+
+- ``POST /v1/solve`` — newline-delimited JSON request objects (the exact
+  ``serve --requests`` line format, ``serve/api.py``). Default response
+  is a chunked ``application/x-ndjson`` stream: one record line per
+  request, written the moment that request's lane retires (iteration-
+  level admission is only *online* because of this — a request arriving
+  mid-chunk is admitted at the next boundary). ``?wait=0`` returns 202
+  with the accepted ids immediately; poll instead.
+- ``GET /v1/requests/<id>`` — one record snapshot (404 unknown id).
+- ``GET /healthz`` — 200 while admitting, 503 once draining (the flip a
+  load balancer keys on), plus a scheduler-crash indicator.
+- ``POST /drainz`` — graceful drain: stops admission (healthz flips
+  immediately, new solves get 503), lets every in-flight lane and queued
+  request finish, then shuts the scheduler down. Idempotent; repeated
+  calls report progress.
+- ``GET /metrics`` — Prometheus text format: request counters by status,
+  per-tenant queue-depth gauges, per-class end-to-end latency histograms
+  and the queue-depth-at-submit histogram (serve/policy.py), plus every
+  counter ``Engine.summary()`` tracks (quarantines, rollbacks, deadline
+  misses, shed, watchdog, compiles, boundary waits).
+
+Backpressure is the PR-5 machinery made visible: a submit shed by
+``--max-queue`` or ``--tenant-quota`` answers **429 with Retry-After**
+instead of queueing without bound, and a draining gateway answers 503
+with the same header. Per-lane fault domains flow through unchanged — a
+quarantined lane's request streams back as a structured ``nonfinite``
+record over HTTP, exactly the record the JSONL drain would have printed.
+
+Threading model: ``ThreadingHTTPServer`` handler threads call only the
+engine's thread-safe surface (``submit``/``poll``/``wait``/listeners);
+the scheduler thread never blocks on a socket. Result streaming is
+listener-driven (no polling loops): each streaming POST registers a
+results listener, submits, then relays matching records from a local
+queue until its batch completes.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as queue_lib
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlsplit
+
+from ..config import SLO_CLASSES
+from ..runtime.logging import master_print
+from .api import parse_request_obj, submit_parsed
+from .scheduler import Engine, TERMINAL_STATUSES
+
+MAX_BODY_BYTES = 16 << 20   # one POST body; a solve request is ~100 bytes,
+                            # so this bounds even absurd batch lines
+_OVERLOAD_PREFIX = "overloaded:"
+
+
+def render_metrics(engine: Engine) -> str:
+    """The ``/metrics`` payload (Prometheus text exposition format).
+
+    Pure function of the engine so tests can assert on it without a
+    socket; the gateway handler just serves it."""
+    s = engine.summary()
+    out = []
+
+    def metric(name, mtype, help_text, samples):
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            lbl = ("{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+                   if labels else "")
+            out.append(f"{name}{lbl} {value}")
+
+    metric("heat_tpu_serve_info", "gauge",
+           "Static engine configuration (value is always 1).",
+           [([("policy", s["policy"]),
+              ("dispatch_depth", s["dispatch_depth"]),
+              ("classes", "|".join(sorted(SLO_CLASSES,
+                                          key=SLO_CLASSES.get)))], 1)])
+    metric("heat_tpu_serve_draining", "gauge",
+           "1 once /drainz has been called (healthz returns 503).",
+           [([], int(engine.draining))])
+    metric("heat_tpu_serve_scheduler_up", "gauge",
+           "1 while the online scheduler thread is alive and healthy.",
+           [([], int(engine.online and engine.loop_error is None))])
+    metric("heat_tpu_serve_requests_total", "counter",
+           "Requests ever submitted, by current/terminal status.",
+           [([("status", st)], s[st]) for st in
+            (*TERMINAL_STATUSES, "queued", "running") if s.get(st)]
+           or [([("status", "ok")], 0)])
+    metric("heat_tpu_serve_queue_depth", "gauge",
+           "Requests queued (not yet admitted to a lane), per tenant.",
+           [([("tenant", t)], n)
+            for t, n in sorted(engine.queue_depths().items())]
+           or [([], 0)])
+    for name, key, help_text in (
+            ("heat_tpu_serve_shed_total", "shed",
+             "Submits rejected by --max-queue / --tenant-quota."),
+            ("heat_tpu_serve_deadline_misses_total", "deadline_misses",
+             "Requests preempted or shed past their deadline_ms."),
+            ("heat_tpu_serve_lanes_quarantined_total", "lanes_quarantined",
+             "Requests failed nonfinite (lane quarantined)."),
+            ("heat_tpu_serve_rollbacks_total", "rollbacks",
+             "Per-lane restore-and-re-step events (--serve-on-nan rollback)."),
+            ("heat_tpu_serve_watchdog_fired_total", "watchdog_fired",
+             "Boundary-fetch watchdog timeouts."),
+            ("heat_tpu_serve_lane_grows_total", "lane_grows",
+             "Online lane-tier growth events (group rebuilt wider)."),
+            ("heat_tpu_serve_chunks_dispatched_total", "chunks_dispatched",
+             "Chunk programs dispatched across all bucket groups."),
+            ("heat_tpu_serve_step_compiles_total", "step_compiles",
+             "Steady stepping programs compiled (one per bucket x tier)."),
+            ("heat_tpu_serve_boundary_waits_total", "boundary_waits",
+             "Chunk-boundary fetches taken.")):
+        metric(name, "counter", help_text, [([], s[key])])
+    metric("heat_tpu_serve_boundary_wait_seconds_total", "counter",
+           "Host wall seconds blocked on chunk-boundary fetches.",
+           [([], s["boundary_wait_s"])])
+
+    def histogram(name, help_text, label, hist):
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} histogram")
+        snap = hist.snapshot()
+        lbl = f'{label[0]}="{label[1]}",' if label else ""
+        for le, cum in snap["buckets"]:
+            out.append(f'{name}_bucket{{{lbl}le="{le}"}} {cum}')
+        suffix = "{" + lbl.rstrip(",") + "}" if label else ""
+        out.append(f"{name}_sum{suffix} {snap['sum']:.6f}")
+        out.append(f"{name}_count{suffix} {snap['count']}")
+
+    for cls in sorted(engine.lat_hist):
+        histogram("heat_tpu_serve_request_latency_seconds",
+                  "End-to-end request latency (submit to terminal record), "
+                  "per SLO class.", ("class", cls), engine.lat_hist[cls])
+    histogram("heat_tpu_serve_queue_depth_observed",
+              "Total queue depth observed at each accepted submit.",
+              None, engine.depth_hist)
+    return "\n".join(out) + "\n"
+
+
+class Gateway:
+    """The long-running front-end over one online :class:`Engine`.
+
+    >>> gw = Gateway(Engine(scfg), "127.0.0.1", 0).start()
+    >>> gw.address            # actual host:port (port 0 = ephemeral)
+    >>> gw.request_drain()    # or POST /drainz
+    >>> gw.wait_drained(30)
+    >>> gw.close()
+    """
+
+    def __init__(self, engine: Engine, host: str = "127.0.0.1",
+                 port: int = 0, retry_after_s: float = 1.0,
+                 stream_timeout_s: float = 600.0,
+                 start_engine: bool = True, quiet: bool = True):
+        self.engine = engine
+        self.retry_after_s = retry_after_s
+        self.stream_timeout_s = stream_timeout_s
+        self._start_engine = start_engine
+        self.quiet = quiet
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True   # a wedged client cannot hold
+                                           # process exit hostage
+        self.httpd.gateway = self          # handler back-pointer
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+        self._drainer: Optional[threading.Thread] = None
+        self._drain_lock = threading.Lock()
+        self._drained = threading.Event()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "Gateway":
+        if self._start_engine:
+            self.engine.start()
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True,
+                                        name="heat-tpu-gateway-http")
+        self._thread.start()
+        return self
+
+    # --- drain ------------------------------------------------------------
+    def request_drain(self) -> bool:
+        """Begin the graceful drain (idempotent): admission stops now,
+        in-flight lanes and already-queued requests finish, then the
+        scheduler exits. Returns True once fully drained."""
+        self.engine.begin_drain()
+        with self._drain_lock:
+            if self._drainer is None:
+                self._drainer = threading.Thread(target=self._drain_worker,
+                                                 daemon=True,
+                                                 name="heat-tpu-gateway-drain")
+                self._drainer.start()
+        return self._drained.is_set()
+
+    def _drain_worker(self) -> None:
+        self.engine.shutdown()
+        self._drained.set()
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        return self._drained.wait(timeout)
+
+    def close(self) -> None:
+        """Tear the HTTP listener down (does NOT drain the engine — call
+        request_drain/wait_drained first for a graceful exit)."""
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.1 for chunked transfer encoding (the streaming response)
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def gw(self) -> Gateway:
+        return self.server.gateway
+
+    # --- plumbing ---------------------------------------------------------
+    def log_message(self, fmt, *args):  # noqa: D102 — per-request stderr
+        if not self.gw.quiet:           # lines would swamp serve output
+            master_print(f"gateway: {self.address_string()} {fmt % args}")
+
+    def _json(self, code: int, obj, headers=()) -> None:
+        body = (json.dumps(obj, sort_keys=True) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, str(v))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _text(self, code: int, text: str, ctype: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    @staticmethod
+    def _sanitize(rec: dict) -> dict:
+        return {k: v for k, v in rec.items() if k != "T"}
+
+    # --- routes -----------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        path = urlsplit(self.path).path
+        eng = self.gw.engine
+        if path == "/healthz":
+            if eng.loop_error is not None:
+                self._json(500, {"status": "error",
+                                 "error": f"{type(eng.loop_error).__name__}: "
+                                          f"{eng.loop_error}"})
+            elif eng.draining:
+                self._json(503, {"status": "draining",
+                                 "drained": self.gw.wait_drained(0)},
+                           headers=[("Retry-After",
+                                     int(self.gw.retry_after_s))])
+            else:
+                self._json(200, {"status": "ok", "online": eng.online})
+        elif path == "/metrics":
+            self._text(200, render_metrics(eng),
+                       "text/plain; version=0.0.4")
+        elif path == "/drainz":
+            self._drainz()
+        elif path.startswith("/v1/requests/"):
+            rid = path[len("/v1/requests/"):]
+            rec = eng.poll(rid)
+            if rec is None:
+                self._json(404, {"error": f"unknown request id {rid!r}"})
+            else:
+                self._json(200, self._sanitize(rec))
+        else:
+            self._json(404, {"error": f"no route for GET {path}"})
+
+    def do_POST(self):  # noqa: N802
+        parts = urlsplit(self.path)
+        if parts.path == "/drainz":
+            self._drainz()
+        elif parts.path == "/v1/solve":
+            self._solve(parts)
+        else:
+            self._json(404, {"error": f"no route for POST {parts.path}"})
+
+    def _drainz(self) -> None:
+        """Idempotent graceful drain trigger (POST preferred; GET kept
+        for curl ergonomics)."""
+        drained = self.gw.request_drain()
+        eng = self.gw.engine
+        self._json(200, {"draining": True, "drained": drained,
+                         "queued": sum(eng.queue_depths().values())})
+
+    # --- /v1/solve --------------------------------------------------------
+    def _read_body(self) -> Optional[bytes]:
+        n = self.headers.get("Content-Length")
+        if n is None:
+            self._json(411, {"error": "Content-Length required"})
+            return None
+        n = int(n)
+        if n > MAX_BODY_BYTES:
+            self._json(413, {"error": f"body exceeds {MAX_BODY_BYTES} "
+                                      f"bytes"})
+            return None
+        return self.rfile.read(n)
+
+    def _solve(self, parts) -> None:
+        gw, eng = self.gw, self.gw.engine
+        if eng.draining:
+            self._json(503, {"error": "draining: admission stopped "
+                                      "(/drainz); retry against another "
+                                      "replica"},
+                       headers=[("Retry-After", int(gw.retry_after_s))])
+            return
+        body = self._read_body()
+        if body is None:
+            return
+        wait = parse_qs(parts.query).get("wait", ["1"])[0] not in ("0",
+                                                                   "false")
+        # streaming responses need the listener registered BEFORE any
+        # submit: a tiny request could otherwise finish in the gap
+        results: queue_lib.Queue = queue_lib.Queue()
+        listener = results.put
+        if wait:
+            eng.add_listener(listener)
+        try:
+            immediate, submitted = [], []
+            for line in body.decode("utf-8", "replace").splitlines():
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    row = parse_request_obj(json.loads(line))
+                except Exception as e:  # noqa: BLE001 — per-line record
+                    immediate.append({"id": None, "status": "rejected",
+                                      "error": f"{type(e).__name__}: {e}"})
+                    continue
+                if row.error is not None:
+                    immediate.append({"id": row.id, "status": "rejected",
+                                      "error": row.error})
+                    continue
+                try:
+                    submitted.append(submit_parsed(eng, row))
+                except ValueError as e:   # duplicate id etc.
+                    immediate.append({"id": row.id, "status": "rejected",
+                                      "error": str(e)})
+            if not immediate and not submitted:
+                self._json(400, {"error": "empty body: expected one JSON "
+                                          "request object per line"})
+                return
+            # backpressure: every submitted request shed at admission ->
+            # 429 so well-behaved clients back off (Retry-After)
+            snaps = {rid: eng.poll(rid) for rid in submitted}
+            overloaded = [rid for rid, r in snaps.items()
+                          if r["status"] == "rejected"
+                          and str(r.get("error", "")).startswith(
+                              _OVERLOAD_PREFIX)]
+            if submitted and len(overloaded) == len(submitted):
+                eng_shed = [self._sanitize(snaps[rid]) for rid in submitted]
+                body_out = {"error": "overloaded: admission queue full; "
+                                     "retry after the indicated delay",
+                            "records": immediate + eng_shed}
+                self._json(429, body_out,
+                           headers=[("Retry-After", int(gw.retry_after_s))])
+                return
+            if not wait:
+                self._json(202, {"accepted": submitted,
+                                 "records": immediate})
+                return
+            self._stream(immediate, submitted, snaps, results)
+        finally:
+            if wait:
+                eng.remove_listener(listener)
+
+    def _stream(self, immediate, submitted, snaps, results) -> None:
+        """Chunked NDJSON: parse-failure records first, then one record
+        per submitted request in FINISH order, each written the moment
+        its terminal record lands (listener-fed queue). Bounded by the
+        gateway's stream timeout so a wedged engine cannot hold the
+        socket forever."""
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj) -> bool:
+            data = (json.dumps(obj, sort_keys=True, default=str)
+                    + "\n").encode()
+            try:
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                return True
+            except (BrokenPipeError, ConnectionResetError):
+                return False   # client went away: stop relaying (the
+                               # engine still finishes the requests)
+        alive = True
+        for rec in immediate:
+            alive = alive and chunk(rec)
+        pending = set(submitted)
+        # records already terminal before the listener registered (the
+        # submit itself rejected, or a racing tiny request)
+        for rid in submitted:
+            rec = snaps[rid]
+            if rec["status"] in TERMINAL_STATUSES and rid in pending:
+                pending.discard(rid)
+                alive = alive and chunk(self._sanitize(rec))
+        deadline = _monotonic() + self.gw.stream_timeout_s
+        while pending and alive:
+            try:
+                rec = results.get(timeout=max(0.05,
+                                              deadline - _monotonic()))
+            except queue_lib.Empty:
+                chunk({"error": f"stream timeout after "
+                                f"{self.gw.stream_timeout_s:g}s; poll "
+                                f"GET /v1/requests/<id> for the rest",
+                       "pending": sorted(pending)})
+                break
+            rid = rec.get("id")
+            if rid in pending:
+                pending.discard(rid)
+                alive = alive and chunk(self._sanitize(rec))
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+def _monotonic() -> float:
+    import time
+
+    return time.monotonic()

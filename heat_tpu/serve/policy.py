@@ -1,0 +1,221 @@
+"""Pluggable admission policies + SLO accounting for the serving engine.
+
+PR 3-5 built an engine whose admission queue was a hard-coded FIFO deque:
+correct for a batch drain, but an *online* service (serve/gateway.py) has
+tenants with different urgency, and the ``deadline_ms`` plumbing PR 5
+added only ever *shed* late requests — it never shaped who runs next.
+This module extracts the queue behind a small policy interface so the
+scheduler stops caring about ordering:
+
+- ``fifo`` — a deque, pop order bit-identical to the pre-policy engine
+  (regression-locked in tests/test_serve_policy.py against a lane-
+  assignment trace captured from the PR-5 scheduler). The default: solo
+  ``heat-tpu serve --requests`` behaves exactly as before.
+- ``edf`` — earliest-deadline-first *within* an SLO class, classes in
+  priority order (``config.SLO_CLASSES``: interactive < standard <
+  batch). Requests without a deadline sort after every dated request of
+  their class; submit order breaks ties, so ``edf`` degrades to ``fifo``
+  when nobody sets deadlines. This is the Orca/vLLM-shaped admission
+  story: deadlines shape *ordering*, not just shedding.
+- ``fair`` — weighted fair share *across tenants* (start-time-style
+  virtual time: each tenant accumulates served work divided by its
+  weight; the next admission goes to the backlogged tenant with the
+  least normalized service), EDF-within-class *inside* each tenant.
+  A flooding tenant cannot starve another past its weight ratio, and a
+  tenant returning from idle is capped to the current virtual time so it
+  cannot hoard credit while away.
+
+Thread-safety contract: queue objects are NOT internally locked — every
+push/pop happens under the engine's one lock (scheduler.py), which also
+keeps the per-tenant queue-depth counters consistent with the queues.
+
+The module also hosts the tiny Prometheus-shaped ``Histogram`` the
+gateway's ``/metrics`` surface exports (per-class latency, queue depth):
+stdlib-only, cumulative buckets, text rendering in serve/gateway.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import heapq
+import itertools
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..config import SLO_CLASSES
+
+POLICIES = ("fifo", "edf", "fair")
+
+
+def _edf_key(req) -> Tuple[int, float, int]:
+    """(class priority, deadline, submit seq): classes strictly first,
+    earliest absolute deadline inside a class, FIFO among undated peers
+    (deadline +inf). ``req.seq`` is the engine-wide submit counter, so the
+    ordering is total and deterministic."""
+    deadline = req.deadline_t if req.deadline_t is not None else math.inf
+    return (SLO_CLASSES.get(req.slo_class, max(SLO_CLASSES.values())),
+            deadline, req.seq)
+
+
+class FifoQueue:
+    """The pre-policy behavior, verbatim: pop in submit order."""
+
+    def __init__(self):
+        self._q = collections.deque()
+
+    def push(self, req) -> None:
+        self._q.append(req)
+
+    def pop(self):
+        return self._q.popleft() if self._q else None
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def __bool__(self) -> bool:
+        return bool(self._q)
+
+
+class EdfQueue:
+    """Class-priority + earliest-deadline-first heap (see module doc)."""
+
+    def __init__(self):
+        self._h: List[Tuple[Tuple[int, float, int], object]] = []
+
+    def push(self, req) -> None:
+        heapq.heappush(self._h, (_edf_key(req), req))
+
+    def pop(self):
+        return heapq.heappop(self._h)[1] if self._h else None
+
+    def __len__(self) -> int:
+        return len(self._h)
+
+    def __bool__(self) -> bool:
+        return bool(self._h)
+
+
+class FairShareQueue:
+    """Weighted fair share across tenants, EDF-within-class per tenant.
+
+    Classic virtual-time WFQ over request *work* (``points * steps`` —
+    a tenant of many small requests and a tenant of few huge ones get
+    wall-proportional shares, not request-count-proportional): popping a
+    tenant's request advances that tenant's virtual time by
+    ``work / weight``; the next pop serves the backlogged tenant with
+    the smallest virtual time (tenant name breaks exact ties, so the
+    order is deterministic). A tenant whose queue just went non-empty is
+    raised to the minimum active virtual time — returning from idle must
+    not replay banked credit and lock everyone else out.
+    """
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None):
+        self._weights = dict(weights or {})
+        self._tenants: Dict[str, List] = {}   # tenant -> EDF heap
+        self._vtime: Dict[str, float] = {}
+        self._count = 0
+
+    def _weight(self, tenant: str) -> float:
+        return float(self._weights.get(tenant, 1.0))
+
+    def push(self, req) -> None:
+        h = self._tenants.get(req.tenant)
+        if h is None:
+            h = self._tenants[req.tenant] = []
+        if not h:
+            # idle -> backlogged: catch up to the busiest floor
+            active = [self._vtime[t] for t, q in self._tenants.items()
+                      if q and t != req.tenant]
+            floor = min(active) if active else 0.0
+            self._vtime[req.tenant] = max(
+                self._vtime.get(req.tenant, 0.0), floor)
+        heapq.heappush(h, (_edf_key(req), req))
+        self._count += 1
+
+    def pop(self):
+        live = [(self._vtime[t], t) for t, h in self._tenants.items() if h]
+        if not live:
+            return None
+        _, tenant = min(live)
+        req = heapq.heappop(self._tenants[tenant])[1]
+        self._count -= 1
+        work = float(req.cfg.points * max(req.cfg.ntime, 1))
+        self._vtime[tenant] += work / self._weight(tenant)
+        return req
+
+    def __len__(self) -> int:
+        return self._count
+
+    def __bool__(self) -> bool:
+        return self._count > 0
+
+
+def make_queue(policy: str, tenant_weights=()):
+    """One admission queue for one bucket group under ``policy``."""
+    if policy == "fifo":
+        return FifoQueue()
+    if policy == "edf":
+        return EdfQueue()
+    if policy == "fair":
+        return FairShareQueue(dict(tenant_weights))
+    raise ValueError(f"policy must be one of {POLICIES}, got {policy!r}")
+
+
+# --- /metrics primitives -----------------------------------------------------
+
+# Latency-shaped default buckets (seconds): sub-ms admission rejections up
+# through minute-scale batch solves; queue-depth histograms reuse the same
+# machinery with integer buckets.
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+DEPTH_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+
+
+class Histogram:
+    """A Prometheus-style cumulative histogram (stdlib-only).
+
+    ``observe`` is called from the scheduler AND writer threads, so it
+    carries its own lock (deliberately not the engine lock: a /metrics
+    scrape must never contend with the boundary hot path for the lock
+    that guards admission)."""
+
+    def __init__(self, buckets=LATENCY_BUCKETS):
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+            self._sum += v
+            self._n += 1
+
+    def snapshot(self) -> dict:
+        """Cumulative (le -> count) pairs + sum/count, scrape-consistent."""
+        with self._lock:
+            counts = list(self._counts)
+            total_sum, n = self._sum, self._n
+        cum = list(itertools.accumulate(counts))
+        les = [*(f"{b:g}" for b in self.buckets), "+Inf"]
+        return {"buckets": list(zip(les, cum)), "sum": total_sum, "count": n}
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-upper-bound estimate of the q-quantile (the benchmark's
+        p50/p95/p99 reporting; None when empty). Conservative: returns the
+        smallest bucket bound covering q of the observations."""
+        snap = self.snapshot()
+        if not snap["count"]:
+            return None
+        target = q * snap["count"]
+        for le, cum in snap["buckets"]:
+            if cum >= target:
+                return math.inf if le == "+Inf" else float(le)
+        return math.inf

@@ -85,15 +85,21 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..config import HeatConfig
+from ..config import (DEFAULT_SLO_CLASS, DEFAULT_TENANT, HeatConfig,
+                      validate_slo_fields)
 from ..grid import initial_condition
 from ..runtime import async_io, faults
 from ..runtime.logging import json_record, master_print
+from . import policy as policy_mod
 from .engine import BucketKey, LaneEngine, lane_tier, wall_clock
+
+# Statuses a record can never leave: what poll()/wait() callers and the
+# gateway's streaming responses key on.
+TERMINAL_STATUSES = ("ok", "rejected", "error", "nonfinite", "deadline")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,6 +150,21 @@ class ServeConfig:
                               # grammar incl. the serve kinds lane-nan /
                               # fetch-hang); per-request specs ride each
                               # request's own "inject" key
+    policy: str = "fifo"      # admission ordering (serve/policy.py):
+                              # "fifo" = submit order (bit-identical to
+                              # the pre-policy engine), "edf" = SLO-class
+                              # priority + earliest-deadline-first within
+                              # a class, "fair" = weighted fair share
+                              # across tenants with EDF inside each
+    tenant_weights: tuple = ()  # (("name", weight), ...) fair-share
+                              # weights; unlisted tenants weigh 1.0
+    tenant_quota: Optional[int] = None  # per-tenant admission sub-quota:
+                              # one tenant may hold at most this many
+                              # queued requests (structured "overloaded"
+                              # rejection past it) — the flood guard
+                              # --max-queue alone cannot give, because a
+                              # single tenant can fill a shared bound;
+                              # None/0 = no per-tenant bound
 
     def __post_init__(self):
         if self.lanes < 1:
@@ -167,6 +188,18 @@ class ServeConfig:
         if self.fetch_timeout_s is not None and self.fetch_timeout_s <= 0:
             raise ValueError(f"fetch_timeout_s must be > 0 (None = no "
                              f"watchdog), got {self.fetch_timeout_s}")
+        if self.policy not in policy_mod.POLICIES:
+            raise ValueError(f"policy must be one of {policy_mod.POLICIES}, "
+                             f"got {self.policy!r}")
+        for entry in self.tenant_weights:
+            name, weight = entry
+            validate_slo_fields(name, None)
+            if not float(weight) > 0:
+                raise ValueError(f"tenant weight must be > 0, got "
+                                 f"{name}={weight}")
+        if self.tenant_quota is not None and self.tenant_quota < 0:
+            raise ValueError(f"tenant_quota must be >= 0 (None/0 = "
+                             f"unbounded), got {self.tenant_quota}")
         if self.inject:
             # fail at construction, not at a boundary mid-drain (same
             # parse-time contract as HeatConfig.inject)
@@ -191,6 +224,11 @@ class Request:
                                         # clock), resolved at submit from
                                         # the request's deadline_ms or the
                                         # engine default; None = none
+    tenant: str = DEFAULT_TENANT        # fair-share / quota accounting key
+    slo_class: str = DEFAULT_SLO_CLASS  # SLO class (config.SLO_CLASSES)
+    seq: int = 0                        # engine-wide submit counter: the
+                                        # FIFO order and every policy's
+                                        # deterministic tiebreak
 
 
 def _bucket_for(cfg: HeatConfig, buckets) -> Optional[int]:
@@ -259,6 +297,10 @@ class _GroupRunner:
         self.inflight: collections.deque = collections.deque()
         self.idle_from: Optional[float] = None  # group device queue empty
                                                 # since (boundary gaps only)
+        self.allow_growth = False   # online loop opts in: offline run()
+                                    # sizes runners from the full queue,
+                                    # so growth (and its pipeline drain)
+                                    # must never perturb the batch shape
         self._fill()
 
     # --- admission into lanes --------------------------------------------
@@ -268,11 +310,19 @@ class _GroupRunner:
         but with chunks in flight they overlap device compute instead of
         extending a fence. Queued requests already past their deadline
         are shed here — failing fast beats occupying a lane for a result
-        nobody is waiting for."""
+        nobody is waiting for. Pops happen under the engine lock (the
+        gateway's HTTP threads push concurrently); which request pops is
+        the admission policy's call (serve/policy.py), recorded in
+        ``Engine.admission_trace``."""
         outer = self.outer
         for lane in range(self.lanes):
             while self.occupant[lane] is None and self.q:
-                req = self.q.popleft()
+                with outer._lock:
+                    req = self.q.pop()
+                    if req is None:
+                        break
+                    outer._queued_by_tenant[req.tenant] -= 1
+                    outer.admission_trace.append(req.id)
                 now = wall_clock()
                 if req.deadline_t is not None and now > req.deadline_t:
                     outer._fail_request(
@@ -326,6 +376,12 @@ class _GroupRunner:
         copy, also enqueued without a fence)."""
         poison = self.outer._has_lane_faults
         while len(self.inflight) < self.depth:
+            if self.allow_growth and self._growth_wanted():
+                # stop feeding the pipeline: once the in-flight chunks
+                # drain, maybe_grow rebuilds the group at the wider tier
+                # (a short deliberate bubble instead of a burst serving
+                # single-lane indefinitely)
+                break
             live = self._live_remaining()
             if not live:
                 break
@@ -490,7 +546,93 @@ class _GroupRunner:
         return (bool(self.inflight) or bool(self.q)
                 or any(o is not None for o in self.occupant))
 
+    # --- online lane-tier growth ------------------------------------------
+    def _growth_wanted(self) -> bool:
+        if self.lanes >= self.outer.scfg.lanes:
+            return False
+        occupied = sum(o is not None for o in self.occupant)
+        want = lane_tier(max(1, min(occupied + len(self.q),
+                                    self.outer.scfg.lanes)),
+                         self.outer.scfg.lanes)
+        return want > self.lanes
+
+    def maybe_grow(self) -> None:
+        """Streaming admission can outgrow the lane tier this runner was
+        born with (the first online request builds a tier-1 group; a
+        burst then queues behind one lane). At an empty-pipeline boundary
+        — no chunk in flight, so the live stack IS the last judged state
+        — rebuild the group at the demanded tier and transplant every
+        occupant bit-exactly: crop its field out (one D2H), reload it
+        into the wider stack with the same remaining count (the host
+        countdown mirror is exact by construction). Bounded cost: tiers
+        are powers of two capped at ``--lanes``, so a group grows at most
+        log2(lanes) times for its whole lifetime. Offline ``run()`` sizes
+        runners from the full queue up front, so this never fires there
+        (the PR-3..5 admission traces stay byte-identical)."""
+        outer = self.outer
+        if self.inflight or not self.allow_growth or not self._growth_wanted():
+            return
+        occupied = sum(o is not None for o in self.occupant)
+        want = lane_tier(max(1, min(occupied + len(self.q),
+                                    outer.scfg.lanes)), outer.scfg.lanes)
+        old_eng, old_occ = self.eng, self.occupant
+        old_rem, old_nan, old_rb = self.dev_rem, self.nan_pending, self.rb_left
+        self.lanes = want
+        self.eng = LaneEngine(self.key, want, self.chunk,
+                              compiled_cache=outer._compiled,
+                              on_compile=outer._note_compile)
+        self.occupant = [None] * want
+        self.epoch = [self.seq] * want
+        self.dev_rem = np.zeros(want, dtype=np.int64)
+        self.nan_pending = [[] for _ in range(want)]
+        self.rb_left = [0] * want
+        self.last_good = [None] * want
+        for lane, req in enumerate(old_occ):
+            if req is None:
+                continue
+            T = old_eng.extract_lane(lane, req.cfg.n)
+            self.eng.load_lane(lane, T, float(req.cfg.r),
+                               int(old_rem[lane]), req.cfg.bc_value)
+            self.occupant[lane] = req
+            self.dev_rem[lane] = old_rem[lane]
+            self.nan_pending[lane] = old_nan[lane]
+            self.rb_left[lane] = old_rb[lane]
+            # the old tier's stack snapshots have the old lane count: drop
+            # them; a post-growth rollback re-steps from the IC instead
+        outer.lane_grows += 1
+        self._fill()
+
     # --- synchronous fallback (--dispatch-depth off) ----------------------
+    def sync_round(self) -> None:
+        """One fenced boundary of the PR-3 shape: dispatch a chunk, fetch
+        it immediately (the fetch fences the chunk), judge every lane on
+        the scheduler thread, refill. ``run_sync`` loops it to drain; the
+        online loop calls it round-robin across groups so depth-0 engines
+        still stream admissions."""
+        outer = self.outer
+        finite = None
+        snap = None
+        if self._live_remaining():
+            if outer._has_lane_faults:
+                self._maybe_poison()
+            t0 = wall_clock()
+            if self.idle_from is not None:
+                # device sat idle from the last fetch's return until
+                # this dispatch — the fence cost the A/B demonstrates
+                outer.device_idle_s += t0 - self.idle_from
+            b = self._fetch(self.eng.dispatch_chunk())
+            rem, finite = b[0], b[1]
+            outer.chunks_dispatched += 1
+            self.idle_from = wall_clock()
+            np.maximum(self.dev_rem - self.chunk, 0, out=self.dev_rem)
+            if self.rollback:
+                snap = self.eng.snapshot_stack()
+        else:
+            rem = self.dev_rem
+        self._judge_lanes(self.seq, rem, finite, snap, sync=True)
+        self.seq += 1
+        self._fill()
+
     def run_sync(self) -> None:
         """The PR-3 shape, kept for debugging A/Bs: fetch every boundary
         as its chunk is dispatched (the fetch fences the whole chunk) and
@@ -499,30 +641,8 @@ class _GroupRunner:
         vector carries the finite bits either way, and here the live
         stack IS the fetched boundary's state, so rollback snapshots are
         taken after the fetch, from a boundary already judged."""
-        outer = self.outer
         while self.has_work():
-            finite = None
-            snap = None
-            if self._live_remaining():
-                if outer._has_lane_faults:
-                    self._maybe_poison()
-                t0 = wall_clock()
-                if self.idle_from is not None:
-                    # device sat idle from the last fetch's return until
-                    # this dispatch — the fence cost the A/B demonstrates
-                    outer.device_idle_s += t0 - self.idle_from
-                b = self._fetch(self.eng.dispatch_chunk())
-                rem, finite = b[0], b[1]
-                outer.chunks_dispatched += 1
-                self.idle_from = wall_clock()
-                np.maximum(self.dev_rem - self.chunk, 0, out=self.dev_rem)
-                if self.rollback:
-                    snap = self.eng.snapshot_stack()
-            else:
-                rem = self.dev_rem
-            self._judge_lanes(self.seq, rem, finite, snap, sync=True)
-            self.seq += 1
-            self._fill()
+            self.sync_round()
 
 
 class Engine:
@@ -539,14 +659,34 @@ class Engine:
 
     def __init__(self, scfg: ServeConfig = ServeConfig()):
         self.scfg = scfg
-        self._queues: Dict[BucketKey, collections.deque] = {}
+        self._queues: Dict[BucketKey, object] = {}  # policy queues
         self._records: List[dict] = []
         self._by_id: Dict[str, dict] = {}
         self._seq = 0
         # one engine-wide lock: records are mutated and emitted from both
         # the scheduler thread and the SnapshotWriter thread — JSON lines
-        # must not interleave mid-line and record mutation must not race
+        # must not interleave mid-line and record mutation must not race.
+        # The same lock guards every policy-queue push/pop (the gateway's
+        # HTTP threads submit while the scheduler thread pops) and backs
+        # the condition the online loop + wait() callers sleep on.
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._listeners: List[Callable[[dict], None]] = []
+        # online mode (serve/gateway.py): a background scheduler thread
+        # drains continuously; submit() feeds it while lanes run
+        self._thread: Optional[threading.Thread] = None
+        self._draining = False
+        self.loop_error: Optional[BaseException] = None
+        # SLO/admission observability: who is queued (per-tenant depth
+        # counters back the --tenant-quota check AND the /metrics queue
+        # gauge), which request was admitted when (the policy's observable
+        # output — the fifo regression test locks this trace), per-class
+        # end-to-end latency + queue-depth-at-submit histograms
+        self._queued_by_tenant: collections.Counter = collections.Counter()
+        self.admission_trace: List[str] = []
+        self.lat_hist: Dict[str, policy_mod.Histogram] = {}
+        self.depth_hist = policy_mod.Histogram(policy_mod.DEPTH_BUCKETS)
+        self.lane_grows = 0          # online lane-tier growth events
         # one compiled-program cache for the engine's lifetime: repeated
         # runs (a long-lived server draining wave after wave) never pay a
         # second (bucket, lane-tier) compile
@@ -588,27 +728,41 @@ class Engine:
 
     # --- admission --------------------------------------------------------
     def submit(self, cfg: HeatConfig, request_id: Optional[str] = None,
-               deadline_ms: Optional[float] = None) -> str:
+               deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None,
+               slo_class: Optional[str] = None) -> str:
         """Admit one request; returns its id. Unservable requests become
         status='rejected' records instead of raising (see module doc).
         ``deadline_ms`` (request JSONL field of the same name) bounds the
         request's wall time from submission; it overrides the engine
-        default ``ServeConfig.deadline_ms``."""
-        rid = request_id or f"req-{self._seq:04d}"
-        self._seq += 1
-        if rid in self._by_id:
-            raise ValueError(f"duplicate request id {rid!r}")
+        default ``ServeConfig.deadline_ms``. ``tenant``/``slo_class``
+        (JSONL/HTTP fields ``tenant``/``class``) drive the fair-share and
+        EDF admission policies; malformed values raise (the JSONL/HTTP
+        front doors pre-validate them into per-request rejections).
+
+        Thread-safe: the gateway's HTTP handler threads call this while
+        the online scheduler thread is mid-drain — shared state mutates
+        under the engine lock and the scheduler is woken per submit."""
+        tenant, slo_class = validate_slo_fields(tenant, slo_class)
         if deadline_ms is not None and deadline_ms <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         deadline_ms = (deadline_ms if deadline_ms is not None
                        else self.scfg.deadline_ms)
-        rec = {"id": rid, "n": cfg.n, "ndim": cfg.ndim, "ntime": cfg.ntime,
-               "dtype": cfg.dtype, "bc": cfg.bc, "status": "queued",
-               "bucket": None, "lane": None, "queue_wait_s": None,
-               "solve_s": None, "steps_per_s": None, "error": None,
-               "deadline_ms": deadline_ms}
-        self._records.append(rec)
-        self._by_id[rid] = rec
+        shed_reason = None
+        with self._lock:
+            seq = self._seq
+            rid = request_id or f"req-{seq:04d}"
+            self._seq += 1
+            if rid in self._by_id:
+                raise ValueError(f"duplicate request id {rid!r}")
+            rec = {"id": rid, "n": cfg.n, "ndim": cfg.ndim,
+                   "ntime": cfg.ntime, "dtype": cfg.dtype, "bc": cfg.bc,
+                   "tenant": tenant, "class": slo_class, "status": "queued",
+                   "bucket": None, "lane": None, "queue_wait_s": None,
+                   "solve_s": None, "steps_per_s": None, "error": None,
+                   "deadline_ms": deadline_ms, "_submit_t": wall_clock()}
+            self._records.append(rec)
+            self._by_id[rid] = rec
         if cfg.bc == "periodic":
             self._reject(rec, "unsupported-bc: periodic has no padded-lane "
                               "form (wraparound would wrap at the bucket "
@@ -620,21 +774,39 @@ class Engine:
                               f"exceeds the biggest bucket "
                               f"{max(self.scfg.buckets)}")
             return rid
-        if self.scfg.max_queue:
-            queued = sum(len(q) for q in self._queues.values())
-            if queued >= self.scfg.max_queue:
-                self.shed += 1
-                self._reject(rec, f"overloaded: admission queue full "
-                                  f"({queued} queued >= --max-queue "
-                                  f"{self.scfg.max_queue}); resubmit later")
-                return rid
         key = BucketKey(ndim=cfg.ndim, n=b, dtype=cfg.dtype, bc=cfg.bc)
-        rec["bucket"] = b
-        submit_t = wall_clock()
-        self._queues.setdefault(key, collections.deque()).append(
-            Request(id=rid, cfg=cfg, submit_t=submit_t, key=key,
+        with self._cond:
+            queued = sum(len(q) for q in self._queues.values())
+            if self.scfg.max_queue and queued >= self.scfg.max_queue:
+                self.shed += 1
+                shed_reason = (f"overloaded: admission queue full "
+                               f"({queued} queued >= --max-queue "
+                               f"{self.scfg.max_queue}); resubmit later")
+            elif (self.scfg.tenant_quota
+                  and self._queued_by_tenant[tenant]
+                  >= self.scfg.tenant_quota):
+                self.shed += 1
+                shed_reason = (f"overloaded: tenant {tenant!r} holds "
+                               f"{self._queued_by_tenant[tenant]} queued "
+                               f"request(s) >= its --tenant-quota "
+                               f"{self.scfg.tenant_quota}; resubmit later")
+            else:
+                rec["bucket"] = b
+                submit_t = rec["_submit_t"]
+                q = self._queues.get(key)
+                if q is None:
+                    q = self._queues[key] = policy_mod.make_queue(
+                        self.scfg.policy, self.scfg.tenant_weights)
+                q.push(Request(
+                    id=rid, cfg=cfg, submit_t=submit_t, key=key,
                     deadline_t=(submit_t + deadline_ms / 1e3
-                                if deadline_ms is not None else None)))
+                                if deadline_ms is not None else None),
+                    tenant=tenant, slo_class=slo_class, seq=seq))
+                self._queued_by_tenant[tenant] += 1
+                self.depth_hist.observe(float(queued + 1))
+                self._cond.notify_all()   # wake the online scheduler
+        if shed_reason is not None:
+            self._reject(rec, shed_reason)
         return rid
 
     def _lane_nan_steps(self, req: Request) -> List[int]:
@@ -681,8 +853,11 @@ class Engine:
         still-queued request of THIS group fails with a structured
         record — and the other groups keep draining. This is the
         fail-clean alternative to `heat-tpu serve` hanging forever on
-        one dead fetch."""
-        self.watchdog_fired += 1
+        one dead fetch. (The online loop reuses it as the generic
+        fail-everything exit when the scheduler loop itself dies — only
+        a real watchdog timeout bumps the watchdog counter.)"""
+        if isinstance(exc, async_io.BoundedFetchTimeout):
+            self.watchdog_fired += 1
         master_print(f"serve fetch watchdog: bucket {runner.key} boundary "
                      f"fetch hung ({exc}); failing the group's "
                      f"{sum(o is not None for o in runner.occupant)} "
@@ -694,23 +869,103 @@ class Engine:
                     f"fetch-watchdog: {exc} — lane {lane}'s group state "
                     f"is unreadable; request failed cleanly", lane=lane)
                 runner.occupant[lane] = None
-        while runner.q:
-            req = runner.q.popleft()
+        while True:
+            with self._lock:
+                req = runner.q.pop()
+                if req is not None:
+                    self._queued_by_tenant[req.tenant] -= 1
+            if req is None:
+                break
             self._fail_request(
                 req, "error",
                 f"fetch-watchdog: {exc} — request was still queued when "
                 f"its bucket group's boundary fetch hung")
         runner.inflight.clear()
 
+    @staticmethod
+    def _public(rec: dict) -> dict:
+        """A record as callers see it: no field payload (``T`` can be a
+        multi-MiB array — poll it explicitly via results()/records), no
+        internal ``_``-prefixed bookkeeping."""
+        return {k: v for k, v in rec.items()
+                if k != "T" and not k.startswith("_")}
+
     def _emit(self, rec: dict) -> None:
-        """Emit one request record as a JSON line. Called from the
-        scheduler thread (rejections) AND the writer thread (finishes);
-        the lock keeps concurrent lines from interleaving mid-line and
-        snapshots the record fields consistently."""
-        if self.scfg.emit_records:
-            with self._lock:
-                json_record("serve_request",
-                            **{k: v for k, v in rec.items() if k != "T"})
+        """Emit one request record: a JSON line (when enabled), the
+        per-class latency histogram observation, a condition broadcast
+        for ``wait()`` callers, and every registered listener. Called
+        from the scheduler thread (rejections) AND the writer thread
+        (finishes); the lock keeps concurrent lines from interleaving
+        mid-line and snapshots the record fields consistently. Every
+        emission is a terminal transition — records are only ever
+        emitted once their status can no longer change."""
+        now = wall_clock()
+        with self._cond:
+            snap = self._public(rec)
+            listeners = list(self._listeners)
+            submit_t = rec.get("_submit_t")
+            if submit_t is not None and snap.get("status") != "rejected":
+                cls = snap.get("class", "standard")
+                h = self.lat_hist.get(cls)
+                if h is None:
+                    h = self.lat_hist[cls] = policy_mod.Histogram()
+                h.observe(max(0.0, now - submit_t))
+            if self.scfg.emit_records:
+                json_record("serve_request", **snap)
+            self._cond.notify_all()
+        # listeners run OUTSIDE the lock: they may call poll()/summary()
+        for fn in listeners:
+            try:
+                fn(snap)
+            except Exception:  # noqa: BLE001 — a broken listener must not
+                pass           # fail the request it is being told about
+
+    # --- incremental consumption (poll / wait / listeners) ----------------
+    def poll(self, request_id: str) -> Optional[dict]:
+        """Snapshot one request's record right now (``None`` — unknown
+        id). Unlike ``results()`` this never blocks and never drains:
+        the gateway's ``GET /v1/requests/<id>`` and any library caller
+        can watch a request finish while the engine keeps running."""
+        with self._lock:
+            rec = self._by_id.get(request_id)
+            return None if rec is None else self._public(rec)
+
+    def wait(self, request_id: str, timeout: Optional[float] = None
+             ) -> Optional[dict]:
+        """Block until a request's record is terminal; returns the record
+        snapshot, or ``None`` on timeout. Raises KeyError for an unknown
+        id (a typo must not wait forever)."""
+        deadline = (wall_clock() + timeout) if timeout is not None else None
+        with self._cond:
+            while True:
+                rec = self._by_id.get(request_id)
+                if rec is None:
+                    raise KeyError(f"unknown request id {request_id!r}")
+                if rec["status"] in TERMINAL_STATUSES:
+                    return self._public(rec)
+                remaining = (None if deadline is None
+                             else deadline - wall_clock())
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining if remaining is not None else 0.5)
+
+    def add_listener(self, fn: Callable[[dict], None]) -> None:
+        """Register a results-ready callback: ``fn(record_snapshot)``
+        fires once per request at its terminal transition — the moment
+        its lane retires (or it is rejected/failed), not at drain. May be
+        called from the scheduler or writer thread; keep it quick."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Queued (not yet admitted) request count per tenant."""
+        with self._lock:
+            return {t: n for t, n in self._queued_by_tenant.items() if n}
 
     # --- execution --------------------------------------------------------
     def run(self) -> List[dict]:
@@ -720,6 +975,11 @@ class Engine:
         compiled programs."""
         from ..runtime.timing import Timing
 
+        if self.online:
+            raise RuntimeError(
+                "Engine.run()/results() cannot be called while the online "
+                "scheduler thread is serving — use poll()/wait() for "
+                "records, shutdown() to drain")
         writer = async_io.SnapshotWriter()
         t0 = wall_clock()
         try:
@@ -768,22 +1028,143 @@ class Engine:
         # normal exit: per-request jobs swallow their own failures, so a
         # surviving writer error here is a real bug and must surface
         writer.drain()
-        wall = wall_clock() - t0
+        self._stamp_timing(Timing, wall_clock() - t0)
+        return list(self._records)
+
+    def _stamp_timing(self, Timing, wall: float) -> None:
         self.timing = Timing(total_s=wall, solve_s=wall,
                              compile_s=self.compile_s,
                              dispatch_depth=self.scfg.dispatch_depth,
+                             serve_policy=self.scfg.policy,
                              boundary_wait_s=round(self.boundary_wait_s, 6),
                              lanes_quarantined=self.lanes_quarantined,
                              rollbacks=self.rollbacks,
                              deadline_misses=self.deadline_misses,
                              shed=self.shed)
-        return list(self._records)
 
     def results(self) -> List[dict]:
         """``run`` + records (the common library call)."""
         if any(self._queues.values()):
             self.run()
         return list(self._records)
+
+    # --- online mode (the gateway's engine shape) -------------------------
+    @property
+    def online(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def start(self) -> "Engine":
+        """Start the online scheduler thread: from here on ``submit()``
+        feeds lanes *while they run* — requests arriving between chunk
+        boundaries are admitted at the next one (the Orca iteration-level
+        contract, now actually online). Idempotent while running."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._draining = False
+            self.loop_error = None
+            self._thread = threading.Thread(
+                target=self._serve_loop, daemon=True,
+                name="heat-tpu-serve-scheduler")
+            self._thread.start()
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admission-by-policy: the online loop finishes every lane
+        already admitted AND every request already queued, then exits.
+        Callers gate *new* work themselves (the gateway 503s new solves
+        the moment draining flips). Idempotent."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    def shutdown(self, timeout: Optional[float] = None) -> bool:
+        """``begin_drain`` + join the scheduler thread. Returns True once
+        the loop has exited (False = still draining after ``timeout``).
+        Idempotent: safe to call repeatedly and without ``start()``."""
+        self.begin_drain()
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        if t.is_alive():
+            return False
+        with self._lock:
+            self._thread = None
+        return True
+
+    def _serve_loop(self) -> None:
+        """The online scheduler: the same dispatch-ahead round-robin as
+        ``run()``, but runners persist for the engine's lifetime, new
+        bucket groups appear as their first request arrives, idle groups
+        grow their lane tier when a burst outruns it, and an empty engine
+        parks on the condition variable until a submit (or drain) wakes
+        it. Exits when draining AND idle; the writer drains on every
+        exit path so no accepted request's writeback is dropped."""
+        from ..runtime.timing import Timing
+
+        writer = async_io.SnapshotWriter()
+        runners: Dict[BucketKey, _GroupRunner] = {}
+        t0 = wall_clock()
+        try:
+            while True:
+                with self._lock:
+                    keys = [k for k, q in self._queues.items() if q]
+                for key in keys:
+                    r = runners.get(key)
+                    if r is None:
+                        r = runners[key] = _GroupRunner(
+                            self, key, self._queues[key], writer)
+                        r.allow_growth = True
+                    else:
+                        r.maybe_grow()
+                        r._fill()
+                live = [r for r in runners.values() if r.has_work()]
+                if not live:
+                    with self._cond:
+                        if self._draining and not any(
+                                q for q in self._queues.values()):
+                            break
+                        # parked: a submit()/begin_drain() notify wakes us;
+                        # the timeout only bounds lost-wakeup worst cases
+                        self._cond.wait(0.05)
+                    continue
+                if self.scfg.dispatch_depth == 0:
+                    for r in live:
+                        try:
+                            r.sync_round()
+                        except async_io.BoundedFetchTimeout as e:
+                            self._fail_group(r, e)
+                else:
+                    for r in live:
+                        r.dispatch_fill()
+                    for r in live:
+                        try:
+                            r.process_boundary()
+                            r.dispatch_fill()
+                        except async_io.BoundedFetchTimeout as e:
+                            self._fail_group(r, e)
+        except BaseException as e:  # noqa: BLE001 — surfaced via loop_error
+            # a scheduler-loop crash in a daemon thread has nowhere to
+            # propagate: record it (gateway /healthz + cmd_serve check it)
+            # and fail every in-flight/queued request cleanly
+            self.loop_error = e
+            master_print(f"serve scheduler loop failed: "
+                         f"{type(e).__name__}: {e}")
+            for r in runners.values():
+                self._fail_group(r, e)
+        finally:
+            try:
+                writer.drain(raise_errors=False)
+            finally:
+                self._stamp_timing(Timing, wall_clock() - t0)
+                with self._cond:
+                    self._cond.notify_all()  # unblock wait() callers
 
     # --- lane retirement --------------------------------------------------
     def _finish_timing(self, req: Request) -> dict:
@@ -855,8 +1236,15 @@ class Engine:
 
     # --- reporting --------------------------------------------------------
     def summary(self) -> dict:
-        by_status = collections.Counter(r["status"] for r in self._records)
-        return {"requests": len(self._records), **dict(by_status),
+        with self._lock:
+            by_status = collections.Counter(
+                r["status"] for r in self._records)
+            n = len(self._records)
+            queued = sum(len(q) for q in self._queues.values())
+        return {"requests": n, **dict(by_status),
+                "policy": self.scfg.policy,
+                "queued_now": queued,
+                "lane_grows": self.lane_grows,
                 "step_compiles": self.step_compiles,
                 "tail_compiles": self.tail_compiles,
                 "compile_s": round(self.compile_s, 3),

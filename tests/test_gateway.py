@@ -1,0 +1,333 @@
+"""Gateway lifecycle over a real localhost socket (serve/gateway.py).
+
+Every connection carries a timeout and every wait is bounded, so the
+suite cannot wedge tier-1: a hang is a failure, not a stall. The
+load-bearing contracts:
+
+- streaming admission: POSTs land in a RUNNING engine (submitted after
+  the scheduler started) and their results are bit-identical to solo
+  ``drive()`` runs of the same configs;
+- ``/healthz`` flips 200 -> 503 the moment ``/drainz`` is called, while
+  in-flight lanes still finish (graceful, idempotent drain);
+- ``--max-queue`` pressure answers 429 + ``Retry-After`` instead of
+  queueing without bound;
+- a ``lane-nan`` fault surfaces through HTTP as the same structured
+  ``nonfinite`` record the JSONL drain emits (the PR-5 fault-domain
+  contract survives the transport).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig
+from heat_tpu.runtime import faults
+from heat_tpu.serve import Engine, ServeConfig
+from heat_tpu.serve.gateway import Gateway, render_metrics
+
+TIMEOUT = 60   # every socket op and drain wait is bounded by this
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def make_gateway(tmp_path=None, start_engine=True, **scfg_kw):
+    scfg_kw.setdefault("emit_records", False)
+    scfg_kw.setdefault("lanes", 2)
+    scfg_kw.setdefault("chunk", 8)
+    scfg_kw.setdefault("buckets", (32,))
+    if tmp_path is not None:
+        scfg_kw.setdefault("out_dir", str(tmp_path / "results"))
+    eng = Engine(ServeConfig(**scfg_kw))
+    gw = Gateway(eng, "127.0.0.1", 0, start_engine=start_engine).start()
+    return gw, eng
+
+
+def http(gw, method, path, body=None, timeout=TIMEOUT):
+    """One bounded request; returns (status, parsed-lines, headers)."""
+    req = urllib.request.Request(
+        f"http://{gw.address}{path}",
+        data=body.encode() if body is not None else None, method=method)
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        status, raw, headers = resp.status, resp.read(), resp.headers
+    except urllib.error.HTTPError as e:
+        status, raw, headers = e.code, e.read(), e.headers
+    lines = [json.loads(l) for l in raw.decode().splitlines() if l.strip()]
+    return status, lines, headers
+
+
+def line(**kw) -> str:
+    return json.dumps(kw) + "\n"
+
+
+# --- streaming admission + end-to-end bit-identity ---------------------------
+
+
+def test_streaming_admission_bit_identical_to_solo_runs(tmp_path):
+    """Acceptance e2e: requests POSTed while lanes run (engine already
+    started, first request mid-flight) come back ok with npz outputs
+    bit-identical to solo drive() runs — including concurrent POSTs."""
+    gw, eng = make_gateway(tmp_path, keep_fields=True)
+    try:
+        # first request starts the lanes turning
+        st, lines0, _ = http(gw, "POST",
+                             "/v1/solve?wait=0",
+                             line(id="warm", n=16, ntime=300,
+                                  dtype="float64"))
+        assert st == 202 and lines0[0]["accepted"] == ["warm"]
+        assert eng.online
+        # two concurrent streaming POSTs while the first one runs
+        cfg_a = dict(id="a", n=16, ntime=24, dtype="float64", nu=0.1)
+        cfg_b = dict(id="b", n=24, ntime=12, dtype="float64", bc="ghost",
+                     ic="uniform")
+        results = {}
+
+        def post(name, payload):
+            st2, recs, _ = http(gw, "POST", "/v1/solve", line(**payload))
+            results[name] = (st2, recs)
+
+        threads = [threading.Thread(target=post, args=("a", cfg_a)),
+                   threading.Thread(target=post, args=("b", cfg_b))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(TIMEOUT)
+            assert not t.is_alive()
+        for name in ("a", "b"):
+            st2, recs = results[name]
+            assert st2 == 200
+            (rec,) = recs
+            assert rec["id"] == name and rec["status"] == "ok", rec
+            assert "T" not in rec       # stream carries records, not fields
+        assert eng.wait("warm", timeout=TIMEOUT)["status"] == "ok"
+    finally:
+        gw.request_drain()
+        assert gw.wait_drained(TIMEOUT)
+        gw.close()
+    # bit-identity against solo runs, through the npz the gateway wrote
+    out = tmp_path / "results"
+    for rid, cfg in (("warm", HeatConfig(n=16, ntime=300, dtype="float64")),
+                     ("a", HeatConfig(n=16, ntime=24, dtype="float64",
+                                      nu=0.1)),
+                     ("b", HeatConfig(n=24, ntime=12, dtype="float64",
+                                      bc="ghost", ic="uniform"))):
+        with np.load(out / f"{rid}.npz") as z:
+            np.testing.assert_array_equal(z["T"], solve(cfg).T)
+
+
+def test_poll_endpoint_and_unknown_routes(tmp_path):
+    gw, _ = make_gateway(tmp_path)
+    try:
+        st, recs, _ = http(gw, "POST", "/v1/solve",
+                           line(id="x", n=16, ntime=8, dtype="float64"))
+        assert st == 200 and recs[0]["status"] == "ok"
+        st, (rec,), _ = http(gw, "GET", "/v1/requests/x")
+        assert st == 200 and rec["status"] == "ok" and rec["id"] == "x"
+        st, _, _ = http(gw, "GET", "/v1/requests/nope")
+        assert st == 404
+        st, _, _ = http(gw, "GET", "/no/such/route")
+        assert st == 404
+        st, (err,), _ = http(gw, "POST", "/v1/solve", "")
+        assert st == 400 and "empty body" in err["error"]
+        # a malformed line is a per-line rejection, not a dropped batch
+        st, recs, _ = http(gw, "POST", "/v1/solve",
+                           "this is not json\n"
+                           + line(id="y", n=16, ntime=4, dtype="float64"))
+        assert st == 200
+        by_status = {r["status"] for r in recs}
+        assert by_status == {"rejected", "ok"}
+    finally:
+        gw.request_drain()
+        assert gw.wait_drained(TIMEOUT)
+        gw.close()
+
+
+# --- drain lifecycle ---------------------------------------------------------
+
+
+def test_healthz_flips_during_drain_and_inflight_finishes(tmp_path):
+    """/drainz stops admission immediately (healthz 503, solve 503 +
+    Retry-After) while the in-flight lane finishes; drain is
+    idempotent and the drained request stays pollable."""
+    gw, eng = make_gateway(tmp_path)
+    try:
+        st, _, _ = http(gw, "GET", "/healthz")
+        assert st == 200
+        st, _, _ = http(gw, "POST", "/v1/solve?wait=0",
+                        line(id="inflight", n=16, ntime=200,
+                             dtype="float64"))
+        assert st == 202
+        st, (d,), _ = http(gw, "POST", "/drainz")
+        assert st == 200 and d["draining"] is True
+        st, (h,), hdrs = http(gw, "GET", "/healthz")
+        assert st == 503 and h["status"] == "draining"
+        assert hdrs.get("Retry-After") is not None
+        st, _, hdrs = http(gw, "POST", "/v1/solve",
+                           line(n=16, ntime=4, dtype="float64"))
+        assert st == 503 and hdrs.get("Retry-After") is not None
+        assert gw.wait_drained(TIMEOUT)
+        # the in-flight request finished, not aborted: graceful drain
+        st, (rec,), _ = http(gw, "GET", "/v1/requests/inflight")
+        assert st == 200 and rec["status"] == "ok"
+        # idempotent: a second drainz reports drained
+        st, (d2,), _ = http(gw, "POST", "/drainz")
+        assert st == 200 and d2["drained"] is True
+        assert not eng.online
+    finally:
+        gw.close()
+
+
+# --- backpressure ------------------------------------------------------------
+
+
+def test_429_retry_after_under_max_queue_pressure():
+    """--max-queue pressure: with the scheduler deliberately held (not
+    started), the queue bound is deterministic — the first request
+    queues, the second answers 429 with a Retry-After hint and a
+    structured overloaded record."""
+    gw, eng = make_gateway(max_queue=1, start_engine=False)
+    try:
+        st, _, _ = http(gw, "POST", "/v1/solve?wait=0",
+                        line(id="q1", n=16, ntime=8, dtype="float64"))
+        assert st == 202
+        st, (body,), hdrs = http(gw, "POST", "/v1/solve?wait=0",
+                                 line(id="q2", n=16, ntime=8,
+                                      dtype="float64"))
+        assert st == 429
+        assert hdrs.get("Retry-After") is not None
+        assert int(hdrs["Retry-After"]) >= 1
+        (rec,) = body["records"]
+        assert rec["status"] == "rejected"
+        assert rec["error"].startswith("overloaded")
+        assert eng.shed == 1
+        # draining the engine serves the queued request normally
+        eng.start()
+        assert eng.wait("q1", timeout=TIMEOUT)["status"] == "ok"
+    finally:
+        gw.request_drain()
+        assert gw.wait_drained(TIMEOUT)
+        gw.close()
+
+
+# --- fault domains through HTTP ----------------------------------------------
+
+
+def test_lane_nan_fault_surfaces_as_structured_http_record(tmp_path):
+    """PR-5 contract through the transport: a lane-nan-poisoned request
+    streams back (and polls) as a structured nonfinite record; its
+    co-scheduled neighbor is untouched and no NaN npz is published."""
+    gw, eng = make_gateway(tmp_path, inject="lane-nan@10:req=bad")
+    try:
+        st, recs, _ = http(
+            gw, "POST", "/v1/solve",
+            line(id="bad", n=16, ntime=40, dtype="float64")
+            + line(id="good", n=16, ntime=40, dtype="float64", nu=0.1))
+        assert st == 200
+        by_id = {r["id"]: r for r in recs}
+        assert by_id["bad"]["status"] == "nonfinite"
+        assert "non-finite field detected at ~step" in by_id["bad"]["error"]
+        assert by_id["good"]["status"] == "ok"
+        st, (rec,), _ = http(gw, "GET", "/v1/requests/bad")
+        assert st == 200 and rec["status"] == "nonfinite"
+        assert eng.lanes_quarantined == 1
+    finally:
+        gw.request_drain()
+        assert gw.wait_drained(TIMEOUT)
+        gw.close()
+    assert not (tmp_path / "results" / "bad.npz").exists()
+    assert (tmp_path / "results" / "good.npz").exists()
+
+
+# --- /metrics ----------------------------------------------------------------
+
+
+def test_metrics_surface_over_http_and_inline(tmp_path):
+    gw, eng = make_gateway(tmp_path, tenant_quota=8)
+    try:
+        st, _, _ = http(gw, "POST", "/v1/solve",
+                        line(id="m1", n=16, ntime=8, dtype="float64",
+                             tenant="acme", **{"class": "interactive"})
+                        + line(id="m2", n=16, ntime=8, dtype="float64"))
+        assert st == 200
+        resp = urllib.request.urlopen(
+            f"http://{gw.address}/metrics", timeout=TIMEOUT)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        scraped = resp.read().decode()
+        assert 'heat_tpu_serve_requests_total{status="ok"} 2' in scraped
+    finally:
+        gw.request_drain()
+        assert gw.wait_drained(TIMEOUT)
+        gw.close()
+    text = render_metrics(eng)
+    assert 'heat_tpu_serve_requests_total{status="ok"} 2' in text
+    assert 'heat_tpu_serve_info{policy="fifo"' in text
+    assert ('heat_tpu_serve_request_latency_seconds_bucket'
+            '{class="interactive",le="+Inf"} 1') in text
+    assert ('heat_tpu_serve_request_latency_seconds_count'
+            '{class="standard"} 1') in text
+    assert "heat_tpu_serve_queue_depth_observed_bucket" in text
+    assert "heat_tpu_serve_shed_total 0" in text
+    assert "heat_tpu_serve_draining 1" in text
+
+
+# --- CLI gateway mode --------------------------------------------------------
+
+
+def test_serve_cli_listen_mode_end_to_end(tmp_cwd, capsys, monkeypatch):
+    """`heat-tpu serve --listen` runs until /drainz completes: drive a
+    whole session (pre-loaded JSONL file + one HTTP admission + drain)
+    through cli.main on a background thread."""
+    import heat_tpu.serve.gateway as gateway_mod
+    from heat_tpu.cli import main
+
+    (tmp_cwd / "reqs.jsonl").write_text(
+        '{"id": "preload", "n": 16, "ntime": 12, "dtype": "float64"}\n')
+    holder = {}
+    real_start = gateway_mod.Gateway.start
+
+    def capture_start(self):
+        holder["gw"] = self
+        return real_start(self)
+
+    monkeypatch.setattr(gateway_mod.Gateway, "start", capture_start)
+    rc = {}
+
+    def run_cli():
+        rc["rc"] = main(["serve", "--listen", "127.0.0.1:0",
+                         "--requests", "reqs.jsonl", "--buckets", "16",
+                         "--chunk", "8", "--policy", "edf"])
+
+    t = threading.Thread(target=run_cli)
+    t.start()
+    try:
+        deadline = TIMEOUT
+        while "gw" not in holder and deadline > 0:
+            import time
+
+            time.sleep(0.05)
+            deadline -= 0.05
+        gw = holder["gw"]
+        st, recs, _ = http(gw, "POST", "/v1/solve",
+                           line(id="net", n=16, ntime=8, dtype="float64"))
+        assert st == 200 and recs[0]["status"] == "ok"
+        st, _, _ = http(gw, "POST", "/drainz")
+        assert st == 200
+    finally:
+        t.join(TIMEOUT)
+        assert not t.is_alive()
+    assert rc["rc"] == 0
+    out = capsys.readouterr().out
+    assert "gateway listening on http://127.0.0.1:" in out
+    assert "served 2 request(s): 2 ok" in out
+    assert "policy edf" in out

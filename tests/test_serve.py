@@ -501,12 +501,58 @@ def test_serve_lab_ab_harness_smoke(tmp_path, capsys):
     assert rec["one_compile_per_bucket_lane_tier"] is True
 
 
+def test_serve_frontend_lab_harness_smoke(tmp_path):
+    """The front-end lab harness (offline policy-layer drain + online
+    Poisson EDF-vs-FIFO A/B) runs end-to-end on a tiny 8-request load
+    and emits every field the committed artifact relies on. Timing
+    thresholds deliberately NOT asserted beyond the structural EDF >=
+    FIFO invariant the lab itself enforces."""
+    import importlib.util
+    import sys
+    from pathlib import Path
+
+    bench_dir = Path(__file__).resolve().parent.parent / "benchmarks"
+    sys.path.insert(0, str(bench_dir))
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "serve_frontend_lab_smoke", bench_dir / "serve_frontend_lab.py")
+        lab = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lab)
+        out = tmp_path / "serve_frontend_lab.json"
+        rc = lab.main(["--requests", "8", "--lanes", "2", "--chunk", "8",
+                       "--out", str(out)])
+    finally:
+        sys.path.remove(str(bench_dir))
+    rec = json.loads(out.read_text())
+    assert rc == 0
+    assert rec["bench"] == "serve_frontend_lab"
+    assert rec["offline_drain"]["ok"] == 8
+    # small runs skip the committed-baseline compare (population differs)
+    assert rec["offline_drain"]["vs_serve_lab_engine"] is None
+    for side in ("online_fifo", "online_edf"):
+        blk = rec[side]
+        assert sum(blk["statuses"].values()) == 8
+        assert blk["deadline_carrying"] == 4
+        assert blk["deadline_hit_rate"] is not None
+        assert "latency_quantiles_s" in blk
+    assert (rec["online_edf"]["deadline_hit_rate"]
+            >= rec["online_fifo"]["deadline_hit_rate"])
+
+
 def test_serve_cli_missing_file(tmp_cwd, capsys):
     from heat_tpu.cli import main
 
     rc = main(["serve", "--requests", "nope.jsonl"])
     assert rc == 2
     assert "not found" in capsys.readouterr().err
+
+
+def test_serve_cli_requires_requests_or_listen(capsys):
+    from heat_tpu.cli import main
+
+    rc = main(["serve"])
+    assert rc == 2
+    assert "--listen" in capsys.readouterr().err
 
 
 # --- per-lane fault domains (ISSUE 5) ---------------------------------------
@@ -782,9 +828,29 @@ def test_serve_jsonl_deadline_ms_field(tmp_path):
                  '{"id": "b", "n": 16, "ntime": 4}\n'
                  '{"id": "c", "n": 16, "ntime": 4, "deadline_ms": -3}\n')
     rows = load_requests(p)
-    assert rows[0][0] == "a" and rows[0][2] == 2000.0 and rows[0][3] is None
-    assert rows[1][2] is None
-    assert rows[2][1] is None and "deadline_ms" in rows[2][3]
+    assert rows[0].id == "a" and rows[0].deadline_ms == 2000.0
+    assert rows[0].error is None
+    assert rows[1].deadline_ms is None
+    assert rows[2].cfg is None and "deadline_ms" in rows[2].error
+
+
+def test_serve_jsonl_tenant_and_class_fields(tmp_path):
+    """tenant/class are scheduler fields validated in config.py: good
+    values parse through, a typoed class is a per-line rejection."""
+    from heat_tpu.serve.api import load_requests
+
+    p = tmp_path / "reqs.jsonl"
+    p.write_text(
+        '{"id": "a", "n": 16, "ntime": 4, "tenant": "acme", '
+        '"class": "interactive"}\n'
+        '{"id": "b", "n": 16, "ntime": 4}\n'
+        '{"id": "c", "n": 16, "ntime": 4, "class": "premium"}\n'
+        '{"id": "d", "n": 16, "ntime": 4, "tenant": "bad tenant!"}\n')
+    rows = load_requests(p)
+    assert rows[0].tenant == "acme" and rows[0].slo_class == "interactive"
+    assert rows[1].tenant == "default" and rows[1].slo_class == "standard"
+    assert rows[2].cfg is None and "class" in rows[2].error
+    assert rows[3].cfg is None and "tenant" in rows[3].error
 
 
 def test_serve_cli_fault_domain_flags(tmp_cwd, capsys):

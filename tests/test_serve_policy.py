@@ -1,0 +1,355 @@
+"""Admission-policy layer (serve/policy.py) + incremental results seam.
+
+Three contracts, all socket-free:
+
+- **fifo regression**: extracting the queue behind the policy interface
+  must not move a single admission — the 64-request golden trace below
+  was captured from the PR-5 scheduler (deque-based, pre-policy) and the
+  refactored engine must reproduce it bit-for-bit.
+- **SLO policies**: EDF admits a later-submitted tighter-deadline request
+  first (and interactive-class ahead of standard regardless of
+  deadlines); fair share keeps a flooding tenant from starving another
+  past its weight; per-tenant quotas shed with a structured
+  ``overloaded`` record.
+- **incremental consumption** (the gateway's seam): listeners fire at
+  each request's terminal transition — before the drain finishes — and
+  ``poll``/``wait`` observe records while the engine keeps running.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig
+from heat_tpu.runtime import faults
+from heat_tpu.serve import Engine, ServeConfig
+from heat_tpu.serve import policy as policy_mod
+from heat_tpu.serve.engine import LaneEngine
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def quiet(**kw) -> ServeConfig:
+    kw.setdefault("emit_records", False)
+    return ServeConfig(**kw)
+
+
+# --- fifo bit-identity regression -------------------------------------------
+
+# Captured from the PR-5 scheduler (hard-coded deque admission) on the
+# exact wave below: the (lane, ntime) pairs LaneEngine.load_lane saw, in
+# order. ntimes are unique, so the pairs identify each request's
+# admission slot exactly. The policy refactor must not move ONE of them.
+GOLDEN_NTIMES = [3 + ((37 * i + 11) % 64) * 2 + (i % 2) for i in range(64)]
+GOLDEN_TRACE = [
+    [0, 25], [1, 100], [2, 45], [3, 120], [0, 65], [2, 12], [2, 85],
+    [1, 32], [0, 105], [3, 52], [1, 125], [2, 72], [3, 17], [3, 92],
+    [0, 37], [2, 112], [0, 57], [1, 4], [1, 77], [3, 24], [0, 97],
+    [3, 44], [2, 117], [1, 64], [3, 9], [3, 84], [1, 29], [0, 104],
+    [1, 49], [2, 124], [3, 69], [1, 16], [0, 89], [1, 36], [3, 109],
+    [1, 56], [2, 129], [0, 76], [1, 21], [1, 96], [3, 41], [0, 116],
+    [2, 61], [3, 8], [3, 81], [1, 28], [2, 101], [1, 48], [0, 121],
+    [3, 68], [1, 13], [1, 88], [2, 33], [3, 108], [2, 53], [0, 128],
+    [1, 73], [2, 20], [3, 93], [2, 40], [1, 113], [2, 60], [0, 5],
+    [0, 80]]
+
+
+def test_fifo_policy_admission_trace_bit_identical_to_pre_policy_engine():
+    """Satellite regression: same 64-request wave -> identical lane
+    assignment trace as the hard-coded-deque scheduler (golden captured
+    at PR 5). Locks both the pop ORDER (fifo == submit order) and the
+    lane placement the continuous-batching refill derives from it."""
+    trace = []
+    real = LaneEngine.load_lane
+
+    def spy(self, lane, field, r, steps, bc_value):
+        trace.append([int(lane), int(steps)])
+        return real(self, lane, field, r, steps, bc_value)
+
+    cfgs = [HeatConfig(n=12, ntime=t, dtype="float64")
+            for t in GOLDEN_NTIMES]
+    eng = Engine(quiet(lanes=4, chunk=8, buckets=(16,)))  # policy="fifo"
+    ids = [eng.submit(c) for c in cfgs]
+    LaneEngine.load_lane = spy
+    try:
+        recs = {r["id"]: r for r in eng.results()}
+    finally:
+        LaneEngine.load_lane = real
+    assert all(recs[i]["status"] == "ok" for i in ids)
+    assert trace == GOLDEN_TRACE
+    # the engine-side admission order agrees: fifo == submit order
+    assert eng.admission_trace == ids
+
+
+# --- EDF --------------------------------------------------------------------
+
+
+def fake_clock(monkeypatch, step=0.001):
+    """Deterministic wall clock: every read advances ``step`` seconds.
+    Patches the one seam both scheduler and engine timestamps use."""
+    import heat_tpu.serve.scheduler as sched_mod
+
+    t = {"now": 0.0}
+
+    def clock():
+        t["now"] += step
+        return t["now"]
+
+    monkeypatch.setattr(sched_mod, "wall_clock", clock)
+    return t
+
+
+def test_edf_admits_later_submitted_tighter_deadline_first(monkeypatch):
+    """Acceptance (fake clock): A (loose deadline) is submitted before
+    B (tight deadline), and an undated request before both. FIFO would
+    admit them in submit order — EDF must admit B first and the undated
+    request last, because the deadline now shapes admission order, not
+    just shedding."""
+    fake_clock(monkeypatch)
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,), policy="edf"))
+    undated = eng.submit(HeatConfig(n=16, ntime=12, dtype="float64"))
+    a = eng.submit(HeatConfig(n=16, ntime=4, dtype="float64"),
+                   deadline_ms=50_000_000.0)
+    b = eng.submit(HeatConfig(n=16, ntime=4, dtype="float64"),
+                   deadline_ms=2_000_000.0)
+    recs = {r["id"]: r for r in eng.results()}
+    assert all(r["status"] == "ok" for r in recs.values()), recs
+    assert eng.admission_trace == [b, a, undated]
+
+
+def test_edf_class_priority_outranks_deadline(monkeypatch):
+    """interactive > standard > batch strictly: an interactive request
+    with NO deadline still beats a standard request with a tight one;
+    undated requests of one class keep submit order among themselves."""
+    fake_clock(monkeypatch)
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,), policy="edf"))
+    undated_std = eng.submit(HeatConfig(n=16, ntime=12, dtype="float64"))
+    batch = eng.submit(HeatConfig(n=16, ntime=4, dtype="float64"),
+                       slo_class="batch", deadline_ms=1_000_000.0)
+    std = eng.submit(HeatConfig(n=16, ntime=4, dtype="float64"),
+                     deadline_ms=2_000_000.0)
+    inter = eng.submit(HeatConfig(n=16, ntime=4, dtype="float64"),
+                       slo_class="interactive")
+    recs = {r["id"]: r for r in eng.results()}
+    assert all(r["status"] == "ok" for r in recs.values())
+    # interactive first despite no deadline; dated standard before the
+    # undated one; dated batch dead last despite the tightest deadline
+    assert eng.admission_trace == [inter, std, undated_std, batch]
+    assert recs[inter]["class"] == "interactive"
+
+
+def test_edf_without_deadlines_degrades_to_fifo():
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,), policy="edf"))
+    ids = [eng.submit(HeatConfig(n=16, ntime=4 + i, dtype="float64"))
+           for i in range(5)]
+    recs = {r["id"]: r for r in eng.results()}
+    assert all(r["status"] == "ok" for r in recs.values())
+    assert eng.admission_trace == ids
+
+
+# --- fair share -------------------------------------------------------------
+
+
+def _tenant_wave(eng, tenant, count, ntime=6):
+    return [eng.submit(HeatConfig(n=16, ntime=ntime, dtype="float64"),
+                       request_id=f"{tenant}-{i}", tenant=tenant)
+            for i in range(count)]
+
+
+def test_fair_share_flood_cannot_starve_equal_weight_tenant():
+    """Acceptance: tenant 'flood' queues 8 requests before 'small'
+    queues 4 — under equal weights the admissions must interleave, so
+    every 'small' request is admitted within the first 2*4+1 slots
+    instead of waiting out the whole flood (FIFO's behavior)."""
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,), policy="fair"))
+    _tenant_wave(eng, "flood", 8)
+    _tenant_wave(eng, "small", 4)
+    recs = {r["id"]: r for r in eng.results()}
+    assert all(r["status"] == "ok" for r in recs.values())
+    trace = eng.admission_trace
+    small_slots = [i for i, rid in enumerate(trace)
+                   if rid.startswith("small")]
+    assert max(small_slots) <= 8, trace   # strict alternation lands 1,3,5,7
+    # and FIFO on the same wave WOULD starve: all flood first
+    fifo = Engine(quiet(lanes=1, chunk=4, buckets=(16,)))
+    _tenant_wave(fifo, "flood", 8)
+    _tenant_wave(fifo, "small", 4)
+    fifo.results()
+    assert [r for r in fifo.admission_trace[:8]] == \
+        [f"flood-{i}" for i in range(8)]
+
+
+def test_fair_share_respects_weights():
+    """weights vip=3, flood=1: while both are backlogged vip takes ~3 of
+    every 4 admissions (virtual time advances by work/weight)."""
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,), policy="fair",
+                       tenant_weights=(("vip", 3.0), ("flood", 1.0))))
+    _tenant_wave(eng, "flood", 6)
+    _tenant_wave(eng, "vip", 6)
+    recs = {r["id"]: r for r in eng.results()}
+    assert all(r["status"] == "ok" for r in recs.values())
+    first8 = eng.admission_trace[:8]
+    vip_share = sum(1 for rid in first8 if rid.startswith("vip"))
+    assert vip_share >= 5, eng.admission_trace  # 3:1 weighting -> ~6 of 8
+
+
+def test_fair_share_idle_tenant_cannot_bank_credit():
+    """A tenant that sat idle while another was served must re-enter at
+    the current virtual time, not replay its unused share in a burst."""
+    q = policy_mod.FairShareQueue({})
+
+    class R:  # minimal Request stand-in
+        def __init__(self, tenant, seq):
+            self.tenant, self.seq = tenant, seq
+            self.slo_class, self.deadline_t = "standard", None
+            self.cfg = HeatConfig(n=16, ntime=4, dtype="float64")
+
+    for i in range(4):
+        q.push(R("busy", i))
+    served = [q.pop().tenant for _ in range(3)]   # busy accrues vtime
+    q.push(R("idler", 100))
+    q.push(R("idler", 101))
+    # idler was raised to busy's floor: busy is not immediately locked
+    # out by the idler's banked zero-credit (without catch-up the order
+    # would be idler, idler, busy)
+    order = [q.pop().tenant for _ in range(3)]
+    assert served == ["busy"] * 3
+    assert order == ["busy", "idler", "idler"]
+
+
+# --- per-tenant quota -------------------------------------------------------
+
+
+def test_tenant_quota_sheds_with_structured_overloaded_record():
+    """Acceptance: a tenant past its --tenant-quota gets 'overloaded'
+    records naming the tenant, while another tenant (and the global
+    queue) keep admitting."""
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,), tenant_quota=2))
+    noisy = _tenant_wave(eng, "noisy", 5)
+    polite = _tenant_wave(eng, "polite", 1)
+    recs = {r["id"]: r for r in eng.results()}
+    shed = [rid for rid in noisy if recs[rid]["status"] == "rejected"]
+    assert len(shed) == 3
+    for rid in shed:
+        assert "overloaded" in recs[rid]["error"]
+        assert "noisy" in recs[rid]["error"]
+        assert "tenant-quota" in recs[rid]["error"]
+    assert recs[polite[0]]["status"] == "ok"
+    assert eng.shed == 3
+    assert sum(recs[rid]["status"] == "ok" for rid in noisy) == 2
+
+
+def test_tenant_and_class_validation_at_submit():
+    eng = Engine(quiet(buckets=(16,)))
+    with pytest.raises(ValueError, match="tenant"):
+        eng.submit(HeatConfig(n=8, ntime=1), tenant="no spaces!")
+    with pytest.raises(ValueError, match="class"):
+        eng.submit(HeatConfig(n=8, ntime=1), slo_class="premium")
+    with pytest.raises(ValueError, match="policy"):
+        ServeConfig(policy="lifo")
+    with pytest.raises(ValueError, match="weight"):
+        ServeConfig(tenant_weights=(("a", 0.0),))
+    with pytest.raises(ValueError, match="tenant_quota"):
+        ServeConfig(tenant_quota=-1)
+
+
+# --- incremental consumption (poll / wait / listeners) ----------------------
+
+
+def test_listener_fires_at_lane_retirement_not_at_drain():
+    """Satellite: the results-ready seam delivers the short request's
+    record while the long request is still stepping — the gateway can
+    stream it immediately instead of waiting for full drain."""
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(16,)))
+    short = eng.submit(HeatConfig(n=16, ntime=8, dtype="float64"))
+    long_ = eng.submit(HeatConfig(n=16, ntime=800, dtype="float64"))
+    events = []
+
+    def listener(rec):
+        with eng._lock:
+            others = {r["id"]: r["status"] for r in eng._records}
+        events.append((rec["id"], rec["status"], others))
+
+    eng.add_listener(listener)
+    eng.results()
+    eng.remove_listener(listener)
+    assert [e[0] for e in events] == [short, long_]
+    sid, sstatus, others_at_short = events[0]
+    assert sstatus == "ok"
+    # when the short record fired, the long solve had NOT finished
+    assert others_at_short[long_] not in ("ok", "error")
+
+
+def test_poll_and_wait_while_engine_runs_online():
+    """poll() observes a live record without draining; wait() blocks to
+    the terminal record; results() refuses while the online scheduler
+    owns the queue (poll/wait are the online API)."""
+    eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,))).start()
+    try:
+        rid = eng.submit(HeatConfig(n=16, ntime=30, dtype="float64"))
+        with pytest.raises(RuntimeError, match="online"):
+            eng.results()
+        rec = eng.wait(rid, timeout=60)
+        assert rec is not None and rec["status"] == "ok"
+        assert "T" not in rec            # snapshots carry no field payload
+        assert eng.poll(rid)["status"] == "ok"
+        assert eng.poll("nope") is None
+        with pytest.raises(KeyError):
+            eng.wait("nope", timeout=1)
+        # online bit-identity: submitted AFTER start, equals the solo run
+        cfg = HeatConfig(n=16, ntime=11, dtype="float64", nu=0.1)
+        rid2 = eng.submit(cfg)
+        assert eng.wait(rid2, timeout=60)["status"] == "ok"
+        with eng._lock:
+            rec2 = dict(eng._by_id[rid2])
+    finally:
+        assert eng.shutdown(timeout=60)
+    np.testing.assert_array_equal(rec2["T"], solve(cfg).T)
+    assert eng.timing is not None        # the online loop stamps Timing
+
+
+def test_shutdown_idempotent_and_safe_without_start():
+    eng = Engine(quiet(buckets=(16,)))
+    assert eng.shutdown() is True        # never started: a no-op
+    eng.start()
+    eng.start()                          # idempotent while running
+    assert eng.shutdown(timeout=30) is True
+    assert eng.shutdown(timeout=30) is True
+
+
+# --- histogram primitive ----------------------------------------------------
+
+
+def test_histogram_buckets_and_quantiles():
+    h = policy_mod.Histogram(buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 5 and snap["sum"] == pytest.approx(56.05)
+    assert snap["buckets"] == [("0.1", 1), ("1", 3), ("10", 4),
+                               ("+Inf", 5)]
+    assert h.quantile(0.5) == 1.0
+    assert h.quantile(0.99) == float("inf")
+    assert policy_mod.Histogram().quantile(0.5) is None
+
+
+def test_summary_and_metrics_carry_policy_fields():
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(16,), policy="edf"))
+    eng.submit(HeatConfig(n=16, ntime=4, dtype="float64"),
+               slo_class="interactive")
+    eng.results()
+    s = eng.summary()
+    assert s["policy"] == "edf" and s["lane_grows"] == 0
+    assert s["queued_now"] == 0
+    assert "interactive" in eng.lat_hist
+    assert eng.lat_hist["interactive"].snapshot()["count"] == 1
+    assert eng.depth_hist.snapshot()["count"] == 1
+    assert eng.timing.serve_policy == "edf"
+    assert any("policy edf" in l for l in eng.timing.report_lines())

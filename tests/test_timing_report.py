@@ -56,6 +56,22 @@ def test_report_lines_serve_dispatch_only_when_serving():
     assert "depth 0" in line and "boundary wait 0.000000" in line
 
 
+def test_report_lines_serve_policy_suffix():
+    """The admission policy rides the dispatch line when set (two serve
+    runs only compare when their ordering matched) and is absent on
+    pre-policy Timing values so old consumers see identical lines."""
+    with_policy = Timing(total_s=1.0, solve_s=1.0, dispatch_depth=2,
+                         boundary_wait_s=0.0, serve_policy="edf")
+    (line,) = [l for l in with_policy.report_lines()
+               if "serve dispatch" in l]
+    assert line.endswith(", policy edf")
+
+    without = Timing(total_s=1.0, solve_s=1.0, dispatch_depth=2,
+                     boundary_wait_s=0.0)
+    (line,) = [l for l in without.report_lines() if "serve dispatch" in l]
+    assert "policy" not in line
+
+
 def test_report_lines_serve_faults_only_when_fault_domains_ran():
     solo = Timing(total_s=1.0, solve_s=0.5, steps=4, points=16)
     assert not any("serve faults" in l for l in solo.report_lines())
