@@ -8,7 +8,7 @@ SHELL := /bin/bash
 .PHONY: test tier1 chaos lint bench bench-all bench-smoke chip-check \
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
-        serve-lab serve-chaos-lab frontend-lab native run viz clean
+        serve-lab serve-chaos-lab frontend-lab trace-lab native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -86,6 +86,10 @@ serve-chaos-lab:       # serving chaos A/B: clean wave vs ~10% lane-nan
 frontend-lab:          # online front-end A/B: Poisson arrivals, EDF vs
                        # FIFO deadline-hit rate + policy-layer cost check
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_frontend_lab.py
+
+trace-lab:             # tracing-overhead A/B: off vs flight-recorder vs
+                       # full --trace on the serve_lab wave (<= 2% gate)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/trace_overhead_lab.py
 
 sweep:                 # flap-tolerant full chip queue
 	bash benchmarks/watch_and_sweep.sh

@@ -112,4 +112,10 @@ phase serve_chaos_lab  1200 env JAX_PLATFORMS=cpu python benchmarks/serve_chaos_
 # within 5% of serve_lab.json's engine throughput (the front-end adds
 # no hot-loop cost). CPU-world: runs with the tunnel down.
 phase serve_frontend_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_frontend_lab.py
+# Tracing-overhead A/B (ISSUE 7): the serve_lab 64-request wave with
+# tracing off vs flight-recorder-only vs full --trace export — the
+# observability layer must keep full tracing within 2% of tracing-off
+# throughput (best-of-N walls), with a non-empty Perfetto-loadable
+# export. CPU-world: runs with the tunnel down.
+phase trace_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/trace_overhead_lab.py
 echo "=== extras_r5c done at $(date)"

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..config import HeatConfig
 from ..runtime import async_io, checkpoint, debug, faults
+from ..runtime import trace as trace_mod
 from ..runtime.logging import master_print
 from ..runtime.timing import Timing, sync, two_point_rate
 from . import SolveResult
@@ -144,6 +145,12 @@ def drive(
     t_all0 = time.perf_counter()
     chunk = event_interval(cfg)
     remaining = cfg.ntime - start_step
+    # request-scoped tracing (runtime/trace.py): the solo path records
+    # into the process-global ring so `heat-tpu run --trace` puts chunk
+    # dispatches, checkpoint snapshots, and the background writer's
+    # D2H+publish spans (the PR-1 overlap) on one Perfetto timeline.
+    tracer = trace_mod.get_tracer()
+    drv_track = tracer.thread_track("solve") if tracer.enabled else None
 
     # AOT-compile every chunk size the loop will encounter (at most two: the
     # steady chunk and a final remainder) so no compile lands inside the
@@ -152,8 +159,12 @@ def drive(
     compile_s = precompile_s
     compiled = dict(precompiled or {})
     if warmup and remaining > 0:
+        t_c0 = time.perf_counter()
         compiled, spent = aot_compile_chunks(
             advance, T_dev, chunk_sizes(cfg, remaining), compiled)
+        if tracer.enabled and spent > 0:
+            tracer.complete("compile", drv_track, t_c0, cat="solve",
+                            args={"sizes": chunk_sizes(cfg, remaining)})
         compile_s += spent
         t0 = time.perf_counter()
         if warm_exec:
@@ -176,7 +187,7 @@ def drive(
     # sync(T_dev) -> fetch -> save stall below, unchanged.
     async_on = cfg.use_async_io() and bool(cfg.checkpoint_every
                                            or cfg.check_numerics)
-    writer = (async_io.SnapshotWriter()
+    writer = (async_io.SnapshotWriter(tracer=tracer)
               if async_on and cfg.checkpoint_every else None)
     # pending boundary flag from the async numerics leg:
     # (device scalar, step, snapshot-or-None, deferred-checkpoint?)
@@ -199,6 +210,9 @@ def drive(
 
     def _submit_snapshot(T_snap, at_step: int) -> None:
         check = cfg.check_numerics
+        if tracer.enabled:
+            tracer.instant("checkpoint-snapshot", drv_track, cat="solve",
+                           args={"step": at_step})
 
         def job():
             T_ck = to_host(T_snap)  # D2H lands HERE, in the writer thread
@@ -214,6 +228,7 @@ def drive(
             else:  # multi-host: each process persists its own shards
                 checkpoint.save_shards(cfg, T_snap, at_step)
 
+        job._trace = (f"checkpoint @{at_step}", None)
         writer.submit(job)
 
     def _try_rollback(bad_step: int) -> bool:
@@ -266,8 +281,14 @@ def drive(
                 while step < cfg.ntime:
                     k = min(chunk, cfg.ntime - step)
                     fn = compiled.get(k)
+                    t_ch = time.perf_counter() if tracer.enabled else 0.0
                     T_dev = fn(T_dev) if fn is not None else advance(T_dev, k)
                     step += k
+                    if tracer.enabled:
+                        # dispatch-side span: the enqueue cost, not the
+                        # device time (the loop deliberately never fences)
+                        tracer.complete(f"chunk @{step}", drv_track, t_ch,
+                                        cat="solve", args={"k": k})
                     if plan is not None:
                         plan.maybe_crash(step)
                         T_dev = plan.maybe_nan(step, T_dev)
@@ -313,7 +334,11 @@ def drive(
                 if pending_flag is None or not _settle_pending():
                     break
                 # final boundary flagged and rolled back: resume stepping
+            t_sync = time.perf_counter() if tracer.enabled else 0.0
             sync(T_dev)
+            if tracer.enabled:
+                tracer.complete("final-sync", drv_track, t_sync,
+                                cat="solve")
     except BaseException:
         # drain-on-exception: every queued snapshot still lands on disk (a
         # blow-up's last good boundary is exactly the state a resume
@@ -322,6 +347,10 @@ def drive(
             writer.drain(raise_errors=False)
         raise
     solve_s = time.perf_counter() - t0
+    if tracer.enabled:
+        tracer.complete("solve", drv_track, t0, t0 + solve_s, cat="solve",
+                        args={"steps": remaining, "n": cfg.n,
+                              "backend": cfg.backend})
     if writer is not None:
         # post-solve flush, deliberately OUTSIDE solve_s: the device has
         # finished stepping, so the remaining writes overlap nothing —

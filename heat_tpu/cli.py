@@ -21,6 +21,7 @@ from typing import Optional, Sequence
 
 from .config import HeatConfig, VARIANTS, parse_input, variant_config
 from .grid import coords, initial_condition
+from .runtime import trace as trace_mod
 from .runtime.logging import master_print
 
 
@@ -94,6 +95,18 @@ def build_parser() -> argparse.ArgumentParser:
                           "(default) = on")
     run.add_argument("--profile", dest="profile_dir", metavar="DIR",
                      help="write a jax.profiler trace of the solve to DIR")
+    run.add_argument("--trace", metavar="FILE",
+                     help="export the run's event timeline (chunk "
+                          "dispatches, checkpoint snapshots, background-"
+                          "writer D2H+publish spans) as Chrome trace-event "
+                          "JSON viewable in Perfetto / chrome://tracing "
+                          "(HEAT_TPU_TRACE=FILE is the env spelling; "
+                          "HEAT_TPU_TRACE=off disables recording)")
+    run.add_argument("--trace-buffer", dest="trace_buffer", type=int,
+                     metavar="N",
+                     help="event-ring capacity (default "
+                          f"{trace_mod.DEFAULT_BUFFER}; 0 disables "
+                          "recording)")
     run.add_argument("--check-numerics", action="store_true",
                      help="detect NaN/Inf per chunk (debug; forces syncs)")
     run.add_argument("--on-nan", dest="on_nan", choices=["abort", "rollback"],
@@ -233,8 +246,39 @@ def build_parser() -> argparse.ArgumentParser:
                             "fetch-hang[@N]:ms=M hangs the Nth boundary "
                             "fetch M ms (watchdog exercise). Per-request "
                             "specs ride each request's own 'inject' key")
+    serve.add_argument("--trace", metavar="FILE",
+                       help="export the engine's event ring as Chrome "
+                            "trace-event JSON at drain (Perfetto / "
+                            "chrome://tracing): per-lane occupancy "
+                            "timelines, chunk pipelining, queue waits, "
+                            "boundary fetches, writer publishes, with "
+                            "flow arrows stitching each request's hops "
+                            "across threads. HEAT_TPU_TRACE=FILE is the "
+                            "env spelling; HEAT_TPU_TRACE=off disables "
+                            "recording (including the flight recorder)")
+    serve.add_argument("--trace-buffer", dest="trace_buffer", type=int,
+                       metavar="N",
+                       help="event-ring capacity (default "
+                            f"{trace_mod.DEFAULT_BUFFER}). The ring is "
+                            "the ALWAYS-ON flight recorder: even without "
+                            "--trace, the last N events are dumped to "
+                            "<out-dir>/flightrec-<ts>.trace.json when a "
+                            "watchdog fires, a lane quarantines after "
+                            "its rollback budget, or the scheduler loop "
+                            "crashes; 0 disables recording entirely")
     serve.add_argument("--json", action="store_true",
                        help="also print a machine-readable summary line")
+
+    trc = sub.add_parser(
+        "trace",
+        help="render a text timeline summary from a trace file (a "
+             "--trace export, a flightrec-*.trace.json dump, or a saved "
+             "GET /tracez response): per-lane utilization, top "
+             "queue-wait requests, boundary-fetch/device-idle totals")
+    trc.add_argument("tracefile", help="Chrome trace-event JSON file")
+    trc.add_argument("--top", type=int, default=5,
+                     help="how many top queue-wait requests to list "
+                          "(default 5)")
 
     viz = sub.add_parser("viz", help="render a .dat file as a 3D surface")
     viz.add_argument("datfile")
@@ -365,6 +409,14 @@ def cmd_run(args) -> int:
         cfg = variant_config(args.variant, cfg)
     cfg = _apply_overrides(cfg, args)
 
+    try:
+        trace_path, trace_cap = trace_mod.resolve_trace(args.trace,
+                                                        args.trace_buffer)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    tracer = trace_mod.configure(capacity=trace_cap)
+
     if args.virtual_devices:
         # must land before the first backend touch; a plain JAX_PLATFORMS
         # env var is not enough where a site hook pins the TPU platform
@@ -399,6 +451,11 @@ def cmd_run(args) -> int:
     res = solve(cfg)
     for line in res.timing.report_lines():
         master_print(line)
+    if trace_path:
+        tracer.export(trace_path)
+        master_print(f"wrote trace {trace_path} (open in Perfetto / "
+                     f"chrome://tracing; summary: heat-tpu trace "
+                     f"{trace_path})")
     if res.gsum is not None:
         master_print(f"Sum of Temperature: {res.gsum:.10g}")
 
@@ -516,6 +573,8 @@ def cmd_serve(args) -> int:
     try:
         buckets = tuple(int(b) for b in str(args.buckets).split(",") if b)
         listen = parse_listen(args.listen) if args.listen else None
+        trace_path, trace_cap = trace_mod.resolve_trace(args.trace,
+                                                        args.trace_buffer)
         scfg = ServeConfig(lanes=args.lanes, chunk=args.chunk,
                            buckets=buckets, out_dir=args.out_dir,
                            dispatch_depth=parse_dispatch_depth(
@@ -529,7 +588,8 @@ def cmd_serve(args) -> int:
                            policy=args.policy,
                            tenant_weights=parse_tenant_weights(
                                args.tenant_weights or ""),
-                           tenant_quota=args.tenant_quota)
+                           tenant_quota=args.tenant_quota,
+                           trace=trace_path, trace_buffer=trace_cap)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -538,6 +598,10 @@ def cmd_serve(args) -> int:
         records, summary = serve_requests(path, scfg)
         ok = sum(1 for r in records if r["status"] == "ok")
         _serve_report(summary, ok, args)
+        if scfg.trace:
+            master_print(f"wrote trace {scfg.trace} (open in Perfetto / "
+                         f"chrome://tracing; summary: heat-tpu trace "
+                         f"{scfg.trace})")
         return 0 if ok == summary["requests"] else 1
 
     # --- online gateway mode ---------------------------------------------
@@ -570,12 +634,37 @@ def cmd_serve(args) -> int:
         summary["rejected"] = summary.get("rejected", 0) + parse_failures
     ok = summary.get("ok", 0)
     _serve_report(summary, ok, args)
+    if scfg.trace:
+        master_print(f"wrote trace {scfg.trace} (open in Perfetto / "
+                     f"chrome://tracing; summary: heat-tpu trace "
+                     f"{scfg.trace})")
     gw.close()
     if eng.loop_error is not None:
         print(f"error: scheduler loop failed: {eng.loop_error}",
               file=sys.stderr)
         return 1
     return 0 if ok == summary["requests"] else 1
+
+
+def cmd_trace(args) -> int:
+    """Text timeline summary of any trace file this framework writes
+    (--trace exports, flight-recorder dumps, /tracez responses) — the
+    no-browser half of the observability story: per-lane utilization,
+    top queue-wait requests, boundary-fetch/device-idle wall, notable
+    fault instants."""
+    path = Path(args.tracefile)
+    if not path.exists():
+        print(f"error: {path} not found", file=sys.stderr)
+        return 2
+    try:
+        lines = trace_mod.summarize_file(path, top=args.top)
+    except (ValueError, KeyError, TypeError, AttributeError) as e:
+        print(f"error: {path} is not a Chrome trace-event JSON file "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+        return 2
+    for line in lines:
+        print(line)
+    return 0
 
 
 def cmd_plan(args) -> int:
@@ -952,6 +1041,17 @@ def cmd_info(_args) -> int:
           f"fetch watchdog {_sd.fetch_timeout_s:g}s (per-lane isfinite "
           f"bits ride every boundary fetch — no extra D2H)")
 
+    # tracing defaults: the always-on flight recorder and the opt-in
+    # Perfetto export (the dynamic half — dumps actually written, /tracez
+    # hits — shows up in serve output and the gateway log)
+    print(f"trace defaults: flight recorder on (ring of "
+          f"{trace_mod.DEFAULT_BUFFER} events; dumps flightrec-*.trace.json "
+          f"on watchdog/quarantine-after-rollbacks/scheduler-crash), "
+          f"--trace FILE / HEAT_TPU_TRACE=FILE exports Chrome trace JSON "
+          f"(Perfetto), GET /tracez on the gateway, `heat-tpu trace FILE` "
+          f"for a text summary; HEAT_TPU_TRACE=off / --trace-buffer 0 "
+          f"disables")
+
     # online gateway defaults (`heat-tpu serve --listen HOST:PORT`): the
     # admission policy and SLO-class table requests are validated against
     from .config import SLO_CLASSES
@@ -1000,7 +1100,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     return {"run": cmd_run, "viz": cmd_viz, "info": cmd_info,
             "launch": cmd_launch, "plan": cmd_plan, "serve": cmd_serve,
-            "bench": cmd_bench, "calibrate": cmd_calibrate}[args.command](args)
+            "bench": cmd_bench, "calibrate": cmd_calibrate,
+            "trace": cmd_trace}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -24,7 +24,15 @@ system owes its operators:
   per-tenant queue-depth gauges, per-class end-to-end latency histograms
   and the queue-depth-at-submit histogram (serve/policy.py), plus every
   counter ``Engine.summary()`` tracks (quarantines, rollbacks, deadline
-  misses, shed, watchdog, compiles, boundary waits).
+  misses, shed, watchdog, compiles, boundary waits), build identity
+  (``heat_tpu_build_info``) and process uptime. User-supplied label
+  values (tenant/class) are escaped per the exposition format.
+- ``GET /tracez`` — the engine's event ring (runtime/trace.py) as Chrome
+  trace-event JSON, on demand: load it straight into Perfetto to see
+  lane occupancy, chunk pipelining, and queue waits of the live engine.
+  Every response to ``/v1/solve`` echoes the minted per-request trace
+  ids in an ``X-Trace-Id`` header (and every NDJSON record carries its
+  ``trace_id``), so client logs join against the timeline.
 
 Backpressure is the PR-5 machinery made visible: a submit shed by
 ``--max-queue`` or ``--tenant-quota`` answers **429 with Retry-After**
@@ -51,6 +59,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
 from ..config import SLO_CLASSES
+from ..runtime import trace as trace_mod
 from ..runtime.logging import master_print
 from .api import parse_request_obj, submit_parsed
 from .scheduler import Engine, TERMINAL_STATUSES
@@ -58,6 +67,16 @@ from .scheduler import Engine, TERMINAL_STATUSES
 MAX_BODY_BYTES = 16 << 20   # one POST body; a solve request is ~100 bytes,
                             # so this bounds even absurd batch lines
 _OVERLOAD_PREFIX = "overloaded:"
+
+
+def escape_label_value(v) -> str:
+    """Escape one Prometheus label VALUE per the text exposition format:
+    backslash, double-quote, and newline must be escaped — ``tenant`` and
+    ``class`` are user-supplied request strings, and a tenant named
+    ``a"b`` (or one smuggling a newline) must corrupt its own label, not
+    the whole scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def render_metrics(engine: Engine) -> str:
@@ -72,10 +91,22 @@ def render_metrics(engine: Engine) -> str:
         out.append(f"# HELP {name} {help_text}")
         out.append(f"# TYPE {name} {mtype}")
         for labels, value in samples:
-            lbl = ("{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+            lbl = ("{" + ",".join(
+                f'{k}="{escape_label_value(v)}"' for k, v in labels) + "}"
                    if labels else "")
             out.append(f"{name}{lbl} {value}")
 
+    import jax
+
+    from .. import __version__
+
+    metric("heat_tpu_build_info", "gauge",
+           "Build/runtime identity (value is always 1).",
+           [([("version", __version__), ("jax", jax.__version__),
+              ("backend", jax.default_backend())], 1)])
+    metric("heat_tpu_process_uptime_seconds", "gauge",
+           "Seconds since this serving process started.",
+           [([], round(trace_mod.process_uptime_s(), 3))])
     metric("heat_tpu_serve_info", "gauge",
            "Static engine configuration (value is always 1).",
            [([("policy", s["policy"]),
@@ -126,7 +157,8 @@ def render_metrics(engine: Engine) -> str:
         out.append(f"# HELP {name} {help_text}")
         out.append(f"# TYPE {name} histogram")
         snap = hist.snapshot()
-        lbl = f'{label[0]}="{label[1]}",' if label else ""
+        lbl = (f'{label[0]}="{escape_label_value(label[1])}",'
+               if label else "")
         for le, cum in snap["buckets"]:
             out.append(f'{name}_bucket{{{lbl}le="{le}"}} {cum}')
         suffix = "{" + lbl.rstrip(",") + "}" if label else ""
@@ -273,6 +305,12 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/metrics":
             self._text(200, render_metrics(eng),
                        "text/plain; version=0.0.4")
+        elif path == "/tracez":
+            # the flight recorder's ring, on demand: a Chrome trace JSON
+            # snapshot of the engine as it runs (loadable in Perfetto —
+            # no fault required, no drain required)
+            self._text(200, json.dumps(eng.tracer.to_chrome()),
+                       "application/json")
         elif path == "/drainz":
             self._drainz()
         elif path.startswith("/v1/requests/"):
@@ -281,7 +319,9 @@ class _Handler(BaseHTTPRequestHandler):
             if rec is None:
                 self._json(404, {"error": f"unknown request id {rid!r}"})
             else:
-                self._json(200, self._sanitize(rec))
+                self._json(200, self._sanitize(rec),
+                           headers=[("X-Trace-Id", rec["trace_id"])]
+                           if rec.get("trace_id") else ())
         else:
             self._json(404, {"error": f"no route for GET {path}"})
 
@@ -316,6 +356,20 @@ class _Handler(BaseHTTPRequestHandler):
         return self.rfile.read(n)
 
     def _solve(self, parts) -> None:
+        """One HTTP receive/parse/submit/stream span on the gateway
+        handler thread's track — the front half of every request's flow
+        (Engine.submit anchors the flow start on this same thread)."""
+        tr = self.gw.engine.tracer
+        if not tr.enabled:
+            return self._solve_inner(parts)
+        t0 = tr.now()
+        try:
+            self._solve_inner(parts)
+        finally:
+            tr.complete("POST /v1/solve", tr.thread_track("gateway"), t0,
+                        cat="http")
+
+    def _solve_inner(self, parts) -> None:
         gw, eng = self.gw, self.gw.engine
         if eng.draining:
             self._json(503, {"error": "draining: admission stopped "
@@ -362,6 +416,12 @@ class _Handler(BaseHTTPRequestHandler):
             # backpressure: every submitted request shed at admission ->
             # 429 so well-behaved clients back off (Retry-After)
             snaps = {rid: eng.poll(rid) for rid in submitted}
+            # every response names the request-scoped trace ids it minted
+            # (one per submitted line, comma-joined) so a client log line
+            # can be joined against /tracez and flight-recorder dumps
+            tids = ",".join(str(r.get("trace_id"))
+                            for r in snaps.values() if r.get("trace_id"))
+            tid_hdr = [("X-Trace-Id", tids)] if tids else []
             overloaded = [rid for rid, r in snaps.items()
                           if r["status"] == "rejected"
                           and str(r.get("error", "")).startswith(
@@ -372,18 +432,22 @@ class _Handler(BaseHTTPRequestHandler):
                                      "retry after the indicated delay",
                             "records": immediate + eng_shed}
                 self._json(429, body_out,
-                           headers=[("Retry-After", int(gw.retry_after_s))])
+                           headers=[("Retry-After", int(gw.retry_after_s)),
+                                    *tid_hdr])
                 return
             if not wait:
                 self._json(202, {"accepted": submitted,
-                                 "records": immediate})
+                                 "records": immediate},
+                           headers=tid_hdr)
                 return
-            self._stream(immediate, submitted, snaps, results)
+            self._stream(immediate, submitted, snaps, results,
+                         headers=tid_hdr)
         finally:
             if wait:
                 eng.remove_listener(listener)
 
-    def _stream(self, immediate, submitted, snaps, results) -> None:
+    def _stream(self, immediate, submitted, snaps, results,
+                headers=()) -> None:
         """Chunked NDJSON: parse-failure records first, then one record
         per submitted request in FINISH order, each written the moment
         its terminal record lands (listener-fed queue). Bounded by the
@@ -392,6 +456,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
+        for k, v in headers:
+            self.send_header(k, str(v))
         self.end_headers()
 
         def chunk(obj) -> bool:

@@ -151,6 +151,28 @@ class FairShareQueue:
         return self._count > 0
 
 
+# --- admission tracing (runtime/trace.py) ------------------------------------
+# Queue objects stay trace-free (they are pure ordering structures); the
+# scheduler calls these at its push/pop sites so every policy's admission
+# decisions land on the timeline the same way: an ``enqueue`` instant per
+# push and an id-paired ``queue-wait`` span per pop — per tenant, so one
+# tenant's overlapping waits stack on one track and a starved tenant is a
+# visibly empty one.
+
+def note_enqueue(tracer, policy: str, req) -> None:
+    tracer.instant("enqueue", tracer.track("queue", req.tenant),
+                   cat="queue", trace_id=req.trace_id,
+                   args={"id": req.id, "policy": policy,
+                         "class": req.slo_class}, ts=req.submit_t)
+
+
+def note_pop(tracer, policy: str, req, now: float) -> None:
+    tracer.async_span("queue-wait", tracer.track("queue", req.tenant),
+                      req.submit_t, now, req.trace_id,
+                      args={"id": req.id, "policy": policy,
+                            "tenant": req.tenant, "class": req.slo_class})
+
+
 def make_queue(policy: str, tenant_weights=()):
     """One admission queue for one bucket group under ``policy``."""
     if policy == "fifo":

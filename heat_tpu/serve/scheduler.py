@@ -93,6 +93,7 @@ from ..config import (DEFAULT_SLO_CLASS, DEFAULT_TENANT, HeatConfig,
                       validate_slo_fields)
 from ..grid import initial_condition
 from ..runtime import async_io, faults
+from ..runtime import trace as trace_mod
 from ..runtime.logging import json_record, master_print
 from . import policy as policy_mod
 from .engine import BucketKey, LaneEngine, lane_tier, wall_clock
@@ -165,6 +166,17 @@ class ServeConfig:
                               # --max-queue alone cannot give, because a
                               # single tenant can fill a shared bound;
                               # None/0 = no per-tenant bound
+    trace: Optional[str] = None  # export the run's event ring as Chrome
+                              # trace-event JSON here at drain (Perfetto /
+                              # chrome://tracing); None = flight-recorder
+                              # only (ring retained, dumped on faults)
+    trace_buffer: int = trace_mod.DEFAULT_BUFFER  # event-ring capacity
+                              # (runtime/trace.py); 0 disables recording
+                              # entirely — including the flight recorder
+    flight_dir: Optional[str] = None  # flight-recorder dump directory
+                              # (flightrec-<ts>.trace.json on watchdog /
+                              # quarantine-after-rollbacks / scheduler
+                              # crash); None = out_dir, else the cwd
 
     def __post_init__(self):
         if self.lanes < 1:
@@ -200,6 +212,12 @@ class ServeConfig:
         if self.tenant_quota is not None and self.tenant_quota < 0:
             raise ValueError(f"tenant_quota must be >= 0 (None/0 = "
                              f"unbounded), got {self.tenant_quota}")
+        if self.trace_buffer < 0:
+            raise ValueError(f"trace_buffer must be >= 0 (0 disables "
+                             f"recording), got {self.trace_buffer}")
+        if self.trace and self.trace_buffer == 0:
+            raise ValueError("trace export needs trace_buffer > 0 (the "
+                             "export is the event ring's contents)")
         if self.inject:
             # fail at construction, not at a boundary mid-drain (same
             # parse-time contract as HeatConfig.inject)
@@ -229,6 +247,9 @@ class Request:
     seq: int = 0                        # engine-wide submit counter: the
                                         # FIFO order and every policy's
                                         # deterministic tiebreak
+    trace_id: str = ""                  # request-scoped trace/flow id
+                                        # (runtime/trace.py), minted at
+                                        # submit and echoed in the record
 
 
 def _bucket_for(cfg: HeatConfig, buckets) -> Optional[int]:
@@ -301,6 +322,16 @@ class _GroupRunner:
                                     # sizes runners from the full queue,
                                     # so growth (and its pipeline drain)
                                     # must never perturb the batch shape
+        # trace tracks (runtime/trace.py): one process row per bucket
+        # group, one thread row per lane (the occupancy timeline) plus a
+        # dispatch row for chunk-in-flight / device-idle spans. Registered
+        # here, once, so the per-event path is append-only.
+        self.tracer = outer.tracer
+        self.track_name = (f"lanes {key.ndim}d n{key.n} "
+                           f"{key.dtype} {key.bc}")
+        self.group_track = self.tracer.track(self.track_name, "dispatch")
+        self.lane_tracks = [self.tracer.track(self.track_name, f"lane {i}")
+                            for i in range(self.lanes)]
         self._fill()
 
     # --- admission into lanes --------------------------------------------
@@ -324,7 +355,17 @@ class _GroupRunner:
                     outer._queued_by_tenant[req.tenant] -= 1
                     outer.admission_trace.append(req.id)
                 now = wall_clock()
+                tr = self.tracer
+                if tr.enabled:
+                    # queue-wait span (pop side — serve/policy.py): the
+                    # request's wait under THIS policy, id-paired so
+                    # overlapping waits of one tenant render cleanly
+                    policy_mod.note_pop(tr, outer.scfg.policy, req, now)
                 if req.deadline_t is not None and now > req.deadline_t:
+                    if tr.enabled:
+                        tr.instant("deadline-shed", self.group_track,
+                                   trace_id=req.trace_id,
+                                   args={"id": req.id}, ts=now)
                     outer._fail_request(
                         req, "deadline",
                         f"deadline: exceeded its "
@@ -332,6 +373,9 @@ class _GroupRunner:
                         f"budget while still queued (never admitted)")
                     outer.deadline_misses += 1
                     continue
+                if tr.enabled:
+                    tr.flow("t", self.lane_tracks[lane], req.trace_id,
+                            ts=now)
                 rec = outer._by_id[req.id]
                 with outer._lock:
                     rec["lane"] = lane
@@ -395,9 +439,15 @@ class _GroupRunner:
                 # strictly fewer masked steps than one full chunk
                 k = tail
                 self.outer.tail_chunks += 1
+            t_disp = wall_clock()
             handle = self.eng.dispatch_chunk(k)
             if self.idle_from is not None:
-                self.outer.device_idle_s += wall_clock() - self.idle_from
+                self.outer.device_idle_s += t_disp - self.idle_from
+                if self.tracer.enabled:
+                    # the idle gap, ATTRIBUTED: this exact interval on
+                    # this exact group's dispatch row had nothing queued
+                    self.tracer.complete("device-idle", self.group_track,
+                                         self.idle_from, t_disp, cat="idle")
                 self.idle_from = None
             np.maximum(self.dev_rem - k, 0, out=self.dev_rem)
             # rollback mode keeps every in-flight boundary restorable:
@@ -405,7 +455,8 @@ class _GroupRunner:
             # that boundary's finite bit comes back clean
             snap = self.eng.snapshot_stack() if self.rollback else None
             self.inflight.append(
-                (self.seq, handle, self.dev_rem.astype(np.int32), snap))
+                (self.seq, handle, self.dev_rem.astype(np.int32), snap,
+                 t_disp, k))
             self.seq += 1
             self.outer.chunks_dispatched += 1
 
@@ -420,8 +471,32 @@ class _GroupRunner:
                 plan=outer._plan, fetch_index=outer._fetch_seq)
         finally:
             outer._fetch_seq += 1
-            outer.boundary_wait_s += wall_clock() - t0
+            t1 = wall_clock()
+            outer.boundary_wait_s += t1 - t0
             outer.boundary_waits += 1
+            if self.tracer.enabled:
+                # boundary_wait_s, attributed: each fetch's blocked wall
+                # becomes one span on the scheduler thread's row
+                self.tracer.complete("boundary-fetch",
+                                     self.tracer.thread_track("scheduler"),
+                                     t0, t1, cat="boundary",
+                                     args={"bucket": self.track_name})
+
+    def _trace_occupancy(self, lane: int, req: Request, status: str) -> None:
+        """Close the lane's occupancy span (admission -> this verdict) on
+        its track. Must run BEFORE the finish/fail path pops the record's
+        ``_start_t``."""
+        tr = self.tracer
+        if not tr.enabled:
+            return
+        t0 = self.outer._by_id[req.id].get("_start_t")
+        if t0 is None:
+            return
+        tr.complete(req.id, self.lane_tracks[lane], t0, cat="lane",
+                    trace_id=req.trace_id,
+                    args={"status": status, "n": req.cfg.n,
+                          "ntime": req.cfg.ntime})
+        tr.flow("t", self.lane_tracks[lane], req.trace_id)
 
     def _judge_lanes(self, seq: int, rem, finite, snap, sync: bool) -> None:
         """Apply one fetched boundary's verdicts to every lane this
@@ -439,6 +514,7 @@ class _GroupRunner:
             if finite is not None and not finite[lane]:
                 self._handle_nonfinite(lane, req, int(rem[lane]), snap)
             elif rem[lane] == 0:
+                self._trace_occupancy(lane, req, "retired")
                 if sync:
                     outer._finish_sync(self.eng, lane, req, self.writer)
                 else:
@@ -446,6 +522,7 @@ class _GroupRunner:
                 self.occupant[lane] = None
             elif req.deadline_t is not None and now > req.deadline_t:
                 done = req.cfg.ntime - int(rem[lane])
+                self._trace_occupancy(lane, req, "deadline")
                 outer._fail_request(
                     req, "deadline",
                     f"deadline: exceeded its "
@@ -470,6 +547,10 @@ class _GroupRunner:
         if self.rollback and self.rb_left[lane] > 0:
             self.rb_left[lane] -= 1
             outer.rollbacks += 1
+            if self.tracer.enabled:
+                self.tracer.instant("rollback", self.lane_tracks[lane],
+                                    trace_id=req.trace_id,
+                                    args={"id": req.id, "at_step": done})
             if self.last_good[lane] is not None:
                 good_snap, steps_left = self.last_good[lane]
                 master_print(
@@ -500,15 +581,27 @@ class _GroupRunner:
             self.epoch[lane] = self.seq
             self.last_good[lane] = None
         else:
+            exhausted = self.rollback and self.rb_left[lane] == 0
             tried = (f" after {_MAX_LANE_ROLLBACKS} rollbacks "
-                     f"(deterministic blow-up)"
-                     if self.rollback and self.rb_left[lane] == 0 else "")
+                     f"(deterministic blow-up)" if exhausted else "")
+            if self.tracer.enabled:
+                self.tracer.instant("quarantine", self.lane_tracks[lane],
+                                    trace_id=req.trace_id,
+                                    args={"id": req.id, "at_step": done})
+            self._trace_occupancy(lane, req, "nonfinite")
             outer._fail_request(
                 req, "nonfinite",
                 f"nonfinite: non-finite field detected at ~step {done} of "
                 f"{req.cfg.ntime} (lane {lane}){tried} — check the CFL "
                 f"bound sigma <= 1/(2*ndim) for this request", lane=lane)
             outer.lanes_quarantined += 1
+            if exhausted:
+                # flight-recorder trigger: a lane quarantined after its
+                # rollback budget is the postmortem case the ring exists
+                # for — the dump holds the whole restore/re-flag history
+                outer._flight_dump("quarantine after "
+                                   f"{_MAX_LANE_ROLLBACKS} rollbacks "
+                                   f"(request {req.id})")
             # free the lane; its NaN field idles masked (and its device
             # countdown keeps draining, mirrored by dev_rem) until a new
             # request's load overwrites the whole lane buffer
@@ -522,9 +615,16 @@ class _GroupRunner:
         judge every lane's health/completion/deadline, refill from the
         queue."""
         if self.inflight:
-            seq, handle, predicted, snap = self.inflight.popleft()
+            seq, handle, predicted, snap, t_disp, k = self.inflight.popleft()
             b = self._fetch(handle)
             rem, finite = b[0], b[1]
+            if self.tracer.enabled:
+                # chunk-in-flight span: dispatch enqueue -> boundary
+                # fetched (under dispatch-ahead the newer chunks compute
+                # behind this interval — visibly, on the timeline)
+                self.tracer.complete(f"chunk {seq} ({k} steps)",
+                                     self.group_track, t_disp, cat="chunk",
+                                     args={"seq": seq, "k": k})
             if not self.inflight:
                 self.idle_from = wall_clock()
             if not np.array_equal(rem, predicted):
@@ -577,6 +677,9 @@ class _GroupRunner:
                                     outer.scfg.lanes)), outer.scfg.lanes)
         old_eng, old_occ = self.eng, self.occupant
         old_rem, old_nan, old_rb = self.dev_rem, self.nan_pending, self.rb_left
+        if self.tracer.enabled:
+            self.tracer.instant("lane-tier-grow", self.group_track,
+                                args={"from": self.lanes, "to": want})
         self.lanes = want
         self.eng = LaneEngine(self.key, want, self.chunk,
                               compiled_cache=outer._compiled,
@@ -587,6 +690,8 @@ class _GroupRunner:
         self.nan_pending = [[] for _ in range(want)]
         self.rb_left = [0] * want
         self.last_good = [None] * want
+        self.lane_tracks = [self.tracer.track(self.track_name, f"lane {i}")
+                            for i in range(want)]
         for lane, req in enumerate(old_occ):
             if req is None:
                 continue
@@ -620,10 +725,19 @@ class _GroupRunner:
                 # device sat idle from the last fetch's return until
                 # this dispatch — the fence cost the A/B demonstrates
                 outer.device_idle_s += t0 - self.idle_from
+                if self.tracer.enabled:
+                    self.tracer.complete("device-idle", self.group_track,
+                                         self.idle_from, t0, cat="idle")
             b = self._fetch(self.eng.dispatch_chunk())
             rem, finite = b[0], b[1]
             outer.chunks_dispatched += 1
             self.idle_from = wall_clock()
+            if self.tracer.enabled:
+                self.tracer.complete(f"chunk {self.seq} ({self.chunk} "
+                                     f"steps, fenced)", self.group_track,
+                                     t0, self.idle_from, cat="chunk",
+                                     args={"seq": self.seq,
+                                           "k": self.chunk})
             np.maximum(self.dev_rem - self.chunk, 0, out=self.dev_rem)
             if self.rollback:
                 snap = self.eng.snapshot_stack()
@@ -659,6 +773,13 @@ class Engine:
 
     def __init__(self, scfg: ServeConfig = ServeConfig()):
         self.scfg = scfg
+        # request-scoped tracing + always-on flight recorder
+        # (runtime/trace.py): every request mints a trace id at submit,
+        # every layer appends spans to this bounded ring, and the ring is
+        # dumped on watchdog/quarantine/crash — or exported to
+        # ``scfg.trace`` at drain. ``trace_buffer=0`` disables recording
+        # (ids are still minted: the record schema never flickers).
+        self.tracer = trace_mod.Tracer(capacity=scfg.trace_buffer)
         self._queues: Dict[BucketKey, object] = {}  # policy queues
         self._records: List[dict] = []
         self._by_id: Dict[str, dict] = {}
@@ -755,14 +876,22 @@ class Engine:
             self._seq += 1
             if rid in self._by_id:
                 raise ValueError(f"duplicate request id {rid!r}")
+            trace_id = self.tracer.mint_trace_id()
             rec = {"id": rid, "n": cfg.n, "ndim": cfg.ndim,
                    "ntime": cfg.ntime, "dtype": cfg.dtype, "bc": cfg.bc,
                    "tenant": tenant, "class": slo_class, "status": "queued",
                    "bucket": None, "lane": None, "queue_wait_s": None,
                    "solve_s": None, "steps_per_s": None, "error": None,
-                   "deadline_ms": deadline_ms, "_submit_t": wall_clock()}
+                   "deadline_ms": deadline_ms, "trace_id": trace_id,
+                   "_submit_t": wall_clock()}
             self._records.append(rec)
             self._by_id[rid] = rec
+        if self.tracer.enabled:
+            # flow start: the submitting thread (gateway handler, JSONL
+            # loader, library caller) anchors the request's cross-thread
+            # arrow; admission/retirement/terminal-record hops follow
+            self.tracer.flow("s", self.tracer.thread_track(), trace_id,
+                             ts=rec["_submit_t"])
         if cfg.bc == "periodic":
             self._reject(rec, "unsupported-bc: periodic has no padded-lane "
                               "form (wraparound would wrap at the bucket "
@@ -797,11 +926,16 @@ class Engine:
                 if q is None:
                     q = self._queues[key] = policy_mod.make_queue(
                         self.scfg.policy, self.scfg.tenant_weights)
-                q.push(Request(
+                req = Request(
                     id=rid, cfg=cfg, submit_t=submit_t, key=key,
                     deadline_t=(submit_t + deadline_ms / 1e3
                                 if deadline_ms is not None else None),
-                    tenant=tenant, slo_class=slo_class, seq=seq))
+                    tenant=tenant, slo_class=slo_class, seq=seq,
+                    trace_id=trace_id)
+                q.push(req)
+                if self.tracer.enabled:
+                    policy_mod.note_enqueue(self.tracer, self.scfg.policy,
+                                            req)
                 self._queued_by_tenant[tenant] += 1
                 self.depth_hist.observe(float(queued + 1))
                 self._cond.notify_all()   # wake the online scheduler
@@ -856,14 +990,20 @@ class Engine:
         one dead fetch. (The online loop reuses it as the generic
         fail-everything exit when the scheduler loop itself dies — only
         a real watchdog timeout bumps the watchdog counter.)"""
-        if isinstance(exc, async_io.BoundedFetchTimeout):
+        is_watchdog = isinstance(exc, async_io.BoundedFetchTimeout)
+        if is_watchdog:
             self.watchdog_fired += 1
+            if self.tracer.enabled:
+                self.tracer.instant("watchdog-fired", runner.group_track,
+                                    args={"bucket": runner.track_name,
+                                          "error": str(exc)})
         master_print(f"serve fetch watchdog: bucket {runner.key} boundary "
                      f"fetch hung ({exc}); failing the group's "
                      f"{sum(o is not None for o in runner.occupant)} "
                      f"in-flight and {len(runner.q)} queued request(s)")
         for lane, req in enumerate(runner.occupant):
             if req is not None:
+                runner._trace_occupancy(lane, req, "error")
                 self._fail_request(
                     req, "error",
                     f"fetch-watchdog: {exc} — lane {lane}'s group state "
@@ -881,6 +1021,24 @@ class Engine:
                 f"fetch-watchdog: {exc} — request was still queued when "
                 f"its bucket group's boundary fetch hung")
         runner.inflight.clear()
+        if is_watchdog:
+            # flight-recorder trigger: the ring holds the wedged
+            # request's whole span chain up to the hang — dump it next to
+            # the results so the postmortem starts with a timeline
+            self._flight_dump(f"fetch watchdog fired for bucket "
+                              f"{runner.key}")
+
+    def _flight_dump(self, reason: str) -> None:
+        """Flight-recorder dump (watchdog fire / quarantine-after-
+        rollbacks / scheduler crash): atomic write of the event ring to
+        ``flight_dir`` (default: ``out_dir``, else the cwd). Must never
+        raise into the failure path it is documenting."""
+        try:
+            self.tracer.flight_dump(
+                self.scfg.flight_dir or self.scfg.out_dir or ".", reason)
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            master_print(f"flight recorder: dump failed "
+                         f"({type(e).__name__}: {e})")
 
     @staticmethod
     def _public(rec: dict) -> dict:
@@ -913,6 +1071,13 @@ class Engine:
             if self.scfg.emit_records:
                 json_record("serve_request", **snap)
             self._cond.notify_all()
+        if self.tracer.enabled:
+            # flow end: the terminal record left the engine (scheduler
+            # thread for rejections/failures, writer thread for finishes)
+            xid = snap.get("trace_id")
+            if xid:
+                self.tracer.flow("f", self.tracer.thread_track(), xid,
+                                 ts=now)
         # listeners run OUTSIDE the lock: they may call poll()/summary()
         for fn in listeners:
             try:
@@ -980,7 +1145,7 @@ class Engine:
                 "Engine.run()/results() cannot be called while the online "
                 "scheduler thread is serving — use poll()/wait() for "
                 "records, shutdown() to drain")
-        writer = async_io.SnapshotWriter()
+        writer = async_io.SnapshotWriter(tracer=self.tracer)
         t0 = wall_clock()
         try:
             runners = [
@@ -1018,17 +1183,24 @@ class Engine:
                         if r.has_work():
                             nxt.append(r)
                     live = nxt
-        except BaseException:
-            # drain-on-exception: every writeback already queued still
-            # lands (or fails per-request) — no orphan *.tmp, no dropped
-            # result — but a writer error must not mask the scheduler
-            # error already propagating
+        except BaseException as e:
+            # flight-recorder trigger: the scheduler loop died — dump the
+            # ring first (cheap, bounded), THEN drain: every writeback
+            # already queued still lands (or fails per-request) — no
+            # orphan *.tmp, no dropped result — but a writer error must
+            # not mask the scheduler error already propagating
+            self._flight_dump(f"scheduler crashed: {type(e).__name__}: {e}")
             writer.drain(raise_errors=False)
             raise
         # normal exit: per-request jobs swallow their own failures, so a
         # surviving writer error here is a real bug and must surface
         writer.drain()
         self._stamp_timing(Timing, wall_clock() - t0)
+        if self.tracer.enabled:
+            self.tracer.complete("engine.run", self.tracer.thread_track(),
+                                 t0, cat="engine")
+            if self.scfg.trace:
+                self.tracer.export(self.scfg.trace)
         return list(self._records)
 
     def _stamp_timing(self, Timing, wall: float) -> None:
@@ -1108,7 +1280,7 @@ class Engine:
         exit path so no accepted request's writeback is dropped."""
         from ..runtime.timing import Timing
 
-        writer = async_io.SnapshotWriter()
+        writer = async_io.SnapshotWriter(tracer=self.tracer)
         runners: Dict[BucketKey, _GroupRunner] = {}
         t0 = wall_clock()
         try:
@@ -1156,6 +1328,8 @@ class Engine:
             self.loop_error = e
             master_print(f"serve scheduler loop failed: "
                          f"{type(e).__name__}: {e}")
+            self._flight_dump(f"scheduler loop crashed: "
+                              f"{type(e).__name__}: {e}")
             for r in runners.values():
                 self._fail_group(r, e)
         finally:
@@ -1163,6 +1337,16 @@ class Engine:
                 writer.drain(raise_errors=False)
             finally:
                 self._stamp_timing(Timing, wall_clock() - t0)
+                if self.tracer.enabled:
+                    self.tracer.complete("serve-loop",
+                                         self.tracer.thread_track(), t0,
+                                         cat="engine")
+                    if self.scfg.trace:
+                        try:
+                            self.tracer.export(self.scfg.trace)
+                        except OSError as te:
+                            master_print(f"trace export to "
+                                         f"{self.scfg.trace} failed: {te}")
                 with self._cond:
                     self._cond.notify_all()  # unblock wait() callers
 
@@ -1214,6 +1398,9 @@ class Engine:
                     rec["error"] = f"{type(e).__name__}: {e}"
             self._emit(rec)
 
+        # the writer thread labels its span with the request it serves
+        # (snapshot D2H + atomic publish, on the writer's own track)
+        job._trace = (f"writeback {req.id}", rec.get("trace_id"))
         writer.submit(job)
 
     def _finish_async(self, eng: LaneEngine, lane: int, req: Request,

@@ -98,6 +98,9 @@ def test_info(capsys):
     assert "gloo CPU collectives:" in out
     assert "compile cache:" in out
     assert ("warm" in out) or ("cold/empty" in out)
+    # the trace defaults line (ISSUE 7): flight recorder + export knobs
+    assert "trace defaults: flight recorder on" in out
+    assert "--trace FILE" in out and "HEAT_TPU_TRACE" in out
 
 
 def test_bad_mesh_arg():

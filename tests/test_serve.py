@@ -731,7 +731,8 @@ def test_fetch_watchdog_fails_group_cleanly_others_drain(tmp_path):
     draining normally (no hang)."""
     faults.reset()
     eng = Engine(quiet(lanes=2, chunk=8, buckets=(16,),
-                       inject="fetch-hang:ms=1500", fetch_timeout_s=0.2))
+                       inject="fetch-hang:ms=1500", fetch_timeout_s=0.2,
+                       flight_dir=str(tmp_path)))
     # f64 group submitted first -> its boundary fetch comes first -> hangs
     hung = [eng.submit(HeatConfig(n=16, ntime=24, dtype="float64"))
             for _ in range(3)]
@@ -747,10 +748,11 @@ def test_fetch_watchdog_fails_group_cleanly_others_drain(tmp_path):
     assert eng.watchdog_fired == 1
 
 
-def test_fetch_watchdog_fires_in_sync_fallback_too():
+def test_fetch_watchdog_fires_in_sync_fallback_too(tmp_path):
     faults.reset()
     eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,), dispatch_depth=0,
-                       inject="fetch-hang:ms=1500", fetch_timeout_s=0.2))
+                       inject="fetch-hang:ms=1500", fetch_timeout_s=0.2,
+                       flight_dir=str(tmp_path)))
     rid = eng.submit(HeatConfig(n=16, ntime=24, dtype="float64"))
     recs = {r["id"]: r for r in eng.results()}
     assert recs[rid]["status"] == "error"
