@@ -8,7 +8,8 @@ SHELL := /bin/bash
 .PHONY: test tier1 chaos lint bench bench-all bench-smoke chip-check \
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
-        serve-lab serve-chaos-lab frontend-lab trace-lab native run viz clean
+        serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
+        perfcheck native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -90,6 +91,16 @@ frontend-lab:          # online front-end A/B: Poisson arrivals, EDF vs
 trace-lab:             # tracing-overhead A/B: off vs flight-recorder vs
                        # full --trace on the serve_lab wave (<= 2% gate)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/trace_overhead_lab.py
+
+prof-lab:              # observatory-overhead A/B: full cost-model/ledger/
+                       # watermark/burn-rate metering vs off (<= 2% gate,
+                       # npz bit-identity at depths 0 and 2)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/prof_overhead_lab.py
+
+perfcheck:             # CI perf gate: fresh prof-lab vs committed baseline
+                       # (tolerance band) + every committed lab's internal
+                       # gates + cost-model-vs-calibration cross-check
+	env JAX_PLATFORMS=cpu $(PY) -m heat_tpu perfcheck
 
 sweep:                 # flap-tolerant full chip queue
 	bash benchmarks/watch_and_sweep.sh

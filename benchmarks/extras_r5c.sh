@@ -118,4 +118,16 @@ phase serve_frontend_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_fron
 # throughput (best-of-N walls), with a non-empty Perfetto-loadable
 # export. CPU-world: runs with the tunnel down.
 phase trace_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/trace_overhead_lab.py
+# Observatory-overhead A/B (ISSUE 8): the serve_lab wave with the full
+# performance/cost observatory (online chunk-cost model + per-tenant
+# usage ledger + memory watermarks + SLO burn windows) vs observatory
+# off — must stay within 2% and keep npz outputs byte-identical at
+# dispatch depths 0 and 2, with the usage ledger reconciling exactly
+# against the per-record stamps. CPU-world: runs with the tunnel down.
+phase prof_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/prof_overhead_lab.py
+# Perf regression gate (ISSUE 8): fresh prof_overhead_lab vs the
+# committed baseline within a tolerance band, every committed lab's
+# internal gates re-validated, and the online cost model cross-checked
+# against calibration_v5e.json (hard gate on TPU, informational on CPU).
+phase perfcheck 1800 env JAX_PLATFORMS=cpu python -m heat_tpu perfcheck
 echo "=== extras_r5c done at $(date)"

@@ -77,7 +77,7 @@ def chunk_sizes(cfg: HeatConfig, remaining: int) -> list[int]:
     return sorted(sizes)
 
 
-def aot_compile_chunks(advance, example, sizes, compiled=None):
+def aot_compile_chunks(advance, example, sizes, compiled=None, label=None):
     """AOT-compile ``advance(example..., k)`` for every chunk size ``k``
     in ``sizes`` not already covered; returns ``(compiled, seconds)``.
 
@@ -85,7 +85,12 @@ def aot_compile_chunks(advance, example, sizes, compiled=None):
     the serving engine's lane programs (serve/engine.py) both go through
     here, so no compile ever lands inside a timed region and compile
     bookkeeping (guard hand-off, serve's one-per-bucket accounting) stays a
-    dict of size -> executable everywhere.
+    dict of size -> executable everywhere. Being the one path also makes
+    it the compile observatory's tap (runtime/prof.py): every program
+    actually built lands in the process-wide structured compile log with
+    its ``label`` (caller-supplied key: bucket/tier for lanes, grid/dtype
+    for solo solves), per-program wall, and first-vs-warm — the wall of a
+    warm re-compile is the persistent compile cache's report card.
 
     ``example`` is a single array for the solo drive shape
     (``advance(T, k)``) or a TUPLE of arrays for multi-argument programs
@@ -95,12 +100,20 @@ def aot_compile_chunks(advance, example, sizes, compiled=None):
     single pytree argument cannot express); a tuple is splatted into
     ``lower``.
     """
+    from ..runtime import prof
+
     compiled = dict(compiled or {})
     args = example if isinstance(example, tuple) else (example,)
+    if label is None:
+        shape = getattr(args[0], "shape", ())
+        dtype = getattr(args[0], "dtype", "?")
+        label = f"chunk {tuple(shape)} {dtype}"
     t0 = time.perf_counter()
     for k in sizes:
         if k not in compiled:
+            tk = time.perf_counter()
             compiled[k] = advance.lower(*args, k).compile()
+            prof.compile_log().note(label, k, time.perf_counter() - tk)
     return compiled, time.perf_counter() - t0
 
 
@@ -161,7 +174,8 @@ def drive(
     if warmup and remaining > 0:
         t_c0 = time.perf_counter()
         compiled, spent = aot_compile_chunks(
-            advance, T_dev, chunk_sizes(cfg, remaining), compiled)
+            advance, T_dev, chunk_sizes(cfg, remaining), compiled,
+            label=f"solve {cfg.backend} n{cfg.n}^{cfg.ndim} {cfg.dtype}")
         if tracer.enabled and spent > 0:
             tracer.complete("compile", drv_track, t_c0, cat="solve",
                             args={"sizes": chunk_sizes(cfg, remaining)})

@@ -266,8 +266,67 @@ def build_parser() -> argparse.ArgumentParser:
                             "watchdog fires, a lane quarantines after "
                             "its rollback budget, or the scheduler loop "
                             "crashes; 0 disables recording entirely")
+    serve.add_argument("--prof", default="on", metavar="on|off",
+                       help="performance & cost observatory "
+                            "(runtime/prof.py): online per-bucket chunk-"
+                            "cost model, per-tenant usage ledger, memory "
+                            "watermarks + leak sentinel, SLO burn-rate "
+                            "monitor — all fed from timestamps the "
+                            "scheduler already takes (overhead gate: "
+                            "benchmarks/prof_overhead_lab.json). "
+                            "'off' = A/B baseline (records keep their "
+                            "usage stamps; aggregation off) (default on)")
+    serve.add_argument("--slo-targets", dest="slo_targets",
+                       metavar="CLASS=FRAC,...",
+                       help="per-class SLO targets for the burn-rate "
+                            "monitor, e.g. 'interactive=0.999,batch=0.8' "
+                            "(deadline-hit fraction; error budget = "
+                            "1 - target; defaults interactive=0.99, "
+                            "standard=0.95, batch=0.9)")
+    serve.add_argument("--mem-poll", dest="mem_poll", type=int,
+                       metavar="N",
+                       help="chunk boundaries between device-memory "
+                            "watermark samples (leak sentinel; default "
+                            "32, 0 = never sample)")
     serve.add_argument("--json", action="store_true",
                        help="also print a machine-readable summary line")
+
+    usage = sub.add_parser(
+        "usage",
+        help="per-tenant usage ledger: render lane-seconds / steps / "
+             "chunks / bytes-written per tenant and SLO class, from a "
+             "running gateway (GET /v1/usage) or from a saved stream of "
+             "serve_request JSON records")
+    usage.add_argument("source",
+                       help="gateway base URL (http://HOST:PORT — "
+                            "/v1/usage is fetched) or a file of "
+                            "serve_request JSON lines (the offline "
+                            "drain's stdout records)")
+    usage.add_argument("--json", action="store_true",
+                       help="print the raw ledger JSON instead of the "
+                            "table")
+
+    pc = sub.add_parser(
+        "perfcheck",
+        help="performance regression gate: run the observatory-overhead "
+             "lab (benchmarks/prof_overhead_lab.py), compare it against "
+             "the committed baseline JSON within a tolerance band, "
+             "re-validate every committed lab's internal gates, and "
+             "cross-check the online cost model against "
+             "calibration_v5e.json")
+    pc.add_argument("--fresh", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="run a fresh prof_overhead_lab and compare it "
+                         "to the committed baseline (--no-fresh = only "
+                         "re-validate committed artifacts; fast)")
+    pc.add_argument("--tolerance", type=float, default=0.5,
+                    help="relative band for fresh-vs-baseline throughput "
+                         "(default 0.5 = within 50%% either way — CI "
+                         "boxes jitter; the hard gates are the labs' "
+                         "internal ones)")
+    pc.add_argument("--baseline",
+                    help="baseline prof_overhead_lab JSON (default: the "
+                         "committed benchmarks/prof_overhead_lab.json)")
 
     trc = sub.add_parser(
         "trace",
@@ -540,6 +599,21 @@ def _serve_report(summary, ok: int, args) -> None:
                      f"{summary['deadline_misses']} deadline miss(es), "
                      f"{summary['shed']} shed, "
                      f"{summary['watchdog_fired']} watchdog timeout(s)")
+    cm = summary.get("cost_model") or []
+    if cm:
+        tops = sorted(cm, key=lambda e: -e["wall_s"])[:3]
+        more = f" (+{len(cm) - 3} more)" if len(cm) > 3 else ""
+        master_print("cost model: " + "; ".join(
+            f"{e['bucket']} xL{e['lanes']} d{e['depth']}: "
+            f"{e['ewma_s_per_lane_step'] or 0:.3e} s/lane-step "
+            f"({e['chunks']} chunks)" for e in tops) + more)
+    mem = summary.get("mem") or {}
+    if mem.get("samples"):
+        master_print(f"observatory: mem peak "
+                     f"{(mem.get('peak_bytes') or 0) / 2**20:.1f} MiB "
+                     f"({mem['source']}, {mem['samples']} sample(s), "
+                     f"{mem['warnings']} leak warning(s)); "
+                     f"{summary.get('flightrec_dumps', 0)} flight dump(s)")
     if args.json:
         master_print(_json.dumps(summary, sort_keys=True))
 
@@ -557,7 +631,7 @@ def cmd_serve(args) -> int:
     same summary over everything it served.
     """
     from .config import parse_dispatch_depth, parse_listen, \
-        parse_tenant_weights
+        parse_on_off, parse_slo_targets, parse_tenant_weights
     from .serve import Engine, ServeConfig, serve_requests
 
     path = None
@@ -589,7 +663,12 @@ def cmd_serve(args) -> int:
                            tenant_weights=parse_tenant_weights(
                                args.tenant_weights or ""),
                            tenant_quota=args.tenant_quota,
-                           trace=trace_path, trace_buffer=trace_cap)
+                           trace=trace_path, trace_buffer=trace_cap,
+                           prof=parse_on_off(args.prof, "--prof"),
+                           slo_targets=parse_slo_targets(
+                               args.slo_targets or ""),
+                           **({"mem_poll_every": args.mem_poll}
+                              if args.mem_poll is not None else {}))
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -644,6 +723,226 @@ def cmd_serve(args) -> int:
               file=sys.stderr)
         return 1
     return 0 if ok == summary["requests"] else 1
+
+
+def cmd_usage(args) -> int:
+    """Render the per-tenant usage ledger as a table (or raw JSON) from
+    either a running gateway's ``GET /v1/usage`` or a saved stream of
+    ``serve_request`` JSON records — the offline spelling re-aggregates
+    the exact per-record usage stamps, so both sources reconcile with
+    each other by construction (runtime/prof.py UsageLedger)."""
+    import json as _json
+
+    src = str(args.source)
+    if src.startswith(("http://", "https://")):
+        import urllib.request
+
+        url = src.rstrip("/")
+        if not url.endswith("/v1/usage"):
+            url += "/v1/usage"
+        try:
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                payload = _json.loads(resp.read().decode())
+        except Exception as e:  # noqa: BLE001 — CLI boundary
+            print(f"error: GET {url} failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            return 2
+    else:
+        path = Path(src)
+        if not path.exists():
+            print(f"error: {src} is neither an http(s) URL nor a file",
+                  file=sys.stderr)
+            return 2
+        from .runtime.prof import UsageLedger, empty_usage
+
+        ledger = UsageLedger()
+        found = 0
+        for line in path.read_text().splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue   # records interleave with human report lines
+            try:
+                d = _json.loads(line)
+            except ValueError:
+                continue
+            if d.get("event") != "serve_request":
+                continue
+            found += 1
+            ledger.add(d.get("tenant") or "default",
+                       d.get("class") or "standard",
+                       d.get("status") or "?",
+                       d.get("usage") or empty_usage())
+        if not found:
+            print(f"error: no serve_request JSON records found in {src}",
+                  file=sys.stderr)
+            return 2
+        payload = ledger.snapshot()
+    if args.json:
+        print(_json.dumps(payload, sort_keys=True))
+        return 0
+    hdr = (f"{'tenant':<20} {'class':<12} {'requests':>8} {'lane_s':>10} "
+           f"{'steps':>10} {'chunks':>8} {'MiB':>8}")
+    print(hdr)
+    print("-" * len(hdr))
+
+    def row(name, cls, c):
+        print(f"{name:<20} {cls:<12} {c['requests']:>8} "
+              f"{c['lane_s']:>10.3f} {c['steps']:>10} {c['chunks']:>8} "
+              f"{c['bytes_written'] / 2**20:>8.2f}")
+
+    for tenant, t in sorted(payload["tenants"].items()):
+        for cls, c in sorted(t["classes"].items()):
+            row(tenant, cls, c)
+    print("-" * len(hdr))
+    row("TOTAL", "", payload["totals"])
+    return 0
+
+
+def _band_ok(ratio: float, tolerance: float) -> bool:
+    """Symmetric relative band: ratio within [1-t, 1/(1-t)]."""
+    lo = 1.0 - tolerance
+    return lo <= ratio <= 1.0 / lo
+
+
+def cmd_perfcheck(args) -> int:
+    """The performance regression gate (CI/tooling satellite, ISSUE 8).
+
+    Three layers, strict to informational:
+    1. re-validate every committed lab JSON's *internal* gates (the
+       claims the artifacts were committed with must still hold as
+       recorded — a hand-edited or stale artifact fails loudly);
+    2. run a fresh ``benchmarks/prof_overhead_lab.py`` and require its
+       gates to pass AND its throughput to land within ``--tolerance``
+       of the committed baseline (the band absorbs box-to-box jitter;
+       the gates do not);
+    3. cross-check the lab's recorded online cost model against the
+       static ``calibration_v5e.json`` fit — a hard gate only when the
+       lab ran on the calibrated platform, informational elsewhere
+       (a CPU lab vs a TPU calibration is a sanity ratio, not a fail).
+    """
+    import json as _json
+    import os
+    import re as _re
+    import subprocess
+    import tempfile
+
+    repo = Path(__file__).resolve().parent.parent
+    bdir = repo / "benchmarks"
+    baseline_path = (Path(args.baseline) if args.baseline
+                     else bdir / "prof_overhead_lab.json")
+    results: list[tuple[bool, str]] = []
+
+    def check(ok: bool, name: str, detail: str) -> None:
+        results.append((ok, f"{name}: {detail}"))
+
+    if not baseline_path.exists():
+        print(f"error: baseline {baseline_path} not found (run "
+              f"benchmarks/prof_overhead_lab.py first, or pass "
+              f"--baseline)", file=sys.stderr)
+        return 2
+    base = _json.loads(baseline_path.read_text())
+    check(base.get("on_within_2pct_of_off") is True,
+          "baseline overhead gate",
+          f"observatory-on within 2% of off "
+          f"(recorded {100 * base.get('on_overhead_frac', 0):+.2f}%)")
+    check(bool(base.get("bit_identical_depth0"))
+          and bool(base.get("bit_identical_depth2")),
+          "baseline bit-identity",
+          "npz outputs identical with observatory on vs off at depths "
+          "0 and 2")
+    check(base.get("usage_reconciles") is True, "baseline usage ledger",
+          "ledger totals == sum of per-record usage stamps")
+
+    # committed sibling labs: their internal gates, as recorded
+    for fname, gates in (
+            ("serve_lab.json",
+             (("bit_identical_sample", lambda v: v is True),
+              ("one_compile_per_bucket_lane_tier", lambda v: v is True),
+              ("aggregate_speedup", lambda v: (v or 0) >= 3.0))),
+            ("trace_overhead_lab.json",
+             (("full_within_2pct_of_off", lambda v: v is True),
+              ("trace_export_nonempty", lambda v: v is True))),
+            ("serve_chaos_lab.json",
+             (("bit_identical_healthy_sample", lambda v: v is True),
+              ("healthy_within_10pct", lambda v: v is True),
+              ("all_poisoned_quarantined", lambda v: v is True))),
+            ("serve_frontend_lab.json",
+             (("edf_vs_fifo_hit_rate_delta", lambda v: (v or -1) >= 0),))):
+        p = bdir / fname
+        if not p.exists():
+            check(False, fname, "committed artifact missing")
+            continue
+        d = _json.loads(p.read_text())
+        for field, pred in gates:
+            check(bool(pred(d.get(field))), f"{fname}",
+                  f"{field}={d.get(field)}")
+
+    fresh = None
+    if args.fresh:
+        out = Path(tempfile.mkdtemp(prefix="perfcheck_")) / "fresh.json"
+        lab = bdir / "prof_overhead_lab.py"
+        env = {**os.environ,
+               "PYTHONPATH": str(repo) + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+        rc = subprocess.call([sys.executable, str(lab), "--out", str(out)],
+                             env=env, stdout=subprocess.DEVNULL)
+        check(rc == 0 and out.exists(), "fresh lab run",
+              f"prof_overhead_lab.py exited rc={rc}")
+        if out.exists():
+            fresh = _json.loads(out.read_text())
+            check(fresh.get("on_within_2pct_of_off") is True,
+                  "fresh overhead gate",
+                  f"{100 * fresh.get('on_overhead_frac', 0):+.2f}% "
+                  f"(gate <= +2%)")
+            check(bool(fresh.get("bit_identical_depth0"))
+                  and bool(fresh.get("bit_identical_depth2")),
+                  "fresh bit-identity", "npz on-vs-off at depths 0 and 2")
+            b_pts = (base.get("on") or {}).get("points_per_s") or 0
+            f_pts = (fresh.get("on") or {}).get("points_per_s") or 0
+            if b_pts and f_pts:
+                ratio = f_pts / b_pts
+                check(_band_ok(ratio, args.tolerance),
+                      "fresh-vs-baseline band",
+                      f"throughput ratio {ratio:.3f} (tolerance "
+                      f"±{100 * args.tolerance:.0f}%)")
+            else:
+                check(False, "fresh-vs-baseline band",
+                      "points_per_s missing from lab output")
+
+    # cost model vs the static calibration fit
+    cal_path = bdir / "calibration_v5e.json"
+    cm = (fresh or base).get("cost_model") or []
+    if cal_path.exists() and cm:
+        cal = _json.loads(cal_path.read_text())
+        cal_pts = (cal.get("sweep_2d") or {}).get("points_per_s")
+        on_tpu = str((fresh or base).get("platform", "")) == "tpu"
+        for e in cm:
+            m = _re.match(r"(\d)d/n(\d+)/", e["bucket"])
+            per = e.get("ewma_s_per_lane_step")
+            if not m or not per or not cal_pts:
+                continue
+            ndim, side = int(m.group(1)), int(m.group(2))
+            implied = side**ndim / per
+            ratio = implied / cal_pts
+            line = (f"bucket {e['bucket']}: cost model implies "
+                    f"{implied:.3e} pts/s = {100 * ratio:.2f}% of the "
+                    f"calibrated v5e stencil rate")
+            if on_tpu:
+                # live model within 4x of the one-off fit: lanes pay
+                # masking/vmap overhead vs the solo Pallas kernel, but an
+                # order-of-magnitude gap means one of the two is wrong
+                check(0.25 <= ratio <= 4.0, "calibration cross-check",
+                      line)
+            else:
+                check(True, "calibration cross-check (informational, "
+                      f"platform={(fresh or base).get('platform')})", line)
+
+    failed = [line for ok, line in results if not ok]
+    for ok, line in results:
+        print(("OK   " if ok else "FAIL ") + line)
+    print(f"perfcheck: {'OK' if not failed else 'FAILED'} — "
+          f"{len(results) - len(failed)}/{len(results)} checks passed")
+    return 0 if not failed else 1
 
 
 def cmd_trace(args) -> int:
@@ -1052,6 +1351,29 @@ def cmd_info(_args) -> int:
           f"for a text summary; HEAT_TPU_TRACE=off / --trace-buffer 0 "
           f"disables")
 
+    # performance & cost observatory (runtime/prof.py): the metering
+    # defaults plus this process's compile-observatory state (mostly
+    # cold at info time — the line says where the warm numbers surface)
+    from .config import SLO_CLASSES as _slo_classes
+    from .config import SLO_TARGETS
+    from .runtime import prof as _prof
+
+    _comp = _prof.compile_log().summary()
+    _targets = ",".join(f"{c}={t:g}" for c, t in sorted(
+        SLO_TARGETS.items(), key=lambda kv: _slo_classes.get(kv[0], 99)))
+    print(f"perf observatory: on by default (--prof off = A/B baseline) "
+          f"— online chunk-cost model per (bucket, lane-tier, depth), "
+          f"per-tenant usage ledger (GET /v1/usage, heat-tpu usage), "
+          f"memory watermarks every {_sd.mem_poll_every} boundaries "
+          f"(--mem-poll), SLO burn monitor (targets {_targets}, "
+          f"--slo-targets); surfaces: /metrics, GET /statusz, "
+          f"Engine.summary(), heat-tpu perfcheck")
+    print(f"compile observatory: {_comp['programs']} program(s) compiled "
+          f"by this process ({_comp['total_s']:.2f}s; "
+          f"{_comp['first_s']:.2f}s first-time, {_comp['warm_s']:.2f}s "
+          f"warm) — structured per-compile events ride trace spans and "
+          f"/metrics; per-program keys in GET /statusz")
+
     # online gateway defaults (`heat-tpu serve --listen HOST:PORT`): the
     # admission policy and SLO-class table requests are validated against
     from .config import SLO_CLASSES
@@ -1101,7 +1423,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     return {"run": cmd_run, "viz": cmd_viz, "info": cmd_info,
             "launch": cmd_launch, "plan": cmd_plan, "serve": cmd_serve,
             "bench": cmd_bench, "calibrate": cmd_calibrate,
-            "trace": cmd_trace}[args.command](args)
+            "trace": cmd_trace, "usage": cmd_usage,
+            "perfcheck": cmd_perfcheck}[args.command](args)
 
 
 if __name__ == "__main__":

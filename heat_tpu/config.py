@@ -263,6 +263,15 @@ SLO_CLASSES = {"interactive": 0, "standard": 1, "batch": 2}
 DEFAULT_SLO_CLASS = "standard"
 DEFAULT_TENANT = "default"
 
+# Default per-class SLO targets (deadline-hit fraction) for the burn-rate
+# monitor (runtime/prof.py BurnMonitor): the error budget a class may
+# spend is 1 - target, and the monitor's burn rate is miss_fraction /
+# budget. Tighter classes get tighter budgets; override per engine with
+# ``--slo-targets interactive=0.999`` (parse_slo_targets below). Lives
+# here with SLO_CLASSES because this module is the one validation
+# chokepoint for anything class-shaped.
+SLO_TARGETS = {"interactive": 0.99, "standard": 0.95, "batch": 0.9}
+
 _TENANT_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 
 
@@ -329,6 +338,50 @@ def parse_tenant_weights(s) -> Tuple[Tuple[str, float], ...]:
                 f"--tenant-weights weight must be > 0, got {weight}")
         out.append((tenant, weight))
     return tuple(out)
+
+
+def parse_slo_targets(s) -> Tuple[Tuple[str, float], ...]:
+    """``--slo-targets interactive=0.999,batch=0.8`` -> (("interactive",
+    0.999), ("batch", 0.8)). Classes must exist (SLO_CLASSES) and targets
+    lie strictly in (0, 1) — a target of 1.0 is a zero error budget and
+    every burn rate would be infinite; unlisted classes keep the
+    SLO_TARGETS defaults."""
+    out = []
+    for tok in str(s).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        name, sep, t = tok.partition("=")
+        if not sep:
+            raise ValueError(
+                f"--slo-targets entries must be CLASS=TARGET, got {tok!r}")
+        name = name.strip()
+        if name not in SLO_CLASSES:
+            raise ValueError(
+                f"--slo-targets class must be one of {sorted(SLO_CLASSES)}, "
+                f"got {name!r}")
+        try:
+            target = float(t)
+        except ValueError:
+            raise ValueError(
+                f"--slo-targets target must be a number, got {t!r}"
+            ) from None
+        if not 0.0 < target < 1.0:
+            raise ValueError(
+                f"--slo-targets target must be in (0, 1), got {target}")
+        out.append((name, target))
+    return tuple(out)
+
+
+def parse_on_off(v, flag: str) -> bool:
+    """``on``/``off`` CLI grammar shared by boolean serve knobs
+    (``--prof``)."""
+    s = str(v).strip().lower()
+    if s == "on":
+        return True
+    if s == "off":
+        return False
+    raise ValueError(f"{flag} must be 'on' or 'off', got {v!r}")
 
 
 def parse_dispatch_depth(v) -> int:

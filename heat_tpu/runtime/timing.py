@@ -149,6 +149,11 @@ class Timing:
     rollbacks: int | None = None
     deadline_misses: int | None = None
     shed: int | None = None
+    # Performance-observatory accounting (runtime/prof.py; None when the
+    # observatory is off or never sampled). mem_peak_bytes: the highest
+    # device-memory watermark the boundary-cadence sampler saw — the
+    # number a capacity plan (and the leak sentinel) keys on.
+    mem_peak_bytes: int | None = None
 
     @property
     def per_step_s(self) -> float:
@@ -184,4 +189,7 @@ class Timing:
                 f"{self.rollbacks or 0} rollback(s), "
                 f"{self.deadline_misses or 0} deadline miss(es), "
                 f"{self.shed or 0} shed")
+        if self.mem_peak_bytes is not None:
+            lines.append(f"observatory: mem peak "
+                         f"{self.mem_peak_bytes / 2**20:.1f} MiB")
         return lines

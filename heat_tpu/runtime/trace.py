@@ -110,6 +110,10 @@ class Tracer:
         self._ids = itertools.count(1)
         self._id_prefix = f"{os.getpid():x}"
         self.dumps = 0                      # flight dumps written
+        self.dump_paths: List[str] = []     # where they landed (the
+                                            # flightrec record + /statusz
+                                            # name these so operators
+                                            # never grep the filesystem)
         self.dropped_hint = False           # ring wrapped at least once
         self._appended = 0
 
@@ -277,6 +281,7 @@ class Tracer:
             master_print(f"flight recorder: dump to {path} failed ({e}) — "
                          f"continuing without it")
             return None
+        self.dump_paths.append(str(path))
         master_print(f"flight recorder: {reason} — dumped {len(self._buf)} "
                      f"event(s) to {path}")
         return path

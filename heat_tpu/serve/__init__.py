@@ -40,7 +40,8 @@ from .api import (ParsedRequest, load_requests,  # noqa: F401
 def __getattr__(name):
     # Gateway imports lazily: the offline drain must not pay for (or
     # depend on) the HTTP stack it never uses.
-    if name in ("Gateway", "render_metrics"):
+    if name in ("Gateway", "render_metrics", "render_statusz",
+                "usage_payload"):
         from . import gateway
 
         return getattr(gateway, name)

@@ -291,8 +291,14 @@ class LaneEngine:
         if ckey not in self._cache:
             from ..backends.common import aot_compile_chunks
 
+            # the compile-observatory key (runtime/prof.py): which lane
+            # program this was — bucket geometry x tier, steady vs tail
+            # k — so the structured compile log attributes lazy tail/tier
+            # compiles to the group that forced them
             compiled, spent = aot_compile_chunks(
-                self._advance_fn, self._state, [k])
+                self._advance_fn, self._state, [k],
+                label=(f"lanes {self.key.ndim}d n{self.key.n} "
+                       f"{self.key.dtype} {self.key.bc} L{self.lanes}"))
             self._cache[ckey] = compiled[k]
             self.compile_s += spent
             if self._on_compile is not None:

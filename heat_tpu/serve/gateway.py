@@ -33,6 +33,22 @@ system owes its operators:
   Every response to ``/v1/solve`` echoes the minted per-request trace
   ids in an ``X-Trace-Id`` header (and every NDJSON record carries its
   ``trace_id``), so client logs join against the timeline.
+- ``GET /statusz`` — human-readable operator snapshot (text): engine
+  counters, the online chunk-cost model (runtime/prof.py), compile
+  observatory, memory watermarks, SLO burn rates, top tenants by usage,
+  flight-recorder dump paths. The "what is this server doing right now"
+  page; everything on it is also machine-readable elsewhere.
+- ``GET /v1/usage`` — the per-tenant usage ledger as JSON: lane-seconds,
+  steps, chunks, and bytes written per (tenant, class) plus engine-wide
+  totals, reconciling exactly with the ``usage`` stamps on the
+  per-request records (``heat-tpu usage URL`` renders it as a table).
+
+**Every** response carries an ``X-Trace-Id`` header — success, 4xx/5xx
+error paths, ``/drainz``, all of it: the inbound header is echoed when
+the client sent one (charset-checked), else an id is minted, so a
+client log line always joins against the server's trace no matter how
+the request ended. ``/v1/solve`` responses override the default with
+the per-request ids they minted.
 
 Backpressure is the PR-5 machinery made visible: a submit shed by
 ``--max-queue`` or ``--tenant-quota`` answers **429 with Retry-After**
@@ -53,12 +69,14 @@ from __future__ import annotations
 
 import json
 import queue as queue_lib
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
 from ..config import SLO_CLASSES
+from ..runtime import prof as prof_mod
 from ..runtime import trace as trace_mod
 from ..runtime.logging import master_print
 from .api import parse_request_obj, submit_parsed
@@ -67,6 +85,11 @@ from .scheduler import Engine, TERMINAL_STATUSES
 MAX_BODY_BYTES = 16 << 20   # one POST body; a solve request is ~100 bytes,
                             # so this bounds even absurd batch lines
 _OVERLOAD_PREFIX = "overloaded:"
+
+# Inbound X-Trace-Id values we will echo verbatim: ids we mint plus any
+# sane client-correlation token. Anything else (header-splitting
+# attempts, binary junk) is replaced by a freshly minted id.
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._,-]{1,200}$")
 
 
 def escape_label_value(v) -> str:
@@ -152,6 +175,91 @@ def render_metrics(engine: Engine) -> str:
     metric("heat_tpu_serve_boundary_wait_seconds_total", "counter",
            "Host wall seconds blocked on chunk-boundary fetches.",
            [([], s["boundary_wait_s"])])
+    metric("heat_tpu_flightrec_dumps_total", "counter",
+           "Flight-recorder dumps written (watchdog fire / quarantine-"
+           "after-rollbacks / scheduler crash); paths in the structured "
+           "flightrec records and on /statusz.",
+           [([], engine.tracer.dumps)])
+
+    # --- performance & cost observatory (runtime/prof.py) ----------------
+    cm = s.get("cost_model") or []
+    metric("heat_tpu_serve_cost_s_per_lane_step", "gauge",
+           "Online chunk-cost model: EWMA seconds per lane-step, per "
+           "(bucket, lane-tier, dispatch-depth). The live counterpart of "
+           "calibration_v5e.json (cross-check: heat-tpu perfcheck).",
+           [([("bucket", e["bucket"]), ("lanes", e["lanes"]),
+              ("depth", e["depth"])], e["ewma_s_per_lane_step"])
+            for e in cm if e["ewma_s_per_lane_step"] is not None]
+           or [([], 0)])
+    metric("heat_tpu_serve_cost_chunks_observed_total", "counter",
+           "Chunk boundaries the cost model has learned from, per key.",
+           [([("bucket", e["bucket"]), ("lanes", e["lanes"]),
+              ("depth", e["depth"])], e["chunks"]) for e in cm]
+           or [([], 0)])
+    comp = prof_mod.compile_log().summary()
+    metric("heat_tpu_compile_programs_total", "counter",
+           "Chunk programs actually compiled by this process "
+           "(aot_compile_chunks — solo solves and lane engines alike), "
+           "by first-vs-warm key attribution.",
+           [([("kind", "first")], comp["distinct"]),
+            ([("kind", "warm")], comp["programs"] - comp["distinct"])])
+    metric("heat_tpu_compile_seconds_total", "counter",
+           "Wall seconds spent compiling chunk programs, by first-vs-"
+           "warm (warm re-compile wall = persistent-cache report card).",
+           [([("kind", "first")], comp["first_s"]),
+            ([("kind", "warm")], comp["warm_s"])])
+    mem = s.get("mem") or {}
+    metric("heat_tpu_mem_bytes_in_use", "gauge",
+           "Newest device-memory watermark sample (source label: "
+           "allocator stats or live-array bytes).",
+           [([("source", mem.get("source", "unavailable"))],
+             mem.get("last_bytes") or 0)])
+    metric("heat_tpu_mem_peak_bytes", "gauge",
+           "Peak device-memory watermark this engine has seen.",
+           [([], mem.get("peak_bytes") or 0)])
+    metric("heat_tpu_mem_watermark_warnings_total", "counter",
+           "Leak-sentinel firings (monotone growth past the byte floor).",
+           [([], mem.get("warnings") or 0)])
+    burn = s.get("slo_burn") or {}
+    for name, field, help_text in (
+            ("heat_tpu_slo_burn_rate", None,
+             "Error-budget burn rate per class and window (1.0 = burning "
+             "exactly at the sustainable rate; >threshold in both windows "
+             "emits a structured slo_alert)."),
+            ("heat_tpu_slo_deadline_hit_ratio", "hit",
+             "Deadline-hit fraction per class and window (dated requests "
+             "only; absent window = no dated traffic).")):
+        samples = []
+        for cls, b in sorted(burn.items()):
+            for window in ("fast", "slow"):
+                v = (b[f"{window}_burn"] if field is None
+                     else b[f"{window}_hit_ratio"])
+                if v is not None:
+                    samples.append(
+                        ([("class", cls), ("window", window)], v))
+        metric(name, "gauge", help_text, samples or [([], 0)])
+    metric("heat_tpu_slo_alerts_total", "counter",
+           "Structured slo_alert records emitted, per class.",
+           [([("class", cls)], b["alerts"])
+            for cls, b in sorted(burn.items())] or [([], 0)])
+    usage = engine.prof.ledger.snapshot()
+    for name, field, help_text in (
+            ("heat_tpu_usage_lane_seconds_total", "lane_s",
+             "Lane-occupancy seconds consumed, per tenant and class "
+             "(the per-request usage stamps, aggregated)."),
+            ("heat_tpu_usage_steps_total", "steps",
+             "Simulation steps served, per tenant and class."),
+            ("heat_tpu_usage_chunks_total", "chunks",
+             "Chunk programs participated in, per tenant and class."),
+            ("heat_tpu_usage_bytes_written_total", "bytes_written",
+             "Result bytes produced, per tenant and class."),
+            ("heat_tpu_usage_requests_total", "requests",
+             "Terminal requests accounted, per tenant and class.")):
+        metric(name, "counter", help_text,
+               [([("tenant", tenant), ("class", cls)], c[field])
+                for tenant, t in sorted(usage["tenants"].items())
+                for cls, c in sorted(t["classes"].items())]
+               or [([], 0)])
 
     def histogram(name, help_text, label, hist):
         out.append(f"# HELP {name} {help_text}")
@@ -173,6 +281,108 @@ def render_metrics(engine: Engine) -> str:
               "Total queue depth observed at each accepted submit.",
               None, engine.depth_hist)
     return "\n".join(out) + "\n"
+
+
+def usage_payload(engine: Engine) -> dict:
+    """The ``GET /v1/usage`` body: the per-tenant usage ledger
+    (runtime/prof.py) plus identity fields. Pure function of the engine
+    so the exact-reconciliation test asserts on it without a socket.
+    ``totals`` sums the same stamps every terminal record carries — the
+    two views reconcile exactly by construction."""
+    payload = engine.prof.ledger.snapshot()
+    payload["prof"] = engine.scfg.prof
+    payload["uptime_s"] = round(trace_mod.process_uptime_s(), 3)
+    return payload
+
+
+def render_statusz(engine: Engine) -> str:
+    """The ``GET /statusz`` page: one human-readable snapshot of the
+    serving process for an operator mid-incident — counters, the online
+    cost model, compile observatory, memory watermarks, SLO burn, top
+    tenants, flight-recorder dumps. Text on purpose: curl-able from any
+    box with no dashboard in reach."""
+    s = engine.summary()
+    lines = [f"heat-tpu serving engine — statusz "
+             f"(uptime {trace_mod.process_uptime_s():.0f}s, "
+             f"policy {s['policy']}, dispatch depth {s['dispatch_depth']}, "
+             f"observatory {'on' if s['prof'] else 'OFF'})", ""]
+    lines.append(
+        f"requests: {s['requests']} total — "
+        + ", ".join(f"{s.get(st, 0)} {st}" for st in
+                    (*TERMINAL_STATUSES, "queued", "running")
+                    if s.get(st)))
+    lines.append(
+        f"engine: {s['chunks_dispatched']} chunk(s) "
+        f"({s['tail_chunks']} tail), {s['boundary_waits']} boundary "
+        f"wait(s) {s['boundary_wait_s']:.3f}s, device idle "
+        f"{s['device_idle_s']:.3f}s, {s['step_compiles']}+"
+        f"{s['tail_compiles']} compiles {s['compile_s']:.2f}s, "
+        f"{s['lane_grows']} lane grow(s)")
+    lines.append(
+        f"faults: {s['lanes_quarantined']} quarantined, "
+        f"{s['rollbacks']} rollback(s), {s['deadline_misses']} deadline "
+        f"miss(es), {s['shed']} shed, {s['watchdog_fired']} watchdog")
+    cm = s.get("cost_model") or []
+    lines.append("")
+    lines.append(f"cost model ({len(cm)} key(s), s/lane-step EWMA; "
+                 f"cross-check: heat-tpu perfcheck):")
+    if not cm:
+        lines.append("  (no chunk boundaries observed yet)")
+    for e in cm:
+        ew = e["ewma_s_per_lane_step"]
+        lines.append(
+            f"  {e['bucket']} xL{e['lanes']} depth{e['depth']}: "
+            f"{'n/a' if ew is None else format(ew, '.3e')} s/lane-step "
+            f"(p95 {e['p95_s_per_lane_step'] or 0:.0e}, "
+            f"{e['chunks']} chunk(s), {e['wall_s']:.3f}s observed)")
+    comp = s.get("compile", prof_mod.compile_log().summary())
+    lines.append("")
+    lines.append(
+        f"compile observatory (process-wide): {comp['programs']} "
+        f"program(s) / {comp['distinct']} distinct key(s), "
+        f"{comp['total_s']:.2f}s total ({comp['first_s']:.2f}s first-time, "
+        f"{comp['warm_s']:.2f}s warm re-compiles)")
+    mem = s.get("mem") or {}
+    lines.append(
+        f"memory watermarks: peak "
+        f"{(mem.get('peak_bytes') or 0) / 2**20:.1f} MiB, last "
+        f"{(mem.get('last_bytes') or 0) / 2**20:.1f} MiB "
+        f"({mem.get('source', 'unavailable')}; {mem.get('samples', 0)} "
+        f"sample(s), {mem.get('warnings', 0)} leak warning(s))")
+    burn = s.get("slo_burn") or {}
+    lines.append("")
+    lines.append("slo burn (dated requests; budget = 1 - target):")
+    if not burn:
+        lines.append("  (no dated traffic yet)")
+    for cls, b in sorted(burn.items()):
+        lines.append(
+            f"  {cls}: target {b['target']:g}, burn fast "
+            f"{b['fast_burn']:.2f}x / slow {b['slow_burn']:.2f}x, "
+            f"hit fast {b['fast_hit_ratio']} / slow {b['slow_hit_ratio']} "
+            f"({b['fast_events']}/{b['slow_events']} events, "
+            f"{b['alerts']} alert(s))")
+    usage = engine.prof.ledger.snapshot()
+    tot = usage["totals"]
+    lines.append("")
+    lines.append(
+        f"usage ledger: {tot['requests']} request(s), "
+        f"{tot['lane_s']:.3f} lane-s, {tot['steps']} steps, "
+        f"{tot['chunks']} chunk-slots, "
+        f"{tot['bytes_written'] / 2**20:.2f} MiB written "
+        f"(full detail: GET /v1/usage or heat-tpu usage URL)")
+    top = sorted(usage["tenants"].items(),
+                 key=lambda kv: -kv[1]["lane_s"])[:5]
+    for tenant, t in top:
+        lines.append(
+            f"  {tenant}: {t['lane_s']:.3f} lane-s, {t['steps']} steps, "
+            f"{t['requests']} request(s), "
+            f"{t['bytes_written'] / 2**20:.2f} MiB")
+    if engine.tracer.dumps:
+        lines.append("")
+        lines.append(f"flight-recorder dumps ({engine.tracer.dumps}):")
+        for p in engine.tracer.dump_paths:
+            lines.append(f"  {p}")
+    return "\n".join(lines) + "\n"
 
 
 class Gateway:
@@ -258,14 +468,41 @@ class _Handler(BaseHTTPRequestHandler):
         if not self.gw.quiet:           # lines would swamp serve output
             master_print(f"gateway: {self.address_string()} {fmt % args}")
 
-    def _json(self, code: int, obj, headers=()) -> None:
-        body = (json.dumps(obj, sort_keys=True) + "\n").encode()
+    @property
+    def trace_id(self) -> str:
+        """The X-Trace-Id EVERY response to this request echoes: the
+        client's inbound header when sane (so a client-side id survives
+        the round trip even on a 4xx/5xx), else a freshly minted id.
+        Cached per request; /v1/solve overrides it with the per-request
+        ids it mints."""
+        tid = getattr(self, "_trace_id", None)
+        if tid is None:
+            inbound = (self.headers.get("X-Trace-Id") or "").strip()
+            tid = (inbound if _TRACE_ID_RE.match(inbound)
+                   else self.gw.engine.tracer.mint_trace_id())
+            self._trace_id = tid
+        return tid
+
+    def _send_headers(self, code: int, body_len: int, ctype: str,
+                      headers=()) -> None:
+        """Shared response-header path: the one place that guarantees the
+        X-Trace-Id contract (satellite audit, ISSUE 8) — an explicit
+        X-Trace-Id in ``headers`` wins; every other response gets the
+        request-scoped default, 429s and 400s and /drainz included."""
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(body_len))
+        has_tid = False
         for k, v in headers:
             self.send_header(k, str(v))
+            has_tid = has_tid or k == "X-Trace-Id"
+        if not has_tid:
+            self.send_header("X-Trace-Id", self.trace_id)
         self.end_headers()
+
+    def _json(self, code: int, obj, headers=()) -> None:
+        body = (json.dumps(obj, sort_keys=True) + "\n").encode()
+        self._send_headers(code, len(body), "application/json", headers)
         try:
             self.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):
@@ -273,10 +510,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _text(self, code: int, text: str, ctype: str) -> None:
         body = text.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
+        self._send_headers(code, len(body), ctype)
         try:
             self.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):
@@ -305,6 +539,10 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/metrics":
             self._text(200, render_metrics(eng),
                        "text/plain; version=0.0.4")
+        elif path == "/statusz":
+            self._text(200, render_statusz(eng), "text/plain; charset=utf-8")
+        elif path == "/v1/usage":
+            self._json(200, usage_payload(eng))
         elif path == "/tracez":
             # the flight recorder's ring, on demand: a Chrome trace JSON
             # snapshot of the engine as it runs (loadable in Perfetto —
@@ -456,8 +694,12 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
+        has_tid = False
         for k, v in headers:
             self.send_header(k, str(v))
+            has_tid = has_tid or k == "X-Trace-Id"
+        if not has_tid:
+            self.send_header("X-Trace-Id", self.trace_id)
         self.end_headers()
 
         def chunk(obj) -> bool:

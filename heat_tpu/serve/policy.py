@@ -29,21 +29,23 @@ Thread-safety contract: queue objects are NOT internally locked — every
 push/pop happens under the engine's one lock (scheduler.py), which also
 keeps the per-tenant queue-depth counters consistent with the queues.
 
-The module also hosts the tiny Prometheus-shaped ``Histogram`` the
-gateway's ``/metrics`` surface exports (per-class latency, queue depth):
-stdlib-only, cumulative buckets, text rendering in serve/gateway.py.
+The tiny Prometheus-shaped ``Histogram`` the gateway's ``/metrics``
+surface exports (per-class latency, queue depth) moved to
+``runtime/prof.py`` with the rest of the observatory primitives (PR 8);
+it is re-exported here so every existing ``policy_mod.Histogram``
+consumer keeps working.
 """
 
 from __future__ import annotations
 
 import collections
 import heapq
-import itertools
 import math
-import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..config import SLO_CLASSES
+from ..runtime.prof import (DEPTH_BUCKETS, LATENCY_BUCKETS,  # noqa: F401
+                            Histogram)
 
 POLICIES = ("fifo", "edf", "fair")
 
@@ -185,59 +187,5 @@ def make_queue(policy: str, tenant_weights=()):
 
 
 # --- /metrics primitives -----------------------------------------------------
-
-# Latency-shaped default buckets (seconds): sub-ms admission rejections up
-# through minute-scale batch solves; queue-depth histograms reuse the same
-# machinery with integer buckets.
-LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
-                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
-DEPTH_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
-
-
-class Histogram:
-    """A Prometheus-style cumulative histogram (stdlib-only).
-
-    ``observe`` is called from the scheduler AND writer threads, so it
-    carries its own lock (deliberately not the engine lock: a /metrics
-    scrape must never contend with the boundary hot path for the lock
-    that guards admission)."""
-
-    def __init__(self, buckets=LATENCY_BUCKETS):
-        self.buckets = tuple(buckets)
-        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
-        self._sum = 0.0
-        self._n = 0
-        self._lock = threading.Lock()
-
-    def observe(self, v: float) -> None:
-        with self._lock:
-            for i, b in enumerate(self.buckets):
-                if v <= b:
-                    self._counts[i] += 1
-                    break
-            else:
-                self._counts[-1] += 1
-            self._sum += v
-            self._n += 1
-
-    def snapshot(self) -> dict:
-        """Cumulative (le -> count) pairs + sum/count, scrape-consistent."""
-        with self._lock:
-            counts = list(self._counts)
-            total_sum, n = self._sum, self._n
-        cum = list(itertools.accumulate(counts))
-        les = [*(f"{b:g}" for b in self.buckets), "+Inf"]
-        return {"buckets": list(zip(les, cum)), "sum": total_sum, "count": n}
-
-    def quantile(self, q: float) -> Optional[float]:
-        """Bucket-upper-bound estimate of the q-quantile (the benchmark's
-        p50/p95/p99 reporting; None when empty). Conservative: returns the
-        smallest bucket bound covering q of the observations."""
-        snap = self.snapshot()
-        if not snap["count"]:
-            return None
-        target = q * snap["count"]
-        for le, cum in snap["buckets"]:
-            if cum >= target:
-                return math.inf if le == "+Inf" else float(le)
-        return math.inf
+# Histogram / LATENCY_BUCKETS / DEPTH_BUCKETS live in runtime/prof.py now
+# (re-exported above).

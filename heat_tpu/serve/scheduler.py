@@ -89,10 +89,11 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..config import (DEFAULT_SLO_CLASS, DEFAULT_TENANT, HeatConfig,
-                      validate_slo_fields)
+from ..config import (DEFAULT_SLO_CLASS, DEFAULT_TENANT, SLO_TARGETS,
+                      HeatConfig, validate_slo_fields)
 from ..grid import initial_condition
 from ..runtime import async_io, faults
+from ..runtime import prof as prof_mod
 from ..runtime import trace as trace_mod
 from ..runtime.logging import json_record, master_print
 from . import policy as policy_mod
@@ -177,6 +178,31 @@ class ServeConfig:
                               # (flightrec-<ts>.trace.json on watchdog /
                               # quarantine-after-rollbacks / scheduler
                               # crash); None = out_dir, else the cwd
+    prof: bool = True         # the performance & cost observatory
+                              # (runtime/prof.py): online chunk-cost
+                              # model, per-tenant usage ledger, memory
+                              # watermarks, SLO burn-rate monitor — fed
+                              # from timestamps the scheduler already
+                              # takes. off = aggregation/model/sampling
+                              # disabled (records keep their usage
+                              # stamps so the schema never flickers);
+                              # the A/B baseline of
+                              # benchmarks/prof_overhead_lab.py
+    slo_targets: tuple = ()   # (("class", target), ...) per-class SLO
+                              # target overrides (deadline-hit fraction;
+                              # defaults config.SLO_TARGETS) — the burn
+                              # monitor's error budget is 1 - target
+    slo_burn_threshold: float = prof_mod.SLO_BURN_THRESHOLD
+                              # emit a structured slo_alert when a
+                              # class's FAST and SLOW windows both burn
+                              # budget above this multiple of the
+                              # sustainable rate
+    slo_fast_window_s: float = prof_mod.SLO_FAST_WINDOW_S
+    slo_slow_window_s: float = prof_mod.SLO_SLOW_WINDOW_S
+    mem_poll_every: int = prof_mod.MEM_POLL_EVERY_DEFAULT
+                              # chunk boundaries between device-memory
+                              # watermark samples (leak sentinel);
+                              # 0 = never sample
 
     def __post_init__(self):
         if self.lanes < 1:
@@ -218,6 +244,22 @@ class ServeConfig:
         if self.trace and self.trace_buffer == 0:
             raise ValueError("trace export needs trace_buffer > 0 (the "
                              "export is the event ring's contents)")
+        for entry in self.slo_targets:
+            cls, target = entry
+            validate_slo_fields(None, cls)
+            if not 0.0 < float(target) < 1.0:
+                raise ValueError(f"SLO target must be in (0, 1), got "
+                                 f"{cls}={target}")
+        if self.slo_burn_threshold <= 0:
+            raise ValueError(f"slo_burn_threshold must be > 0, got "
+                             f"{self.slo_burn_threshold}")
+        if self.slo_fast_window_s <= 0 or self.slo_slow_window_s <= 0:
+            raise ValueError("SLO burn windows must be > 0 seconds, got "
+                             f"{self.slo_fast_window_s}/"
+                             f"{self.slo_slow_window_s}")
+        if self.mem_poll_every < 0:
+            raise ValueError(f"mem_poll_every must be >= 0 (0 = never "
+                             f"sample), got {self.mem_poll_every}")
         if self.inject:
             # fail at construction, not at a boundary mid-drain (same
             # parse-time contract as HeatConfig.inject)
@@ -318,6 +360,14 @@ class _GroupRunner:
         self.inflight: collections.deque = collections.deque()
         self.idle_from: Optional[float] = None  # group device queue empty
                                                 # since (boundary gaps only)
+        # cost-observatory feed (runtime/prof.py): the model key names the
+        # bucket geometry; per-lane chunk counters back the usage stamps
+        # (one vectorized add per dispatch — no per-lane python loop, no
+        # device work); last_fetch_t makes the boundary service-time
+        # estimator exact under pipelining (see prof.CostModel)
+        self.cost_label = f"{key.ndim}d/n{key.n}/{key.dtype}/{key.bc}"
+        self.lane_chunks = np.zeros(self.lanes, dtype=np.int64)
+        self.last_fetch_t: Optional[float] = None
         self.allow_growth = False   # online loop opts in: offline run()
                                     # sizes runners from the full queue,
                                     # so growth (and its pipeline drain)
@@ -388,6 +438,8 @@ class _GroupRunner:
                 self.occupant[lane] = req
                 self.epoch[lane] = self.seq
                 self.dev_rem[lane] = req.cfg.ntime
+                self.lane_chunks[lane] = 0   # usage meter restarts with
+                                             # the new occupant
                 self.nan_pending[lane] = outer._lane_nan_steps(req)
                 if self.nan_pending[lane]:
                     outer._has_lane_faults = True  # gates _maybe_poison
@@ -449,6 +501,10 @@ class _GroupRunner:
                     self.tracer.complete("device-idle", self.group_track,
                                          self.idle_from, t_disp, cat="idle")
                 self.idle_from = None
+            # usage metering: every lane still counting down participates
+            # in this chunk (one vectorized add; freed lanes' garbage
+            # counts are reset at the next admission)
+            self.lane_chunks += self.dev_rem > 0
             np.maximum(self.dev_rem - k, 0, out=self.dev_rem)
             # rollback mode keeps every in-flight boundary restorable:
             # the snapshot is promoted to a lane's last_good only once
@@ -515,10 +571,13 @@ class _GroupRunner:
                 self._handle_nonfinite(lane, req, int(rem[lane]), snap)
             elif rem[lane] == 0:
                 self._trace_occupancy(lane, req, "retired")
+                chunks = int(self.lane_chunks[lane])
                 if sync:
-                    outer._finish_sync(self.eng, lane, req, self.writer)
+                    outer._finish_sync(self.eng, lane, req, self.writer,
+                                       chunks=chunks)
                 else:
-                    outer._finish_async(self.eng, lane, req, self.writer)
+                    outer._finish_async(self.eng, lane, req, self.writer,
+                                        chunks=chunks)
                 self.occupant[lane] = None
             elif req.deadline_t is not None and now > req.deadline_t:
                 done = req.cfg.ntime - int(rem[lane])
@@ -528,7 +587,8 @@ class _GroupRunner:
                     f"deadline: exceeded its "
                     f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms budget "
                     f"with ~{done} of {req.cfg.ntime} steps done; lane "
-                    f"{lane} preempted at the chunk boundary", lane=lane)
+                    f"{lane} preempted at the chunk boundary", lane=lane,
+                    steps_done=done, chunks=int(self.lane_chunks[lane]))
                 outer.deadline_misses += 1
                 # the lane keeps counting down on device (masked garbage
                 # until refilled) so the host mirror stays exact; a
@@ -593,7 +653,8 @@ class _GroupRunner:
                 req, "nonfinite",
                 f"nonfinite: non-finite field detected at ~step {done} of "
                 f"{req.cfg.ntime} (lane {lane}){tried} — check the CFL "
-                f"bound sigma <= 1/(2*ndim) for this request", lane=lane)
+                f"bound sigma <= 1/(2*ndim) for this request", lane=lane,
+                steps_done=done, chunks=int(self.lane_chunks[lane]))
             outer.lanes_quarantined += 1
             if exhausted:
                 # flight-recorder trigger: a lane quarantined after its
@@ -617,16 +678,32 @@ class _GroupRunner:
         if self.inflight:
             seq, handle, predicted, snap, t_disp, k = self.inflight.popleft()
             b = self._fetch(handle)
+            t_done = wall_clock()
             rem, finite = b[0], b[1]
             if self.tracer.enabled:
                 # chunk-in-flight span: dispatch enqueue -> boundary
                 # fetched (under dispatch-ahead the newer chunks compute
                 # behind this interval — visibly, on the timeline)
                 self.tracer.complete(f"chunk {seq} ({k} steps)",
-                                     self.group_track, t_disp, cat="chunk",
+                                     self.group_track, t_disp, t_done,
+                                     cat="chunk",
                                      args={"seq": seq, "k": k})
+            outer = self.outer
+            if outer.prof.enabled:
+                # cost-model feed: boundary service time from timestamps
+                # already taken — exact when fenced, per-chunk under a
+                # saturated pipeline (prof.CostModel); then the cadenced
+                # memory watermark sample, also off the dispatch path
+                base = (t_disp if self.last_fetch_t is None
+                        else max(self.last_fetch_t, t_disp))
+                outer.prof.observe_chunk(self.cost_label, self.lanes,
+                                         self.depth, k, t_done - base)
+                self.last_fetch_t = t_done
+                warn = outer.prof.maybe_sample_memory(t_done)
+                if warn is not None:
+                    outer._mem_warn(warn)
             if not self.inflight:
-                self.idle_from = wall_clock()
+                self.idle_from = t_done
             if not np.array_equal(rem, predicted):
                 raise RuntimeError(
                     f"serve dispatch-ahead desync for bucket {self.key}: "
@@ -677,6 +754,7 @@ class _GroupRunner:
                                     outer.scfg.lanes)), outer.scfg.lanes)
         old_eng, old_occ = self.eng, self.occupant
         old_rem, old_nan, old_rb = self.dev_rem, self.nan_pending, self.rb_left
+        old_chunks = self.lane_chunks
         if self.tracer.enabled:
             self.tracer.instant("lane-tier-grow", self.group_track,
                                 args={"from": self.lanes, "to": want})
@@ -687,6 +765,7 @@ class _GroupRunner:
         self.occupant = [None] * want
         self.epoch = [self.seq] * want
         self.dev_rem = np.zeros(want, dtype=np.int64)
+        self.lane_chunks = np.zeros(want, dtype=np.int64)
         self.nan_pending = [[] for _ in range(want)]
         self.rb_left = [0] * want
         self.last_good = [None] * want
@@ -700,6 +779,7 @@ class _GroupRunner:
                                int(old_rem[lane]), req.cfg.bc_value)
             self.occupant[lane] = req
             self.dev_rem[lane] = old_rem[lane]
+            self.lane_chunks[lane] = old_chunks[lane]
             self.nan_pending[lane] = old_nan[lane]
             self.rb_left[lane] = old_rb[lane]
             # the old tier's stack snapshots have the old lane count: drop
@@ -738,6 +818,15 @@ class _GroupRunner:
                                      t0, self.idle_from, cat="chunk",
                                      args={"seq": self.seq,
                                            "k": self.chunk})
+            if outer.prof.enabled:
+                # fenced boundary: the dispatch->fetch wall IS the chunk
+                # service time (cost-model key depth 0, the sync shape)
+                outer.prof.observe_chunk(self.cost_label, self.lanes, 0,
+                                         self.chunk, self.idle_from - t0)
+                warn = outer.prof.maybe_sample_memory(self.idle_from)
+                if warn is not None:
+                    outer._mem_warn(warn)
+            self.lane_chunks += self.dev_rem > 0
             np.maximum(self.dev_rem - self.chunk, 0, out=self.dev_rem)
             if self.rollback:
                 snap = self.eng.snapshot_stack()
@@ -780,6 +869,20 @@ class Engine:
         # ``scfg.trace`` at drain. ``trace_buffer=0`` disables recording
         # (ids are still minted: the record schema never flickers).
         self.tracer = trace_mod.Tracer(capacity=scfg.trace_buffer)
+        # performance & cost observatory (runtime/prof.py): chunk-cost
+        # model, per-tenant usage ledger, memory watermarks, SLO burn
+        # monitor — all fed from timestamps this scheduler already takes.
+        # Its locks are its own and are only ever taken AFTER (or
+        # without) the engine lock, never before it — the gateway's
+        # scrape endpoints can therefore never deadlock the hot path.
+        targets = dict(SLO_TARGETS)
+        targets.update((c, float(t)) for c, t in scfg.slo_targets)
+        self.prof = prof_mod.Observatory(
+            enabled=scfg.prof, slo_targets=targets,
+            mem_poll_every=scfg.mem_poll_every,
+            slo_fast_window_s=scfg.slo_fast_window_s,
+            slo_slow_window_s=scfg.slo_slow_window_s,
+            slo_burn_threshold=scfg.slo_burn_threshold)
         self._queues: Dict[BucketKey, object] = {}  # policy queues
         self._records: List[dict] = []
         self._by_id: Dict[str, dict] = {}
@@ -846,6 +949,16 @@ class Engine:
         else:
             self.tail_compiles += 1
         self.compile_s += seconds
+        if self.tracer.enabled:
+            # compile-observatory span: the lazy tail/tier compile is the
+            # one that lands mid-drain — make its wall visible on the
+            # timeline, not just in the aggregate counter
+            t1 = wall_clock()
+            self.tracer.complete(f"compile k={k}",
+                                 self.tracer.thread_track("compiler"),
+                                 t1 - seconds, t1, cat="compile",
+                                 args={"k": k,
+                                       "seconds": round(seconds, 4)})
 
     # --- admission --------------------------------------------------------
     def submit(self, cfg: HeatConfig, request_id: Optional[str] = None,
@@ -959,13 +1072,19 @@ class Engine:
         with self._lock:
             rec["status"] = "rejected"
             rec["error"] = reason
+            rec["usage"] = prof_mod.empty_usage()   # schema-stable stamp
         self._emit(rec)
 
     def _fail_request(self, req: Request, status: str, reason: str,
-                      lane: Optional[int] = None) -> None:
+                      lane: Optional[int] = None, steps_done: int = 0,
+                      chunks: int = 0) -> None:
         """Fail ONE request with a structured status (nonfinite /
         deadline / error) — the per-lane fault-domain exit: the record
-        carries the reason, the engine keeps serving everyone else."""
+        carries the reason, the engine keeps serving everyone else.
+        ``steps_done``/``chunks`` are the usage-ledger stamp: work the
+        failed request DID consume (a preempted lane still occupied the
+        group for its chunks — billing that work is the point of the
+        per-tenant ledger)."""
         rec = self._by_id[req.id]
         now = wall_clock()
         with self._lock:
@@ -978,7 +1097,24 @@ class Engine:
                 rec["lane"] = lane
             rec["status"] = status
             rec["error"] = reason
+            rec["usage"] = {"lane_s": rec["solve_s"] or 0.0,
+                            "steps": int(steps_done), "chunks": int(chunks),
+                            "bytes_written": 0}
         self._emit(rec)
+
+    def _mem_warn(self, warn: dict) -> None:
+        """The leak sentinel fired (runtime/prof.py MemWatermark): one
+        structured ``mem_watermark`` record + a human line. Called from
+        the scheduler thread at a chunk boundary — never inside the
+        dispatch loop."""
+        master_print(
+            f"mem watermark: device memory grew monotonically by "
+            f"{warn['growth_bytes'] / 2**20:.1f} MiB over the last "
+            f"{warn['window_samples']} samples to "
+            f"{warn['bytes_in_use'] / 2**20:.1f} MiB "
+            f"({warn['source']}) — a rollback-stack or lane-grow leak "
+            f"looks exactly like this; see TROUBLESHOOTING.md")
+        json_record("mem_watermark", **warn)
 
     def _fail_group(self, runner: "_GroupRunner", exc: BaseException) -> None:
         """The boundary-fetch watchdog fired for one bucket group: its
@@ -1007,7 +1143,10 @@ class Engine:
                 self._fail_request(
                     req, "error",
                     f"fetch-watchdog: {exc} — lane {lane}'s group state "
-                    f"is unreadable; request failed cleanly", lane=lane)
+                    f"is unreadable; request failed cleanly", lane=lane,
+                    steps_done=max(0, req.cfg.ntime
+                                   - int(runner.dev_rem[lane])),
+                    chunks=int(runner.lane_chunks[lane]))
                 runner.occupant[lane] = None
         while True:
             with self._lock:
@@ -1032,13 +1171,22 @@ class Engine:
         """Flight-recorder dump (watchdog fire / quarantine-after-
         rollbacks / scheduler crash): atomic write of the event ring to
         ``flight_dir`` (default: ``out_dir``, else the cwd). Must never
-        raise into the failure path it is documenting."""
+        raise into the failure path it is documenting. A successful dump
+        additionally emits a structured ``flightrec`` record naming the
+        file — operators find the dump from the log stream, not by
+        grepping the filesystem — and bumps the
+        ``heat_tpu_flightrec_dumps_total`` counter (/metrics)."""
         try:
-            self.tracer.flight_dump(
+            path = self.tracer.flight_dump(
                 self.scfg.flight_dir or self.scfg.out_dir or ".", reason)
         except Exception as e:  # noqa: BLE001 — best-effort by contract
             master_print(f"flight recorder: dump failed "
                          f"({type(e).__name__}: {e})")
+            return
+        if path is not None:
+            json_record("flightrec", reason=reason, path=str(path),
+                        events=len(self.tracer), dump=self.tracer.dumps,
+                        max_dumps=trace_mod.MAX_FLIGHT_DUMPS)
 
     @staticmethod
     def _public(rec: dict) -> dict:
@@ -1068,9 +1216,21 @@ class Engine:
                 if h is None:
                     h = self.lat_hist[cls] = policy_mod.Histogram()
                 h.observe(max(0.0, now - submit_t))
+            # observatory feed: usage ledger + SLO burn windows consume
+            # the terminal snapshot (their own locks — engine->prof lock
+            # order only); an slo_alert payload is emitted OUTSIDE this
+            # lock, like the listeners
+            alert = self.prof.note_terminal(snap, now)
             if self.scfg.emit_records:
                 json_record("serve_request", **snap)
             self._cond.notify_all()
+        if alert is not None:
+            master_print(
+                f"slo alert: class {alert['class']!r} burning its error "
+                f"budget at {alert['fast_burn']:.1f}x (fast) / "
+                f"{alert['slow_burn']:.1f}x (slow) the sustainable rate "
+                f"(target {alert['target']:g}) — see TROUBLESHOOTING.md")
+            json_record("slo_alert", **alert)
         if self.tracer.enabled:
             # flow end: the terminal record left the engine (scheduler
             # thread for rejections/failures, writer thread for finishes)
@@ -1204,6 +1364,7 @@ class Engine:
         return list(self._records)
 
     def _stamp_timing(self, Timing, wall: float) -> None:
+        mem = self.prof.mem.snapshot() if self.scfg.prof else {}
         self.timing = Timing(total_s=wall, solve_s=wall,
                              compile_s=self.compile_s,
                              dispatch_depth=self.scfg.dispatch_depth,
@@ -1212,7 +1373,8 @@ class Engine:
                              lanes_quarantined=self.lanes_quarantined,
                              rollbacks=self.rollbacks,
                              deadline_misses=self.deadline_misses,
-                             shed=self.shed)
+                             shed=self.shed,
+                             mem_peak_bytes=mem.get("peak_bytes"))
 
     def results(self) -> List[dict]:
         """``run`` + records (the common library call)."""
@@ -1351,7 +1513,7 @@ class Engine:
                     self._cond.notify_all()  # unblock wait() callers
 
     # --- lane retirement --------------------------------------------------
-    def _finish_timing(self, req: Request) -> dict:
+    def _finish_timing(self, req: Request, chunks: int = 0) -> dict:
         rec = self._by_id[req.id]
         now = wall_clock()
         with self._lock:
@@ -1359,6 +1521,12 @@ class Engine:
             rec["solve_s"] = round(now - start, 6)
             rec["steps_per_s"] = (round(req.cfg.ntime / (now - start), 3)
                                   if now > start else None)
+            # the usage-ledger stamp (runtime/prof.py): what THIS request
+            # consumed — bytes_written is finalized by the writer thread
+            # once the publish lands, before the record is emitted
+            rec["usage"] = {"lane_s": rec["solve_s"],
+                            "steps": int(req.cfg.ntime),
+                            "chunks": int(chunks), "bytes_written": 0}
         return rec
 
     def _writeback_job(self, rec: dict, req: Request, writer,
@@ -1384,12 +1552,21 @@ class Engine:
                     plan.sink_fault(cfg.ntime)
                 path = (str(_write_result(scfg.out_dir, req.id, T, cfg))
                         if scfg.out_dir else None)
+                # bytes the tenant's result cost: the published file's
+                # size, or the in-memory field bytes when nothing hits
+                # disk — finalized HERE (writer thread) so the ledger add
+                # at emission sees the complete stamp
+                from pathlib import Path as _Path
+
+                nbytes = (_Path(path).stat().st_size if path is not None
+                          else int(T.nbytes))
                 with self._lock:
                     if scfg.keep_fields or not scfg.out_dir:
                         rec["T"] = T
                     if path is not None:
                         rec["path"] = path
                     rec["status"] = "ok"
+                    rec["usage"]["bytes_written"] = int(nbytes)
             except BaseException as e:  # noqa: BLE001 — per-request record
                 if async_io.is_transient(e) and attempts["n"] <= writer.retries:
                     raise
@@ -1404,20 +1581,20 @@ class Engine:
         writer.submit(job)
 
     def _finish_async(self, eng: LaneEngine, lane: int, req: Request,
-                      writer) -> None:
+                      writer, chunks: int = 0) -> None:
         """Dispatch-ahead retirement: take a one-lane ON-DEVICE snapshot
         (enqueued behind the in-flight chunks; the scheduler thread never
         blocks) and move the D2H + writeback wholly into the writer."""
-        rec = self._finish_timing(req)
+        rec = self._finish_timing(req, chunks=chunks)
         snap = eng.snapshot_lane(lane)
         n = req.cfg.n
         self._writeback_job(rec, req, writer, lambda: eng.extract(snap, n))
 
     def _finish_sync(self, eng: LaneEngine, lane: int, req: Request,
-                     writer) -> None:
+                     writer, chunks: int = 0) -> None:
         """Sync-fallback retirement: fetch the lane on the scheduler
         thread (fences every chunk in flight), write back in the writer."""
-        rec = self._finish_timing(req)
+        rec = self._finish_timing(req, chunks=chunks)
         T = eng.extract_lane(lane, req.cfg.n)
         self._writeback_job(rec, req, writer, lambda: T)
 
@@ -1428,7 +1605,15 @@ class Engine:
                 r["status"] for r in self._records)
             n = len(self._records)
             queued = sum(len(q) for q in self._queues.values())
+        # observatory snapshots AFTER the engine lock is released
+        # (engine -> prof lock order; see Engine.__init__)
+        obs = self.prof.summary(wall_clock())
         return {"requests": n, **dict(by_status),
+                "prof": self.scfg.prof,
+                "cost_model": obs["cost_model"],
+                "mem": obs["mem"],
+                "slo_burn": obs["slo_burn"],
+                "flightrec_dumps": self.tracer.dumps,
                 "policy": self.scfg.policy,
                 "queued_now": queued,
                 "lane_grows": self.lane_grows,

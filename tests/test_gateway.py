@@ -391,3 +391,197 @@ def test_serve_cli_listen_mode_end_to_end(tmp_cwd, capsys, monkeypatch):
     assert "gateway listening on http://127.0.0.1:" in out
     assert "served 2 request(s): 2 ok" in out
     assert "policy edf" in out
+
+
+# --- X-Trace-Id on EVERY response (ISSUE 8 satellite audit) -------------------
+
+
+def test_x_trace_id_on_every_error_path_and_drainz():
+    """The header contract is universal: 400, 404, 429, 503, /drainz,
+    /metrics — every response names a trace id, and a sane inbound id is
+    echoed back even on rejection paths."""
+    gw, eng = make_gateway(max_queue=1, start_engine=False)
+    try:
+        # 400 empty body
+        st, _, hdrs = http(gw, "POST", "/v1/solve", "")
+        assert st == 400 and hdrs.get("X-Trace-Id")
+        # 404 unknown route + unknown id
+        st, _, hdrs = http(gw, "GET", "/no/such/route")
+        assert st == 404 and hdrs.get("X-Trace-Id")
+        st, _, hdrs = http(gw, "GET", "/v1/requests/nope")
+        assert st == 404 and hdrs.get("X-Trace-Id")
+        # 429 overloaded (held scheduler makes the bound deterministic):
+        # the satellite's regression case — a REJECTED request still
+        # carries the header (here: the submitted line's minted ids)
+        st, _, _ = http(gw, "POST", "/v1/solve?wait=0",
+                        line(id="q1", n=16, ntime=8, dtype="float64"))
+        assert st == 202
+        st, (body,), hdrs = http(gw, "POST", "/v1/solve?wait=0",
+                                 line(id="q2", n=16, ntime=8,
+                                      dtype="float64"))
+        assert st == 429 and hdrs.get("X-Trace-Id")
+        assert body["records"][0]["status"] == "rejected"
+        # GET endpoints carry it too (raw fetch: /metrics and /statusz
+        # bodies are text, not JSON lines)
+        for path in ("/metrics", "/healthz", "/statusz", "/v1/usage",
+                     "/tracez"):
+            resp = urllib.request.urlopen(
+                f"http://{gw.address}{path}", timeout=TIMEOUT)
+            assert resp.headers.get("X-Trace-Id"), path
+        # inbound echo: a sane client id survives the round trip on an
+        # error path; junk is replaced with a minted id
+        import urllib.request as _rq
+
+        req = _rq.Request(f"http://{gw.address}/v1/requests/nope",
+                          headers={"X-Trace-Id": "client-abc.123"})
+        try:
+            _rq.urlopen(req, timeout=TIMEOUT)
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert e.headers.get("X-Trace-Id") == "client-abc.123"
+        req = _rq.Request(f"http://{gw.address}/healthz",
+                          headers={"X-Trace-Id": "bad id\twith junk"})
+        resp = _rq.urlopen(req, timeout=TIMEOUT)
+        assert resp.headers.get("X-Trace-Id") != "bad id\twith junk"
+        assert resp.headers.get("X-Trace-Id")
+        # /drainz (the drain trigger itself is traceable)
+        eng.start()
+        st, _, hdrs = http(gw, "POST", "/drainz")
+        assert st == 200 and hdrs.get("X-Trace-Id")
+        # 503 while draining
+        st, _, hdrs = http(gw, "POST", "/v1/solve",
+                           line(n=16, ntime=4, dtype="float64"))
+        assert st == 503 and hdrs.get("X-Trace-Id")
+    finally:
+        gw.request_drain()
+        assert gw.wait_drained(TIMEOUT)
+        gw.close()
+
+
+# --- /statusz + /v1/usage over HTTP ------------------------------------------
+
+
+def test_statusz_and_usage_endpoints_over_http(tmp_path):
+    """GET /v1/usage totals reconcile exactly with the usage stamps on
+    the records the same gateway streamed back (acceptance), and
+    /statusz renders the operator snapshot."""
+    gw, eng = make_gateway(tmp_path)
+    try:
+        st, recs, _ = http(
+            gw, "POST", "/v1/solve",
+            line(id="u1", n=16, ntime=16, dtype="float64",
+                 tenant="acme", deadline_ms=60000)
+            + line(id="u2", n=16, ntime=24, dtype="float64",
+                   tenant="zeta", **{"class": "batch"}))
+        assert st == 200
+        assert all(r["status"] == "ok" for r in recs)
+        assert all("usage" in r for r in recs)
+        st, (payload,), _ = http(gw, "GET", "/v1/usage")
+        assert st == 200
+        tot = payload["totals"]
+        assert tot["requests"] == 2
+        assert tot["steps"] == sum(r["usage"]["steps"] for r in recs)
+        assert tot["chunks"] == sum(r["usage"]["chunks"] for r in recs)
+        assert tot["bytes_written"] == sum(
+            r["usage"]["bytes_written"] for r in recs)
+        assert abs(tot["lane_s"] - sum(r["usage"]["lane_s"]
+                                       for r in recs)) < 1e-6
+        assert set(payload["tenants"]) == {"acme", "zeta"}
+        resp = urllib.request.urlopen(
+            f"http://{gw.address}/statusz", timeout=TIMEOUT)
+        assert resp.status == 200
+        page = resp.read().decode()
+        assert "cost model" in page and "usage ledger" in page
+        assert "acme" in page and "slo burn" in page
+        # the CLI's URL spelling fetches the same ledger
+        import contextlib
+        import io
+
+        from heat_tpu.cli import main
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(["usage", f"http://{gw.address}"])
+        assert rc == 0
+        out = buf.getvalue()
+        assert "acme" in out and "TOTAL" in out
+    finally:
+        gw.request_drain()
+        assert gw.wait_drained(TIMEOUT)
+        gw.close()
+
+
+# --- concurrent scrapes vs submits vs drain (ISSUE 8 satellite) --------------
+
+
+def test_metrics_tracez_scraped_concurrently_with_submits_and_drain(
+        tmp_path):
+    """Lock-ordering/consistency regression: /metrics, /tracez, /statusz
+    and /v1/usage hammered from scrape threads WHILE submit threads feed
+    the running engine, and again mid-drain — every scrape answers 200
+    with parseable content, nothing deadlocks, and the drain completes.
+    (Counters are read under the engine lock from gateway threads; the
+    observatory reads take only its own locks — engine->prof order.)"""
+    gw, eng = make_gateway(tmp_path)
+    stop = threading.Event()
+    errors = []
+    scrapes = {"n": 0}
+
+    def scraper():
+        import urllib.request as _rq
+
+        while not stop.is_set():
+            for path in ("/metrics", "/tracez", "/statusz", "/v1/usage"):
+                try:
+                    resp = _rq.urlopen(
+                        f"http://{gw.address}{path}", timeout=TIMEOUT)
+                    raw = resp.read().decode()
+                    assert resp.status == 200
+                    if path in ("/tracez", "/v1/usage"):
+                        json.loads(raw)
+                    elif path == "/metrics":
+                        assert "heat_tpu_serve_requests_total" in raw
+                    scrapes["n"] += 1
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(f"{path}: {type(e).__name__}: {e}")
+                    return
+
+    def submitter(base):
+        try:
+            for i in range(3):
+                st, recs, _ = http(
+                    gw, "POST", "/v1/solve",
+                    line(id=f"{base}-{i}", n=16, ntime=24,
+                         dtype="float64", tenant=base))
+                assert st == 200 and recs[0]["status"] == "ok"
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(f"submit {base}: {type(e).__name__}: {e}")
+
+    scrapers = [threading.Thread(target=scraper) for _ in range(2)]
+    submitters = [threading.Thread(target=submitter, args=(t,))
+                  for t in ("acme", "zeta")]
+    for t in scrapers + submitters:
+        t.start()
+    try:
+        for t in submitters:
+            t.join(TIMEOUT)
+            assert not t.is_alive()
+        # drain WHILE the scrapers keep hammering: the mid-drain scrape
+        # is the regression case (draining flag + engine lock + ring
+        # export all read from gateway threads)
+        st, _, _ = http(gw, "POST", "/drainz")
+        assert st == 200
+        assert gw.wait_drained(TIMEOUT)
+        resp = urllib.request.urlopen(
+            f"http://{gw.address}/metrics", timeout=TIMEOUT)
+        assert resp.status == 200
+        assert "heat_tpu_serve_draining 1" in resp.read().decode()
+    finally:
+        stop.set()
+        for t in scrapers:
+            t.join(TIMEOUT)
+            assert not t.is_alive()
+        gw.close()
+    assert not errors, errors
+    assert scrapes["n"] > 0
+    assert eng.summary()["ok"] == 6

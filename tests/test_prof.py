@@ -1,0 +1,551 @@
+"""Performance & cost observatory (runtime/prof.py, ISSUE 8).
+
+The load-bearing contracts:
+
+- the online chunk-cost model's per-bucket estimate lands within a
+  tested tolerance of the measured wall on a synthetic fixed-cost
+  harness (acceptance), and its unit math is exact on synthetic
+  observations;
+- ``GET /v1/usage`` / the ledger totals reconcile EXACTLY with the sum
+  of per-request terminal-record usage stamps for a drained run
+  (acceptance) — including failed/preempted requests' partial work;
+- the SLO burn monitor's window math, alert threshold, and cooldown are
+  deterministic under synthetic timestamps, and a real deadline-missing
+  wave emits a structured ``slo_alert`` record;
+- the memory watermark leak sentinel fires exactly once per doubling on
+  monotone growth and never on a plateau, and a leak-shaped device
+  emits a structured ``mem_watermark`` record mid-drain;
+- the compile observatory logs every aot_compile_chunks program with
+  first-vs-warm attribution;
+- ``--prof off`` disables aggregation while records keep their usage
+  stamps (schema never flickers), and results stay bit-identical;
+- the CLI surfaces (``heat-tpu usage``, ``heat-tpu perfcheck``) run
+  against real artifacts.
+"""
+
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+from heat_tpu.config import (HeatConfig, parse_on_off, parse_slo_targets)
+from heat_tpu.runtime import prof as prof_mod
+from heat_tpu.serve import Engine, ServeConfig
+
+
+def make_engine(**kw):
+    kw.setdefault("lanes", 2)
+    kw.setdefault("chunk", 8)
+    kw.setdefault("buckets", (16,))
+    kw.setdefault("emit_records", False)
+    kw.setdefault("keep_fields", True)
+    return Engine(ServeConfig(**kw))
+
+
+# --- (a) online chunk-cost model ---------------------------------------------
+
+
+def test_cost_model_unit_math_exact():
+    cm = prof_mod.CostModel(alpha=0.5)
+    # two observations for one key: 8 steps x 4 lanes, 0.032s then 0.064s
+    cm.observe("2d/n32/float64/edges", 4, 2, 8, 0.032)
+    cm.observe("2d/n32/float64/edges", 4, 2, 8, 0.064)
+    per1, per2 = 0.032 / 32, 0.064 / 32
+    ewma = 0.5 * per1 + 0.5 * per2
+    assert cm.estimate_s_per_lane_step("2d/n32/float64/edges", 4, 2) == \
+        pytest.approx(ewma)
+    # request estimate: ntime * lanes * s_per_lane_step
+    assert cm.estimate_request_s("2d/n32/float64/edges", 4, 2, 100) == \
+        pytest.approx(ewma * 4 * 100)
+    (snap,) = cm.snapshot()
+    assert snap["chunks"] == 2
+    assert snap["mean_s_per_lane_step"] == pytest.approx(
+        (0.032 + 0.064) / (2 * 32))
+    assert snap["wall_s"] == pytest.approx(0.096)
+    # unknown key -> None, not a crash
+    assert cm.estimate_s_per_lane_step("nope", 1, 0) is None
+    assert cm.estimate_request_s("nope", 1, 0, 10) is None
+
+
+def test_cost_model_estimate_within_tolerance_of_measured_wall(monkeypatch):
+    """Acceptance: on a synthetic fixed-cost harness (every chunk
+    dispatch costs a deterministic ~4 ms), the model's per-bucket
+    request estimate lands within tolerance of the measured record
+    wall."""
+    from heat_tpu.serve import engine as engine_mod
+
+    real = engine_mod.LaneEngine.dispatch_chunk
+
+    def fixed_cost(self, k=None):
+        handle = real(self, k)
+        time.sleep(0.004)   # the dominant, deterministic chunk cost
+        return handle
+
+    monkeypatch.setattr(engine_mod.LaneEngine, "dispatch_chunk", fixed_cost)
+    eng = make_engine(lanes=1, dispatch_depth=1)
+    rid = eng.submit(HeatConfig(n=16, ntime=64, dtype="float64"))
+    (rec,) = [r for r in eng.results() if r["id"] == rid]
+    assert rec["status"] == "ok"
+    est = eng.prof.cost.estimate_request_s("2d/n16/float64/edges", 1, 1, 64)
+    assert est is not None
+    # 8 chunks x ~4ms: estimate and measured wall agree within 50%
+    assert est == pytest.approx(rec["solve_s"], rel=0.5)
+    (snap,) = [e for e in eng.summary()["cost_model"]
+               if e["lanes"] == 1]
+    assert snap["chunks"] == 8
+
+
+def test_cost_model_keys_sync_fallback_as_depth_zero():
+    eng = make_engine(dispatch_depth=0)
+    eng.submit(HeatConfig(n=16, ntime=16, dtype="float64"))
+    eng.results()
+    (snap,) = eng.summary()["cost_model"]
+    assert snap["depth"] == 0 and snap["chunks"] == 2
+    assert snap["ewma_s_per_lane_step"] > 0
+
+
+# --- (d) per-tenant usage ledger ---------------------------------------------
+
+
+def drain_mixed_wave(tmp_path=None, **kw):
+    eng = make_engine(lanes=4,
+                      **({"out_dir": str(tmp_path / "res")} if tmp_path
+                         else {}), **kw)
+    ids = []
+    for i in range(8):
+        ids.append(eng.submit(
+            HeatConfig(n=16, ntime=16 + 8 * (i % 2), dtype="float64"),
+            tenant=("acme", "zeta")[i % 2],
+            slo_class=("interactive", "batch")[i % 2],
+            deadline_ms=60_000.0))
+    # one unservable request: rejected records carry zero usage stamps
+    ids.append(eng.submit(HeatConfig(n=16, ntime=4, bc="periodic")))
+    records = eng.results()
+    return eng, [r for r in records if r["id"] in ids]
+
+
+def test_usage_ledger_reconciles_exactly_with_record_stamps(tmp_path):
+    """Acceptance: /v1/usage totals == the sum of the per-request
+    terminal-record usage stamps for a drained run — ints exactly,
+    lane-seconds to float-summation noise."""
+    eng, records = drain_mixed_wave(tmp_path)
+    assert all("usage" in r for r in records)
+    totals = eng.prof.ledger.snapshot()["totals"]
+    for field in ("steps", "chunks", "bytes_written"):
+        assert totals[field] == sum(int(r["usage"][field])
+                                    for r in records), field
+    assert totals["lane_s"] == pytest.approx(
+        sum(float(r["usage"]["lane_s"]) for r in records), abs=1e-6)
+    assert totals["requests"] == len(records)
+    # bytes_written is the real published file size
+    ok = [r for r in records if r["status"] == "ok"]
+    for r in ok:
+        assert r["usage"]["bytes_written"] == \
+            (tmp_path / "res" / f"{r['id']}.npz").stat().st_size
+    # the gateway payload is the same snapshot (socket-free contract)
+    from heat_tpu.serve.gateway import usage_payload
+
+    payload = usage_payload(eng)
+    assert payload["totals"] == totals
+    assert set(payload["tenants"]) == {"acme", "zeta", "default"}
+    assert payload["prof"] is True
+
+
+def test_usage_stamps_on_failed_and_preempted_requests():
+    """A quarantined lane's request bills the chunks it DID consume; a
+    request shed while queued bills zero."""
+    eng = make_engine(lanes=1, inject="lane-nan@8:req=bad")
+    eng.submit(HeatConfig(n=16, ntime=32, dtype="float64"),
+               request_id="bad")
+    records = eng.results()
+    (bad,) = [r for r in records if r["id"] == "bad"]
+    assert bad["status"] == "nonfinite"
+    assert bad["usage"]["chunks"] >= 1        # it ran before poisoning
+    assert bad["usage"]["bytes_written"] == 0  # nothing published
+    assert 0 < bad["usage"]["steps"] <= 32
+    cell = eng.prof.ledger.snapshot()["totals"]
+    assert cell["by_status"].get("nonfinite") == 1
+
+
+def test_in_memory_results_bill_field_bytes():
+    eng = make_engine()   # no out_dir: fields stay on the records
+    eng.submit(HeatConfig(n=16, ntime=8, dtype="float64"))
+    (rec,) = eng.results()
+    assert rec["usage"]["bytes_written"] == rec["T"].nbytes
+
+
+# --- (e) SLO burn-rate monitor -----------------------------------------------
+
+
+def test_burn_monitor_window_math_threshold_and_cooldown():
+    bm = prof_mod.BurnMonitor({"interactive": 0.9}, fast_window_s=10,
+                              slow_window_s=100, threshold=1.5,
+                              cooldown_s=50)
+    t = 1000.0
+    # 18 hits: burn 0, no alert
+    for i in range(18):
+        assert bm.note("interactive", True, t + i * 0.1) is None
+    snap = bm.snapshot(t + 2)["interactive"]
+    assert snap["fast_burn"] == 0.0 and snap["fast_hit_ratio"] == 1.0
+    # 2 misses inside both windows: miss_frac 2/20 = budget -> burn 1.0,
+    # still under threshold
+    assert bm.note("interactive", False, t + 2.0) is None
+    assert bm.note("interactive", False, t + 2.1) is None
+    snap = bm.snapshot(t + 2.2)["interactive"]
+    assert snap["fast_burn"] == pytest.approx(1.0)
+    # 3 more misses -> 5/23 ~ 2.17x budget: alert fires once...
+    alerts = [bm.note("interactive", False, t + 3 + i * 0.1)
+              for i in range(3)]
+    fired = [a for a in alerts if a is not None]
+    assert len(fired) == 1
+    a = fired[0]
+    assert a["fast_burn"] >= 1.5 and a["slow_burn"] >= 1.5
+    assert a["class"] == "interactive" and a["target"] == 0.9
+    # ...and the cooldown suppresses an immediate repeat, but not one
+    # after the cooldown elapses
+    assert bm.note("interactive", False, t + 4) is None
+    assert bm.note("interactive", False, t + 60) is not None
+    # fast window slid away: only the slow window remembers old misses
+    snap = bm.snapshot(t + 200)["interactive"]
+    assert snap["fast_events"] == 0 and snap["slow_events"] == 0
+
+
+def test_burn_monitor_ignores_undated_and_rejected():
+    eng = make_engine()
+    eng.submit(HeatConfig(n=16, ntime=8, dtype="float64"))   # undated
+    eng.submit(HeatConfig(n=16, ntime=8, bc="periodic"),     # rejected
+               deadline_ms=1000.0)
+    eng.results()
+    assert eng.summary()["slo_burn"] == {}
+
+
+def test_deadline_missing_wave_emits_slo_alert_record(capsys):
+    """A wave of dated requests all shed past deadline burns the class's
+    budget in both windows -> one structured slo_alert JSON record."""
+    eng = make_engine(slo_targets=(("standard", 0.5),),
+                      slo_burn_threshold=1.5)
+    for i in range(4):
+        eng.submit(HeatConfig(n=16, ntime=400, dtype="float64"),
+                   deadline_ms=0.01)   # missed before any lane starts
+    records = eng.results()
+    assert all(r["status"] == "deadline" for r in records)
+    out = capsys.readouterr().out
+    alert_lines = [json.loads(l) for l in out.splitlines()
+                   if l.startswith("{") and '"slo_alert"' in l]
+    assert alert_lines, out
+    a = alert_lines[0]
+    assert a["class"] == "standard" and a["fast_burn"] >= 1.5
+    burn = eng.summary()["slo_burn"]["standard"]
+    assert burn["alerts"] >= 1 and burn["fast_hit_ratio"] == 0.0
+
+
+# --- (c) memory watermarks ---------------------------------------------------
+
+
+def test_mem_watermark_leak_sentinel_unit():
+    mw = prof_mod.MemWatermark(window=4, min_growth_bytes=100)
+    # plateau: never fires
+    for i in range(8):
+        assert mw.note(1000, float(i)) is None
+    # monotone growth past the floor: fires once...
+    warn = None
+    for i in range(4):
+        warn = mw.note(2000 + 200 * i, 10.0 + i) or warn
+    assert warn is not None
+    assert warn["growth_bytes"] >= 100 and warn["source"] == "device"
+    assert warn["slope_bytes_per_s"] > 0
+    # ...and stays quiet until usage doubles again
+    assert mw.note(2700, 15.0) is None
+    warn2 = None
+    for i in range(6):
+        warn2 = mw.note(6000 + 300 * i, 20.0 + i) or warn2
+    assert warn2 is not None
+    assert mw.snapshot()["warnings"] == 2
+    assert mw.snapshot()["peak_bytes"] == 6000 + 300 * 5
+
+
+def test_device_memory_bytes_returns_int_on_cpu():
+    nbytes, source = prof_mod.device_memory_bytes()
+    assert isinstance(nbytes, int) and nbytes >= 0
+    assert source in ("device", "live_arrays")
+
+
+def test_leaky_device_emits_mem_watermark_record(capsys, monkeypatch):
+    """A device whose memory grows monotonically across the sampling
+    window produces one structured mem_watermark record mid-drain."""
+    grow = {"n": 0}
+
+    def leaky():
+        grow["n"] += 1
+        return (100 << 20) + grow["n"] * (8 << 20), "device"
+
+    monkeypatch.setattr(prof_mod, "device_memory_bytes", leaky)
+    eng = make_engine(lanes=1, mem_poll_every=1)
+    eng.submit(HeatConfig(n=16, ntime=16 * prof_mod.MEM_WINDOW,
+                          dtype="float64"))
+    eng.results()
+    out = capsys.readouterr().out
+    warns = [json.loads(l) for l in out.splitlines()
+             if l.startswith("{") and '"mem_watermark"' in l]
+    assert warns and warns[0]["growth_bytes"] >= prof_mod.MEM_MIN_GROWTH_BYTES
+    assert eng.prof.mem.snapshot()["warnings"] >= 1
+    assert eng.timing.mem_peak_bytes == eng.prof.mem.snapshot()["peak_bytes"]
+    assert any("observatory: mem peak" in l
+               for l in eng.timing.report_lines())
+
+
+# --- (b) compile observatory -------------------------------------------------
+
+
+def test_compile_log_first_vs_warm_attribution():
+    log = prof_mod.compile_log()
+    before = log.summary()["programs"]
+    eng = make_engine()
+    eng.submit(HeatConfig(n=16, ntime=8, dtype="float64"))
+    eng.results()
+    mid = log.summary()
+    assert mid["programs"] == before + 1
+    ev = log.snapshot()[-1]
+    assert ev["k"] == 8 and ev["seconds"] > 0
+    assert ev["label"] == "lanes 2d n16 float64 edges L1"
+    # a second engine compiles the same program again: warm re-compile
+    eng2 = make_engine()
+    eng2.submit(HeatConfig(n=16, ntime=8, dtype="float64"))
+    eng2.results()
+    after = log.summary()
+    assert after["programs"] == before + 2
+    assert log.snapshot()[-1]["first"] is False
+    assert after["warm_s"] > 0
+
+
+def test_compile_span_lands_on_trace_timeline():
+    eng = make_engine()
+    eng.submit(HeatConfig(n=16, ntime=8, dtype="float64"))
+    eng.results()
+    evs = eng.tracer.to_chrome()["traceEvents"]
+    spans = [e for e in evs if e.get("cat") == "compile"
+             and e.get("ph") == "X"]
+    assert spans and spans[0]["name"] == "compile k=8"
+    assert spans[0]["dur"] > 0
+
+
+# --- --prof off (the A/B baseline) -------------------------------------------
+
+
+def test_prof_off_disables_aggregation_but_keeps_usage_stamps(tmp_path):
+    eng, records = drain_mixed_wave(tmp_path, prof=False)
+    assert all("usage" in r for r in records)       # schema stable
+    ok = [r for r in records if r["status"] == "ok"]
+    assert ok and all(r["usage"]["steps"] > 0 for r in ok)
+    s = eng.summary()
+    assert s["prof"] is False
+    assert s["cost_model"] == [] and s["slo_burn"] == {}
+    assert s["mem"]["samples"] == 0
+    assert eng.prof.ledger.snapshot()["totals"]["requests"] == 0
+    assert eng.timing.mem_peak_bytes is None
+
+
+def test_prof_on_off_bit_identical_results():
+    fields = {}
+    for prof in (True, False):
+        eng = make_engine(prof=prof)
+        rid = eng.submit(HeatConfig(n=16, ntime=24, dtype="float64"))
+        (rec,) = [r for r in eng.results() if r["id"] == rid]
+        fields[prof] = rec["T"]
+    np.testing.assert_array_equal(fields[True], fields[False])
+
+
+# --- flight-recorder record (satellite) --------------------------------------
+
+
+def test_flight_dump_emits_structured_flightrec_record(tmp_path, capsys):
+    eng = make_engine(flight_dir=str(tmp_path))
+    eng.submit(HeatConfig(n=16, ntime=8, dtype="float64"))
+    eng.results()
+    eng._flight_dump("test trigger")
+    out = capsys.readouterr().out
+    recs = [json.loads(l) for l in out.splitlines()
+            if l.startswith("{") and '"flightrec"' in l]
+    assert recs, out
+    r = recs[0]
+    assert r["reason"] == "test trigger" and r["dump"] == 1
+    assert r["path"].startswith(str(tmp_path))
+    assert (tmp_path / r["path"].rsplit("/", 1)[1]).exists()
+    assert eng.tracer.dump_paths == [r["path"]]
+    # the /metrics counter reports it
+    from heat_tpu.serve.gateway import render_metrics
+
+    assert "heat_tpu_flightrec_dumps_total 1" in render_metrics(eng)
+
+
+# --- /metrics + /statusz surfaces (socket-free) ------------------------------
+
+
+def test_metrics_export_cost_usage_burn_mem_series(tmp_path):
+    eng, _ = drain_mixed_wave(tmp_path, mem_poll_every=1)
+    from heat_tpu.serve.gateway import render_metrics
+
+    text = render_metrics(eng)
+    assert ('heat_tpu_serve_cost_s_per_lane_step{bucket='
+            '"2d/n16/float64/edges"') in text
+    assert 'heat_tpu_usage_lane_seconds_total{tenant="acme"' in text
+    assert ('heat_tpu_usage_steps_total{tenant="zeta",class="batch"}'
+            in text)
+    assert ('heat_tpu_slo_burn_rate{class="interactive",window="fast"}'
+            in text)
+    assert "heat_tpu_mem_peak_bytes" in text
+    assert "heat_tpu_flightrec_dumps_total 0" in text
+    # every sample line is parseable: name{labels} value
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert len(line.rsplit(" ", 1)) == 2, line
+        float(line.rsplit(" ", 1)[1])
+
+
+def test_statusz_renders_all_sections(tmp_path):
+    eng, _ = drain_mixed_wave(tmp_path, mem_poll_every=1)
+    from heat_tpu.serve.gateway import render_statusz
+
+    text = render_statusz(eng)
+    for needle in ("cost model", "compile observatory",
+                   "memory watermarks", "slo burn", "usage ledger",
+                   "2d/n16/float64/edges", "acme"):
+        assert needle in text, needle
+
+
+# --- config / ServeConfig grammar --------------------------------------------
+
+
+def test_parse_slo_targets_grammar():
+    assert parse_slo_targets("") == ()
+    assert parse_slo_targets("interactive=0.999,batch=0.8") == \
+        (("interactive", 0.999), ("batch", 0.8))
+    for bad in ("nope=0.5", "interactive", "interactive=x",
+                "interactive=1.0", "interactive=0"):
+        with pytest.raises(ValueError):
+            parse_slo_targets(bad)
+
+
+def test_parse_on_off_grammar():
+    assert parse_on_off("on", "--prof") is True
+    assert parse_on_off("off", "--prof") is False
+    with pytest.raises(ValueError):
+        parse_on_off("maybe", "--prof")
+
+
+def test_serve_config_validates_observatory_knobs():
+    with pytest.raises(ValueError):
+        ServeConfig(slo_targets=(("standard", 1.5),))
+    with pytest.raises(ValueError):
+        ServeConfig(slo_targets=(("bogus-class", 0.9),))
+    with pytest.raises(ValueError):
+        ServeConfig(slo_burn_threshold=0)
+    with pytest.raises(ValueError):
+        ServeConfig(mem_poll_every=-1)
+    with pytest.raises(ValueError):
+        ServeConfig(slo_fast_window_s=0)
+
+
+# --- histogram re-export (policy.py moved to prof.py) ------------------------
+
+
+def test_policy_histogram_reexport_is_prof_histogram():
+    from heat_tpu.serve import policy as policy_mod
+
+    assert policy_mod.Histogram is prof_mod.Histogram
+    assert policy_mod.LATENCY_BUCKETS is prof_mod.LATENCY_BUCKETS
+    h = policy_mod.Histogram(prof_mod.LANE_STEP_BUCKETS)
+    h.observe(1e-6)
+    assert h.quantile(0.5) == 1e-6
+    over = prof_mod.Histogram((1.0,))
+    over.observe(5.0)          # beyond the top bucket -> +Inf estimate
+    assert math.isinf(over.quantile(0.5))
+
+
+# --- CLI: heat-tpu usage / heat-tpu perfcheck --------------------------------
+
+
+def test_cli_usage_renders_table_from_records_file(tmp_path, capsys):
+    from heat_tpu.cli import main
+
+    eng, records = drain_mixed_wave(tmp_path)
+    log = tmp_path / "records.log"
+    log.write_text("prologue line\n" + "\n".join(
+        json.dumps({"event": "serve_request", **{k: v for k, v in r.items()
+                                                 if k != "T"}})
+        for r in records))
+    assert main(["usage", str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "acme" in out and "zeta" in out and "TOTAL" in out
+    # --json round-trips the ledger snapshot and reconciles
+    assert main(["usage", str(log), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["totals"]["steps"] == sum(
+        r["usage"]["steps"] for r in records)
+
+
+def test_cli_usage_errors_on_missing_or_empty_source(tmp_path, capsys):
+    from heat_tpu.cli import main
+
+    assert main(["usage", str(tmp_path / "nope.log")]) == 2
+    empty = tmp_path / "empty.log"
+    empty.write_text("no records here\n")
+    assert main(["usage", str(empty)]) == 2
+
+
+def test_cli_perfcheck_no_fresh_validates_committed_artifacts(capsys):
+    from heat_tpu.cli import main
+
+    rc = main(["perfcheck", "--no-fresh"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "baseline overhead gate" in out
+    assert "perfcheck: OK" in out
+    assert "calibration cross-check" in out
+
+
+def test_cli_perfcheck_fails_on_violated_baseline(tmp_path, capsys):
+    from heat_tpu.cli import main
+
+    bad = {"on_within_2pct_of_off": False, "on_overhead_frac": 0.5,
+           "bit_identical_depth0": True, "bit_identical_depth2": True,
+           "usage_reconciles": True, "platform": "cpu",
+           "on": {"points_per_s": 1.0}, "cost_model": []}
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    rc = main(["perfcheck", "--no-fresh", "--baseline", str(p)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FAIL baseline overhead gate" in out
+
+
+def test_prof_overhead_lab_harness_smoke(tmp_path):
+    """The committed lab's harness runs end-to-end on a tiny population
+    (argv-injectable main, same pattern as serve_lab's smoke)."""
+    import importlib.util
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    bdir = _Path(__file__).resolve().parent.parent / "benchmarks"
+    for name, fname in (("_util", "_util.py"),
+                        ("serve_lab", "serve_lab.py"),
+                        ("prof_overhead_lab", "prof_overhead_lab.py")):
+        if name not in _sys.modules:
+            spec = importlib.util.spec_from_file_location(
+                name, bdir / fname)
+            mod = importlib.util.module_from_spec(spec)
+            _sys.modules[name] = mod
+            spec.loader.exec_module(mod)
+    lab = _sys.modules["prof_overhead_lab"]
+    out = tmp_path / "lab.json"
+    rc = lab.main(["--requests", "6", "--bit-requests", "4",
+                   "--lanes", "2", "--repeats", "1",
+                   "--out", str(out)])
+    rec = json.loads(out.read_text())
+    assert rec["bit_identical_depth0"] and rec["bit_identical_depth2"]
+    assert rec["usage_reconciles"] is True
+    assert rec["cost_model"] and rec["mem"]["samples"] > 0
+    assert rc in (0, 1)   # the 2% wall gate may jitter at this tiny size
