@@ -9,7 +9,7 @@ SHELL := /bin/bash
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
-        perfcheck native run viz clean
+        lane-lab perfcheck native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -96,6 +96,12 @@ prof-lab:              # observatory-overhead A/B: full cost-model/ledger/
                        # watermark/burn-rate metering vs off (<= 2% gate,
                        # npz bit-identity at depths 0 and 2)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/prof_overhead_lab.py
+
+lane-lab:              # serve lane-kernel A/B: Pallas lane program vs XLA
+                       # lane program vs solo Pallas drives (bit-identity
+                       # hard gate; perf gate on TPU, informational on CPU)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_lane_kernel_lab.py
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/lane_kernel_compile_check.py
 
 perfcheck:             # CI perf gate: fresh prof-lab vs committed baseline
                        # (tolerance band) + every committed lab's internal

@@ -105,6 +105,20 @@ phase serve_lab        1200 env JAX_PLATFORMS=cpu python benchmarks/serve_lab.py
 # within 10% of the clean run and a healthy sample stays bit-identical.
 # CPU-world: runs with the tunnel down.
 phase serve_chaos_lab  1200 env JAX_PLATFORMS=cpu python benchmarks/serve_chaos_lab.py
+# Serve lane-kernel A/B (ISSUE 9): the serve_lab shape/step population
+# at float32 under --serve-lane-kernel pallas vs xla vs solo Pallas
+# drives. Hard gates everywhere: pallas-vs-xla npz byte-identity, a
+# solo-oracle sample, zero lane_kernel_fallback events. The perf gate
+# (Pallas lane program beats the XLA lane program per chip, targeting
+# ROADMAP's ~90%-of-solo-Pallas bar) is hard on TPU, informational on
+# CPU (interpret-mode kernels). CPU-world: runs with the tunnel down.
+phase serve_lane_kernel_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_lane_kernel_lab.py
+# Mosaic compile check for the lane kernels (ISSUE 9): AOT-compile the
+# exact serve chunk programs (both kernels' donation modes, 2D/3D,
+# f32/bf16) against a single v5e chip via the chipless topology path —
+# interpret-mode tier-1 cannot catch Mosaic-only rejections (SMEM block
+# rules, missing lowerings, sub-32-bit selects); this can.
+phase lane_kernel_compile_check 1200 env JAX_PLATFORMS=cpu python benchmarks/lane_kernel_compile_check.py
 # Serving front-end A/B (ISSUE 6): open-loop Poisson arrivals into the
 # ONLINE engine under --policy edf vs fifo (same seeded schedule, real
 # backlog at 3x the measured service rate) — EDF must meet >= FIFO's

@@ -1,0 +1,105 @@
+"""Do the multi-lane serve kernels compile under REAL Mosaic? (chipless)
+
+CPU tier-1 exercises the lane kernel family only in Pallas interpret
+mode, which accepts several things the real compiler rejects — this
+repo's round of ISSUE-9 hardening hit three: blocked sub-array SMEM
+outputs (Mosaic wants full-array SMEM blocks), ``is_finite`` (no Mosaic
+lowering — spelled ``|x| < inf``), and sub-32-bit selects / misaligned
+shrinking-slice rotates (bf16 ``where`` and the solo 3D kernel's
+shrinking shapes both die). This check AOT-compiles the EXACT serve
+chunk programs (``serve.engine.make_lane_advance(kernel="pallas")`` —
+grid over lanes, SMEM per-lane scalars, fused countdown gate + health
+reduction, both donation modes) against a single v5e chip through
+``jax.experimental.topologies`` + ``force_compiled_kernels`` (the
+Mosaic compiler ships with libtpu; no attached device needed), so a
+kernel regression that only a real TPU would catch fails HERE, in a
+CPU-world lab.
+
+Writes benchmarks/lane_kernel_compile_check.json; nonzero exit if any
+variant fails to compile.
+
+    python benchmarks/lane_kernel_compile_check.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+from _util import write_atomic
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# the serve-relevant matrix: default-bucket 2D at both lane dtypes, the
+# rollback (donate=False) variant, a tail-sized program, and 3D (which
+# chunks into multiple Mosaic passes)
+VARIANTS = (
+    ("2d_f32_ghost_L8_k16", 2, 256, "float32", "ghost", 8, 16, True),
+    ("2d_bf16_edges_L8_k16", 2, 256, "bfloat16", "edges", 8, 16, True),
+    ("2d_f32_edges_L8_k4_rollback", 2, 48, "float32", "edges", 8, 4, False),
+    ("3d_f32_ghost_L4_k16", 3, 64, "float32", "ghost", 4, 16, True),
+)
+
+
+def main(argv=None) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # chipless by construction
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import SingleDeviceSharding
+
+    from heat_tpu.backends.guard_probe import topology_spec
+    from heat_tpu.ops.pallas_stencil import (force_compiled_kernels,
+                                             lane_state_shape)
+    from heat_tpu.ops.stencil import accum_dtype_for
+    from heat_tpu.serve.engine import BucketKey, make_lane_advance
+    from heat_tpu.utils import jnp_dtype
+
+    out = Path(argv[0]) if argv else (Path(__file__).parent
+                                      / "lane_kernel_compile_check.json")
+    name, kwargs = topology_spec("v5e", 1)
+    topo = topologies.get_topology_desc(name, "tpu", **kwargs)
+    sh = SingleDeviceSharding(topo.devices[0])
+    rec = {"ts": time.time(), "topology": name, "variants": {}}
+    ok = True
+    with force_compiled_kernels():
+        for tag, ndim, bucket, dtype, bc, lanes, chunk, donate in VARIANTS:
+            key = BucketKey(ndim, bucket, dtype, bc)
+            slab = lane_state_shape(ndim, bucket, dtype)
+            dt = jnp_dtype(dtype)
+            acc = accum_dtype_for(dt)
+            structs = (
+                jax.ShapeDtypeStruct((lanes,) + slab, dt, sharding=sh),
+                jax.ShapeDtypeStruct((lanes,), acc, sharding=sh),
+                jax.ShapeDtypeStruct((lanes,), jnp.int32, sharding=sh),
+                jax.ShapeDtypeStruct((lanes,), jnp.int32, sharding=sh),
+            )
+            adv = make_lane_advance(key, kernel="pallas", donate=donate)
+            t0 = time.perf_counter()
+            try:
+                txt = adv.lower(*structs, chunk).compile().as_text()
+                row = {"compiles": True,
+                       "compile_s": round(time.perf_counter() - t0, 3),
+                       "mosaic_calls": txt.count("tpu_custom_call")}
+            except Exception as e:  # noqa: BLE001 — recorded verdict
+                ok = False
+                row = {"compiles": False,
+                       "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            rec["variants"][tag] = row
+            print(f"{tag:32s} "
+                  + (f"OK {row['compile_s']:.1f}s "
+                     f"({row['mosaic_calls']} mosaic call(s))"
+                     if row["compiles"] else f"FAILED {row['error']}"),
+                  flush=True)
+    rec["all_compile"] = ok
+    write_atomic(out, rec)
+    print(json.dumps({"all_compile": ok, "out": str(out)}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

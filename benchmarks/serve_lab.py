@@ -43,7 +43,7 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 
-def build_requests(count: int):
+def build_requests(count: int, dtype: str = "float64"):
     """Mixed-size request population: three grid sides, two diffusivities,
     varying step counts — the mix forces two buckets and mid-flight
     admissions without leaving the 'small request' regime. This is the
@@ -51,7 +51,13 @@ def build_requests(count: int):
     aggregate-speedup numbers compare release to release. (Step counts
     are chunk multiples, so the tail-chunk path stays cold here — on a
     one-core CPU host a tail compile costs ~100 ms to save ~ms of masked
-    compute; tests/test_serve.py exercises tails directly.)"""
+    compute; tests/test_serve.py exercises tails directly.)
+
+    ``dtype`` keeps the population shared across labs: this lab's
+    committed artifact stays f64, while serve_lane_kernel_lab.py runs the
+    SAME shape/step mix at float32 (the Pallas lane kernels have no f64
+    — no f64 on the TPU VPU — and a fallback-only A/B would measure
+    nothing)."""
     from heat_tpu.config import HeatConfig
 
     sides = (24, 32, 48)
@@ -59,7 +65,7 @@ def build_requests(count: int):
     for i in range(count):
         n = sides[i % len(sides)]
         reqs.append(HeatConfig(
-            n=n, ntime=96 + 16 * (i % 3), dtype="float64", bc="edges",
+            n=n, ntime=96 + 16 * (i % 3), dtype=dtype, bc="edges",
             ic=("hat", "hat_small")[i % 2], nu=(0.05, 0.1)[(i // 3) % 2]))
     return reqs
 

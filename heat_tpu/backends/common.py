@@ -77,7 +77,8 @@ def chunk_sizes(cfg: HeatConfig, remaining: int) -> list[int]:
     return sorted(sizes)
 
 
-def aot_compile_chunks(advance, example, sizes, compiled=None, label=None):
+def aot_compile_chunks(advance, example, sizes, compiled=None, label=None,
+                       kernel=None):
     """AOT-compile ``advance(example..., k)`` for every chunk size ``k``
     in ``sizes`` not already covered; returns ``(compiled, seconds)``.
 
@@ -99,6 +100,11 @@ def aot_compile_chunks(advance, example, sizes, compiled=None, label=None):
     steps + isfinite bits — its leaves are donated selectively, which a
     single pytree argument cannot express); a tuple is splatted into
     ``lower``.
+
+    ``kernel`` names the stepping body when one label can cover several
+    (the serve lane programs compile both the XLA oracle and the Pallas
+    lane kernels for the same bucket/tier — the compile log must tell
+    them apart, or a Pallas-vs-XLA A/B reads as one warm cache key).
     """
     from ..runtime import prof
 
@@ -108,6 +114,8 @@ def aot_compile_chunks(advance, example, sizes, compiled=None, label=None):
         shape = getattr(args[0], "shape", ())
         dtype = getattr(args[0], "dtype", "?")
         label = f"chunk {tuple(shape)} {dtype}"
+    if kernel is not None:
+        label = f"{label} [{kernel}]"
     t0 = time.perf_counter()
     for k in sizes:
         if k not in compiled:

@@ -203,6 +203,18 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--out-dir", metavar="DIR",
                        help="write each result as DIR/<id>.npz (atomic "
                             "publish); default: results stay in memory")
+    serve.add_argument("--serve-lane-kernel", dest="serve_lane_kernel",
+                       choices=["auto", "pallas", "xla"], default="auto",
+                       help="chunk-program body per bucket: 'auto' "
+                            "(default) = the multi-lane Pallas kernels "
+                            "on TPU wherever the bucket has a kernel "
+                            "plan, the vmapped XLA stencil elsewhere; "
+                            "'pallas'/'xla' force it. Both produce "
+                            "bit-identical results (XLA is the oracle); "
+                            "an unavailable Pallas bucket (f64, or a 3D "
+                            "bucket no VMEM band fits) degrades to XLA "
+                            "as a structured lane_kernel_fallback "
+                            "record + counter, never an error")
     serve.add_argument("--serve-on-nan", dest="serve_on_nan",
                        choices=["fail", "rollback"], default="fail",
                        help="per-lane non-finite response (every chunk "
@@ -584,7 +596,11 @@ def _serve_report(summary, ok: int, args) -> None:
                  f"{summary['compile_s']:.3f}s compiling)")
     master_print(f"dispatch: depth {summary['dispatch_depth']}, "
                  f"policy {summary['policy']}, "
-                 f"{summary['chunks_dispatched']} chunk(s) "
+                 f"lane kernel {summary.get('lane_kernel', 'auto')}"
+                 + (f" ({summary['lane_kernel_fallbacks']} bucket tier(s) "
+                    f"fell back to XLA)"
+                    if summary.get("lane_kernel_fallbacks") else "")
+                 + f", {summary['chunks_dispatched']} chunk(s) "
                  f"({summary['tail_chunks']} tail), "
                  f"{summary['boundary_waits']} boundary wait(s) totaling "
                  f"{summary['boundary_wait_s']:.3f}s, "
@@ -604,7 +620,8 @@ def _serve_report(summary, ok: int, args) -> None:
         tops = sorted(cm, key=lambda e: -e["wall_s"])[:3]
         more = f" (+{len(cm) - 3} more)" if len(cm) > 3 else ""
         master_print("cost model: " + "; ".join(
-            f"{e['bucket']} xL{e['lanes']} d{e['depth']}: "
+            f"{e['bucket']} xL{e['lanes']} d{e['depth']} "
+            f"[{e.get('kernel', 'xla')}]: "
             f"{e['ewma_s_per_lane_step'] or 0:.3e} s/lane-step "
             f"({e['chunks']} chunks)" for e in tops) + more)
     mem = summary.get("mem") or {}
@@ -654,6 +671,7 @@ def cmd_serve(args) -> int:
                            dispatch_depth=parse_dispatch_depth(
                                args.dispatch_depth),
                            on_nan=args.serve_on_nan,
+                           lane_kernel=args.serve_lane_kernel,
                            deadline_ms=args.serve_deadline,
                            max_queue=args.max_queue,
                            fetch_timeout_s=(args.fetch_watchdog
@@ -867,7 +885,13 @@ def cmd_perfcheck(args) -> int:
               ("healthy_within_10pct", lambda v: v is True),
               ("all_poisoned_quarantined", lambda v: v is True))),
             ("serve_frontend_lab.json",
-             (("edf_vs_fifo_hit_rate_delta", lambda v: (v or -1) >= 0),))):
+             (("edf_vs_fifo_hit_rate_delta", lambda v: (v or -1) >= 0),)),
+            ("serve_lane_kernel_lab.json",
+             (("bit_identical", lambda v: v is True),
+              ("solo_sample_identical", lambda v: v is True),
+              ("zero_fallbacks", lambda v: v is True))),
+            ("lane_kernel_compile_check.json",
+             (("all_compile", lambda v: v is True),))):
         p = bdir / fname
         if not p.exists():
             check(False, fname, "committed artifact missing")
@@ -908,6 +932,63 @@ def cmd_perfcheck(args) -> int:
             else:
                 check(False, "fresh-vs-baseline band",
                       "points_per_s missing from lab output")
+
+    # lane-kernel cost rows (ISSUE 9): the committed kernel A/B must be
+    # internally consistent — the cost model's kernel-keyed rows imply
+    # the same pallas/xla cost ratio the measured drain walls show, and
+    # on a TPU artifact the Pallas lane program must have won outright
+    lane_path = bdir / "serve_lane_kernel_lab.json"
+    if lane_path.exists():
+        lane = _json.loads(lane_path.read_text())
+
+        def _agg_s_per_lane_step(side: dict):
+            # work-weighted mean over the side's kernel-keyed cost rows
+            wall = steps = 0.0
+            for e in side.get("cost_model") or []:
+                m = e.get("mean_s_per_lane_step")
+                if m and e.get("wall_s"):
+                    wall += e["wall_s"]
+                    steps += e["wall_s"] / m
+            return wall / steps if steps else None
+
+        want = {"pallas": "pallas", "xla": "xla"}
+        keyed_ok = all(
+            {e.get("kernel") for e in
+             (lane.get(side) or {}).get("cost_model") or []} <= {kern}
+            for side, kern in want.items())
+        check(keyed_ok, "lane-kernel cost rows",
+              "each A/B side's cost-model rows carry its own kernel key")
+        agg_p = _agg_s_per_lane_step(lane.get("pallas") or {})
+        agg_x = _agg_s_per_lane_step(lane.get("xla") or {})
+        wall_p = ((lane.get("pallas") or {}).get("wall_s", 0)
+                  - (lane.get("pallas") or {}).get("compile_s", 0))
+        wall_x = ((lane.get("xla") or {}).get("wall_s", 0)
+                  - (lane.get("xla") or {}).get("compile_s", 0))
+        if agg_p and agg_x and wall_p > 0 and wall_x > 0:
+            # sanity band, same spirit as the calibration cross-check's
+            # 0.25-4x: the kernel-keyed cost rows and the compile-
+            # excluded drain walls measure the same A/B through
+            # different lenses (chunk service vs end-to-end with host
+            # bookkeeping) — they may disagree by a dilution factor,
+            # but an order-of-magnitude split means one of them lies
+            ratio = (agg_p / agg_x) / (wall_p / wall_x)
+            check(0.25 <= ratio <= 4.0,
+                  "lane-kernel cost band",
+                  f"cost-model pallas/xla ratio vs compile-excluded "
+                  f"wall ratio within 4x (consistency {ratio:.3f})")
+        else:
+            check(False, "lane-kernel cost band",
+                  "cost-model rows or walls missing from the artifact")
+        if str(lane.get("platform")) == "tpu":
+            check(lane.get("pallas_beats_xla") is True,
+                  "lane-kernel TPU gate",
+                  f"pallas_vs_xla={lane.get('pallas_vs_xla')} (must beat "
+                  f"the XLA lane program per chip on TPU)")
+        else:
+            check(True, "lane-kernel perf (informational, platform="
+                  f"{lane.get('platform')})",
+                  f"pallas_vs_xla={lane.get('pallas_vs_xla')}, "
+                  f"pallas_vs_solo={lane.get('pallas_vs_solo')}")
 
     # cost model vs the static calibration fit
     cal_path = bdir / "calibration_v5e.json"
@@ -1332,6 +1413,21 @@ def cmd_info(_args) -> int:
           f"off = sync fallback), {_sd.lanes} lanes (power-of-two tiers), "
           f"chunk {_sd.chunk} (+{tail_size(_sd.chunk)}-step tail program, "
           f"compiled on first use), buckets {','.join(map(str, _sd.buckets))}")
+    # serve lane-kernel defaults/availability: which chunk-program body
+    # each default bucket would get under --serve-lane-kernel auto on
+    # THIS host (the static half; per-run fallbacks print per serve)
+    from .ops.pallas_stencil import lane_kernel_available
+
+    _on_tpu = jax.default_backend() == "tpu"
+    _plans = ", ".join(
+        f"{b}:{'ok' if lane_kernel_available(2, b, 'float32') else 'none'}"
+        for b in _sd.buckets)
+    print(f"serve lane-kernel: {_sd.lane_kernel} (--serve-lane-kernel "
+          f"auto|pallas|xla; auto = Pallas on TPU where the bucket has a "
+          f"kernel plan, XLA elsewhere) — this host: "
+          f"{'TPU, auto resolves Pallas per plan' if _on_tpu else 'no TPU, auto resolves XLA'}; "
+          f"2D f32 lane plans {_plans}; f64 always XLA (no VPU f64); "
+          f"unavailable buckets degrade loudly (lane_kernel_fallback)")
     print(f"serve fault domains: on-nan={_sd.on_nan} (--serve-on-nan "
           f"rollback = per-lane restore-and-re-step, 2 retries), "
           f"deadline={'none' if _sd.deadline_ms is None else _sd.deadline_ms} "

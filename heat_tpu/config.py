@@ -31,6 +31,15 @@ _ON_NAN = ("abort", "rollback")
 _EXCHANGES = ("seq", "indep", "overlap")
 _LOCAL_KERNELS = ("auto", "xla", "pallas")
 
+# --serve-lane-kernel grammar (serve/scheduler.py ServeConfig.lane_kernel):
+# the serving engine's chunk-program body per bucket. "auto" = the Pallas
+# multi-lane kernels on TPU wherever the bucket has a kernel plan, the
+# vmapped XLA stencil elsewhere; "pallas"/"xla" force it (an unavailable
+# Pallas bucket under "pallas" degrades to XLA as a structured
+# lane_kernel_fallback record + counter, never an error — the XLA lane
+# program is the bit-exactness oracle either way).
+LANE_KERNELS = ("auto", "pallas", "xla")
+
 
 @dataclasses.dataclass(frozen=True)
 class HeatConfig:

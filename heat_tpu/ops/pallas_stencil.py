@@ -868,7 +868,406 @@ def ftcs_multistep_ghost_pallas(T: jax.Array, r: float, bc_value, ksteps: int) -
     return out
 
 
+# --------------------------------------------------------------------------
+# multi-lane serving kernels: the lane axis as a grid dimension
+#
+# The serving engine (serve/engine.py) steps up to L independent requests
+# as one stacked (L, B+2, ...) array. Its reference chunk program is a
+# masked *vmapped XLA* stencil; the kernels below are the Pallas port: the
+# lane axis becomes grid dimension 0 over the existing 2D halo-slab / 3D
+# 3x3-banded plans, and ONE kernel fuses (a) the per-lane interior mask
+# (cells outside [lo, n-1-lo] of the per-lane request side n, SMEM-
+# resident like bounds_ref), (b) the per-lane countdown gating (a lane
+# whose remaining count ran out keeps its field, step-granular), and
+# (c) the per-lane isfinite health reduction — so lane health costs zero
+# extra passes over the stack instead of a separate post-chunk sweep.
+#
+# Bit-identity with the XLA lane program is a hard contract (the XLA path
+# stays the serving oracle): every mini-step replicates the exact
+# arithmetic of serve/engine._lane_step — laplacian summed in
+# ops.stencil.laplacian_interior's left-to-right order, update applied by
+# SELECT (jnp.where), not the solo kernels' multiply-mask (0 * NaN would
+# leak a blowing-up lane's NaN into its frozen ring where the oracle
+# keeps old values), and the result rounded to the storage dtype EVERY
+# mini-step (the fori_loop rounds per step; the solo kernels' round-once
+# bf16 mode would diverge). Per-lane frozen bounds in buffer coords:
+# cells <= lo or >= n+1-lo freeze, lo = 0 (ghost) or 1 (edges) — the
+# margin ring, the unused bucket corner, and the kernel's alignment
+# padding all land outside, so garbage there is never read by live cells
+# (reads reach one cell per mini-step; live cells sit >= 1 cell inside).
+# --------------------------------------------------------------------------
+
+
+# fixed per-pass fusion depth of the 2D lane kernel: matches the serve
+# default --chunk 16 (one pass per chunk), stays within every dtype's
+# _halo_2d alignment, and — unlike the solo planner's shape-dependent
+# chunk — keeps the padded STATE layout independent of the engine's
+# chunk knob (tail programs reuse the steady layout with fewer steps).
+_LANE_KP_2D = 16
+
+
+@functools.lru_cache(maxsize=None)
+def _plan_lane_2d(bucket_n: int, dtype_str: str):
+    """Geometry for the multi-lane thin-band kernel over (L, m, m) lane
+    slabs, m = bucket side + 2 margin: (m_pad, n_pad, tile, kpad, kp), or
+    None when no row tile fits the band budget. The row tile is chosen to
+    minimize the padded slab height (alignment rows are computed-then-
+    frozen waste), tie-breaking toward fewer, larger tiles."""
+    m = bucket_n + 2
+    n_pad = _round_up(max(m, 128), 128)
+    kp = _LANE_KP_2D
+    kpad = _halo_2d(kp, dtype_str)
+    budget = _chip().band_budget_bytes
+    best = None
+    t = kpad
+    tmax = max(_round_up(m, kpad), kpad)
+    while t <= tmax:
+        if (t + 2 * kpad) * n_pad * 4 <= budget:
+            m_pad = _round_up(max(m, t), t)
+            cand = (m_pad, -t)
+            if best is None or cand < best[0]:
+                best = (cand, t, m_pad)
+        t += kpad
+    if best is None:
+        return None
+    _, tile, m_pad = best
+    return m_pad, n_pad, tile, kpad, kp
+
+
+@functools.lru_cache(maxsize=None)
+def _plan_lane_3d(bucket_n: int, dtype_str: str):
+    """3D lane geometry: the solo (row, mid)-tiled 3x3 plan for one lane
+    slab — ((m_pad, mid_pad, n_pad), R, M, kchunk, km), or None when no
+    band fits VMEM (the caller falls back to the XLA lane program)."""
+    m = bucket_n + 2
+    p = _plan_3d((m, m, m), dtype_str, _KMAX_3D)
+    if p is None:
+        return None
+    (m_pad, mid_pad, n_pad), R, M, k = p
+    return (m_pad, mid_pad, n_pad), R, M, k, _round_up(k, _sublane(dtype_str))
+
+
+def lane_state_shape(ndim: int, bucket_n: int, dtype_str: str):
+    """Per-lane padded slab shape the lane kernels step in place, or None
+    when this (ndim, bucket, dtype) has no kernel plan (f64 — no TPU VPU
+    f64 — or a 3D lane extent no band fits VMEM for). The serving engine
+    keeps its stacked state in THIS layout for the whole engine lifetime
+    (requests load into the [0 : B+2] corner; alignment padding is frozen
+    by the per-lane bounds and never read by a live cell), so chunk
+    dispatch pays zero per-call pad/crop."""
+    if jnp.dtype(dtype_str) == jnp.float64:
+        return None
+    if ndim == 2:
+        p = _plan_lane_2d(bucket_n, dtype_str)
+        return None if p is None else (p[0], p[1])
+    if ndim == 3:
+        p = _plan_lane_3d(bucket_n, dtype_str)
+        return None if p is None else p[0]
+    return None
+
+
+def lane_kernel_available(ndim: int, bucket_n: int, dtype_str: str) -> bool:
+    """Can the Pallas lane kernels serve this bucket? (The serve knob's
+    ``auto`` gate; explicit ``pallas`` on an unavailable bucket is a
+    structured fallback, never an error — serve/engine.py.)"""
+    return lane_state_shape(ndim, bucket_n, dtype_str) is not None
+
+
+def _lane_finite_accumulate(fin_ref, lane, first_any, out_tile,
+                            lanes: int):
+    """Fuse the per-lane health verdict into the stencil pass: AND this
+    program's output-tile isfinite verdict into its lane's slot of the
+    ONE (1, L) SMEM bit vector every grid instance revisits (block index
+    constant, so the block stays resident for the whole grid; Mosaic
+    requires SMEM output blocks to span the full array). The very first
+    grid instance initializes all L bits; each instance then ANDs via a
+    dynamic per-lane SMEM store. bf16 upcasts for the reduction
+    (finiteness is preserved exactly). Spelled ``|x| < inf`` rather than
+    ``jnp.isfinite`` — false for NaN (any compare with NaN is false) and
+    for both infinities — because Mosaic has no ``is_finite`` lowering."""
+    ok = (jnp.abs(out_tile.astype(jnp.float32))
+          < jnp.float32(float("inf"))).all().astype(jnp.int32)
+
+    @pl.when(first_any)
+    def _():
+        for idx in range(lanes):  # static unroll: L scalar SMEM stores
+            fin_ref[0, idx] = jnp.int32(1)
+
+    fin_ref[0, lane] = jnp.minimum(fin_ref[0, lane], ok)
+
+
+def _make_lane_kernel_2d(bc_lo: int, tile: int, kpad: int, n_pad: int,
+                         ksteps: int, offset: int, lanes: int):
+    """Multi-lane thin-band body: one (lane, row-tile) program instance.
+    ``offset`` is the pass's global step index within the chunk — the
+    countdown gate compares against the chunk-start ``remaining``."""
+    rows = tile + 2 * kpad
+
+    def kernel(r_ref, n_ref, rem_ref, prev_ref, cur_ref, next_ref,
+               out_ref, fin_ref):
+        lane = pl.program_id(0)
+        i = pl.program_id(1)
+        store_dt = out_ref.dtype
+        acc_dt = accum_dtype_for(store_dt)
+        # the band WORKS in the accumulation dtype but holds exactly
+        # storage-rounded values: each update is rounded through the
+        # storage dtype (the oracle's per-step rounding) and selected in
+        # 32 bits (Mosaic has no sub-32-bit select); the final downcast
+        # is then exact, so bf16 results stay byte-identical to XLA
+        band = jnp.concatenate(
+            [prev_ref[:], cur_ref[:], next_ref[:]], axis=1)[0].astype(acc_dt)
+        n_l = n_ref[0, lane]
+        rem_l = rem_ref[0, lane]
+        r_l = r_ref[0, lane].astype(acc_dt)
+        grow = i * tile - kpad + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, n_pad), 0)
+        gcol = jax.lax.broadcasted_iota(jnp.int32, (rows, n_pad), 1)
+        hi = n_l + 1 - bc_lo
+        live = ((grow > bc_lo) & (grow < hi)
+                & (gcol > bc_lo) & (gcol < hi))
+        for s in range(ksteps):  # static unroll
+            # XLA-lane-program order: +1 neighbors in axis order, then -1
+            # neighbors, then the center term (laplacian_interior)
+            p0 = pltpu.roll(band, rows - 1, 0)
+            p1 = pltpu.roll(band, n_pad - 1, 1)
+            m0 = pltpu.roll(band, 1, 0)
+            m1 = pltpu.roll(band, 1, 1)
+            lap = p0 + p1 + m0 + m1 + (-4.0) * band
+            upd = (band + r_l * lap).astype(store_dt).astype(acc_dt)
+            keep = jnp.logical_and(live, offset + s < rem_l)
+            band = jnp.where(keep, upd, band)
+        out = band[kpad: kpad + tile].astype(store_dt)
+        out_ref[:] = out.reshape(1, tile, n_pad)
+        _lane_finite_accumulate(
+            fin_ref, lane, jnp.logical_and(lane == 0, i == 0), out, lanes)
+
+    return kernel
+
+
+def _lane_pallas_2d(fields: jax.Array, r, n, rem, bc_lo: int, ksteps: int,
+                    offset: int, plan):
+    """One fused pass of <= kpad mini-steps over every lane (grid =
+    (L, row-tiles)). Traced inside the serving engine's jitted advance —
+    no jit of its own."""
+    m_pad, n_pad, tile, kpad, _ = plan
+    L = fields.shape[0]
+    assert fields.shape == (L, m_pad, n_pad), (fields.shape, plan)
+    assert 1 <= ksteps <= kpad and tile % kpad == 0
+    grid = (L, m_pad // tile)
+    ratio = tile // kpad
+    nhblk = m_pad // kpad
+    smem = pl.BlockSpec((1, L), lambda l, i: (0, 0),
+                        memory_space=pltpu.SMEM)
+    halo = lambda imap: pl.BlockSpec((1, kpad, n_pad), imap,
+                                     memory_space=pltpu.VMEM)
+    main = lambda imap: pl.BlockSpec((1, tile, n_pad), imap,
+                                     memory_space=pltpu.VMEM)
+    band = tile + 2 * kpad
+    out, fin = pl.pallas_call(
+        _make_lane_kernel_2d(bc_lo, tile, kpad, n_pad, ksteps, offset, L),
+        out_shape=(jax.ShapeDtypeStruct(fields.shape, fields.dtype),
+                   jax.ShapeDtypeStruct((1, L), jnp.int32)),
+        grid=grid,
+        in_specs=[
+            smem, smem, smem,
+            halo(lambda l, i: (l, jnp.maximum(i * ratio - 1, 0), 0)),
+            main(lambda l, i: (l, i, 0)),
+            halo(lambda l, i: (l, jnp.minimum((i + 1) * ratio, nhblk - 1),
+                               0)),
+        ],
+        out_specs=(main(lambda l, i: (l, i, 0)),
+                   pl.BlockSpec((1, L), lambda l, i: (0, 0),
+                                memory_space=pltpu.SMEM)),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_chip().vmem_limit_bytes,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=11 * band * n_pad * L * grid[1] * ksteps,
+            bytes_accessed=(2 * m_pad + 2 * kpad * grid[1]) * n_pad * L
+            * fields.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=_interpret(),
+    )(jnp.asarray(r).reshape(1, L),
+      jnp.asarray(n, jnp.int32).reshape(1, L),
+      jnp.asarray(rem, jnp.int32).reshape(1, L),
+      fields, fields, fields)
+    return out, fin[0]
+
+
+def _lane_grid_specs_3x3(R: int, M: int, ki: int, kj: int, nblocks,
+                         n_pad: int):
+    """The 3x3 halo-neighborhood BlockSpecs with a leading LANE block dim:
+    maps take (l, i, j) and clamp within lane l's own slab."""
+    ri, rj = R // ki, M // kj
+    ni, nj = nblocks
+
+    def icl(i):
+        return jnp.clip(i, 0, ni - 1)
+
+    def jcl(j):
+        return jnp.clip(j, 0, nj - 1)
+
+    def bs(bi, bj, imap):
+        return pl.BlockSpec((1, bi, bj, n_pad), imap,
+                            memory_space=pltpu.VMEM)
+
+    specs = [
+        bs(ki, kj, lambda l, i, j: (l, icl(i * ri - 1), jcl(j * rj - 1), 0)),
+        bs(ki, M, lambda l, i, j: (l, icl(i * ri - 1), j, 0)),
+        bs(ki, kj, lambda l, i, j: (l, icl(i * ri - 1), jcl((j + 1) * rj), 0)),
+        bs(R, kj, lambda l, i, j: (l, i, jcl(j * rj - 1), 0)),
+        bs(R, M, lambda l, i, j: (l, i, j, 0)),
+        bs(R, kj, lambda l, i, j: (l, i, jcl((j + 1) * rj), 0)),
+        bs(ki, kj, lambda l, i, j: (l, icl((i + 1) * ri), jcl(j * rj - 1), 0)),
+        bs(ki, M, lambda l, i, j: (l, icl((i + 1) * ri), j, 0)),
+        bs(ki, kj, lambda l, i, j: (l, icl((i + 1) * ri), jcl((j + 1) * rj), 0)),
+    ]
+    return specs, bs(R, M, lambda l, i, j: (l, i, j, 0))
+
+
+def _make_lane_kernel_3d(bc_lo: int, R: int, M: int, kp: int, km: int,
+                         n_pad: int, ksteps: int, offset: int,
+                         lanes: int):
+    """Multi-lane (row, mid)-tiled 3D body. Unlike the solo 3D kernel's
+    shrinking slices, every mini-step runs full-band wrap rotates on ALL
+    three axes with a select-kept update — the col-tiled 2D kernel's
+    proven-on-Mosaic shape discipline (shrinking 3D slices hand Mosaic
+    sublane-misaligned rotate shapes, rejected outright by current
+    compilers). Band-edge wrap corruption travels one cell per mini-step
+    and ksteps <= kp <= km, so it never reaches the out tile — the same
+    invariant as every other kernel in this file. Select-kept, per-lane
+    bounded/gated, storage-rounded each step (the oracle contract)."""
+    rows = R + 2 * kp
+    mids = M + 2 * km
+
+    def kernel(r_ref, n_ref, rem_ref, *refs):
+        out_ref, fin_ref = refs[-2], refs[-1]
+        lane = pl.program_id(0)
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+        store_dt = out_ref.dtype
+        acc_dt = accum_dtype_for(store_dt)
+        rows_g = [jnp.concatenate([refs[3 * g][:], refs[3 * g + 1][:],
+                                   refs[3 * g + 2][:]], axis=2)
+                  for g in range(3)]
+        # band works in the accumulation dtype, holding exactly storage-
+        # rounded values (see the 2D kernel: 32-bit select + exact final
+        # downcast keep bf16 byte-identical to the oracle)
+        band = jnp.concatenate(rows_g, axis=1)[0].astype(acc_dt)
+        n_l = n_ref[0, lane]
+        rem_l = rem_ref[0, lane]
+        r_l = r_ref[0, lane].astype(acc_dt)
+        hi = n_l + 1 - bc_lo
+        bshape = (rows, mids, n_pad)
+        grow = i * R - kp + jax.lax.broadcasted_iota(jnp.int32, bshape, 0)
+        gmid = j * M - km + jax.lax.broadcasted_iota(jnp.int32, bshape, 1)
+        gcol = jax.lax.broadcasted_iota(jnp.int32, bshape, 2)
+        live = ((grow > bc_lo) & (grow < hi) & (gmid > bc_lo) & (gmid < hi)
+                & (gcol > bc_lo) & (gcol < hi))
+        for s in range(ksteps):  # static unroll, constant shapes
+            # XLA-lane-program order: +axis0 +axis1 +axis2, then -axis0
+            # -axis1 -axis2, then the center term (laplacian_interior)
+            p0 = pltpu.roll(band, rows - 1, 0)
+            p1 = pltpu.roll(band, mids - 1, 1)
+            p2 = pltpu.roll(band, n_pad - 1, 2)
+            m0 = pltpu.roll(band, 1, 0)
+            m1 = pltpu.roll(band, 1, 1)
+            m2 = pltpu.roll(band, 1, 2)
+            lap = p0 + p1 + p2 + m0 + m1 + m2 + (-6.0) * band
+            upd = (band + r_l * lap).astype(store_dt).astype(acc_dt)
+            keep = jnp.logical_and(live, offset + s < rem_l)
+            band = jnp.where(keep, upd, band)
+        out = jax.lax.slice(
+            band, (kp, km, 0), (kp + R, km + M, n_pad)).astype(store_dt)
+        out_ref[:] = out.reshape(1, R, M, n_pad)
+        first_any = jnp.logical_and(lane == 0,
+                                    jnp.logical_and(i == 0, j == 0))
+        _lane_finite_accumulate(fin_ref, lane, first_any, out, lanes)
+
+    return kernel
+
+
+def _lane_pallas_3d(fields: jax.Array, r, n, rem, bc_lo: int, ksteps: int,
+                    offset: int, plan):
+    """One fused pass of <= kchunk mini-steps over every lane (grid =
+    (L, row-tiles, mid-tiles))."""
+    (m_pad, mid_pad, n_pad), R, M, kp, km = plan
+    L = fields.shape[0]
+    assert fields.shape == (L, m_pad, mid_pad, n_pad), (fields.shape, plan)
+    assert 1 <= ksteps <= kp
+    grid = (L, m_pad // R, mid_pad // M)
+    smem = pl.BlockSpec((1, L), lambda l, i, j: (0, 0),
+                        memory_space=pltpu.SMEM)
+    in_specs, out_spec = _lane_grid_specs_3x3(
+        R, M, kp, km, (m_pad // kp, mid_pad // km), n_pad)
+    band = (R + 2 * kp) * (M + 2 * km)
+    out, fin = pl.pallas_call(
+        _make_lane_kernel_3d(bc_lo, R, M, kp, km, n_pad, ksteps, offset,
+                             L),
+        out_shape=(jax.ShapeDtypeStruct(fields.shape, fields.dtype),
+                   jax.ShapeDtypeStruct((1, L), jnp.int32)),
+        grid=grid,
+        in_specs=[smem, smem, smem] + in_specs,
+        out_specs=(out_spec,
+                   pl.BlockSpec((1, L), lambda l, i, j: (0, 0),
+                                memory_space=pltpu.SMEM)),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_chip().vmem_limit_bytes,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=13 * band * n_pad * L * grid[1] * grid[2] * ksteps,
+            bytes_accessed=(band + R * M) * n_pad * L * grid[1] * grid[2]
+            * fields.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=_interpret(),
+    )(jnp.asarray(r).reshape(1, L),
+      jnp.asarray(n, jnp.int32).reshape(1, L),
+      jnp.asarray(rem, jnp.int32).reshape(1, L),
+      *([fields] * 9))
+    return out, fin[0]
+
+
+def lane_multistep(fields: jax.Array, r, n, rem, ksteps: int, bc_lo: int,
+                   bucket_n: int):
+    """``ksteps`` masked, countdown-gated FTCS steps over a stacked lane
+    array via the multi-lane Pallas kernels, health reduction fused in.
+
+    ``fields`` is (L,) + ``lane_state_shape(...)`` (the engine keeps its
+    stack in the padded layout); ``r``/``n``/``rem`` are the per-lane
+    scalar vectors of the serving engine's chunk program. Returns
+    ``(fields, finite)`` — ``finite`` a per-lane bool, False iff that
+    lane's post-chunk slab holds a non-finite value. Gate callers on
+    ``lane_kernel_available``; chunks deeper than the per-pass fusion cap
+    run as multiple passes with the countdown gate offset so a lane still
+    stops at exactly its own step count."""
+    assert ksteps >= 1, ksteps
+    nd = fields.ndim - 1
+    dtype_str = str(fields.dtype)
+    if nd == 2:
+        plan = _plan_lane_2d(bucket_n, dtype_str)
+        step, kp = _lane_pallas_2d, plan[4]
+    else:
+        plan = _plan_lane_3d(bucket_n, dtype_str)
+        step, kp = _lane_pallas_3d, plan[3]
+    assert plan is not None, (
+        f"no lane kernel plan for {nd}d bucket {bucket_n} {dtype_str} "
+        f"(gate on lane_kernel_available before calling)")
+    fin = None
+    done = 0
+    while done < ksteps:
+        kpass = min(kp, ksteps - done)
+        fields, f = step(fields, r, n, rem, bc_lo=bc_lo, ksteps=kpass,
+                         offset=done, plan=plan)
+        fin = f if fin is None else jnp.minimum(fin, f)
+        done += kpass
+    return fields, fin.astype(bool)
+
+
 # the plan caches embed the chip's rates/caps in their values; a chip-model
 # override (tests, what-if planning) must flush them
 machine.register_cache(_plan_2d.cache_clear)
 machine.register_cache(_plan_3d.cache_clear)
+machine.register_cache(_plan_lane_2d.cache_clear)
+machine.register_cache(_plan_lane_3d.cache_clear)

@@ -165,27 +165,32 @@ class _CostEntry:
 
 
 class CostModel:
-    """Online per-(bucket, lane-tier, dispatch-depth) chunk-cost EWMA.
+    """Online per-(bucket, lane-tier, dispatch-depth, kernel) chunk-cost
+    EWMA.
 
-    ``observe(bucket, lanes, depth, k, wall_s)`` records one chunk
-    boundary's service time (``wall_s`` seconds for ``k`` steps of
+    ``observe(bucket, lanes, depth, k, wall_s, kernel=...)`` records one
+    chunk boundary's service time (``wall_s`` seconds for ``k`` steps of
     ``lanes`` lanes); the normalized unit is seconds per *lane-step* —
     the number a placement/autoscaling decision compares across buckets
     (cells/s for a bucket of side B falls out as ``B^ndim /
     s_per_lane_step``, the cross-check ``heat-tpu perfcheck`` runs
-    against calibration_v5e.json)."""
+    against calibration_v5e.json). ``kernel`` names the chunk-program
+    body ("xla" — the vmapped oracle — or "pallas", the multi-lane
+    kernel family): the two are different machines with different cost
+    curves, so one EWMA must never average across them (the live half
+    of the serve lane-kernel A/B, benchmarks/serve_lane_kernel_lab.py)."""
 
     def __init__(self, alpha: float = COST_EWMA_ALPHA):
         self.alpha = float(alpha)
-        self._entries: Dict[Tuple[str, int, int], _CostEntry] = {}
+        self._entries: Dict[Tuple[str, int, int, str], _CostEntry] = {}
         self._lock = threading.Lock()
 
     def observe(self, bucket: str, lanes: int, depth: int, k: int,
-                wall_s: float) -> None:
+                wall_s: float, kernel: str = "xla") -> None:
         if wall_s < 0 or k < 1 or lanes < 1:
             return
         per = wall_s / (k * lanes)
-        key = (bucket, lanes, depth)
+        key = (bucket, lanes, depth, kernel)
         with self._lock:
             e = self._entries.get(key)
             if e is None:
@@ -198,20 +203,21 @@ class CostModel:
             e.last = per
         e.hist.observe(per)   # histogram carries its own lock
 
-    def estimate_s_per_lane_step(self, bucket: str, lanes: int,
-                                 depth: int) -> Optional[float]:
+    def estimate_s_per_lane_step(self, bucket: str, lanes: int, depth: int,
+                                 kernel: str = "xla") -> Optional[float]:
         with self._lock:
-            e = self._entries.get((bucket, lanes, depth))
+            e = self._entries.get((bucket, lanes, depth, kernel))
             return None if e is None else e.ewma
 
     def estimate_request_s(self, bucket: str, lanes: int, depth: int,
-                           ntime: int) -> Optional[float]:
+                           ntime: int,
+                           kernel: str = "xla") -> Optional[float]:
         """Predicted wall for one request of ``ntime`` steps admitted to
         this (bucket, tier): its lane advances one step whenever the
         whole group does, and a group step costs ``lanes *
         s_per_lane_step`` — queue wait excluded (that is the admission
         policy's number, not the chunk program's)."""
-        per = self.estimate_s_per_lane_step(bucket, lanes, depth)
+        per = self.estimate_s_per_lane_step(bucket, lanes, depth, kernel)
         return None if per is None else per * lanes * ntime
 
     def snapshot(self) -> List[dict]:
@@ -220,10 +226,11 @@ class CostModel:
         with self._lock:
             items = list(self._entries.items())
         out = []
-        for (bucket, lanes, depth), e in sorted(items):
+        for (bucket, lanes, depth, kernel), e in sorted(items):
             mean = e.wall_s / e.lane_steps if e.lane_steps else None
             out.append({
                 "bucket": bucket, "lanes": lanes, "depth": depth,
+                "kernel": kernel,
                 "chunks": e.count,
                 "ewma_s_per_lane_step": e.ewma,
                 "mean_s_per_lane_step": mean,
@@ -620,9 +627,10 @@ class Observatory:
 
     # -- feeds (scheduler side) --------------------------------------------
     def observe_chunk(self, bucket: str, lanes: int, depth: int, k: int,
-                      wall_s: float) -> None:
+                      wall_s: float, kernel: str = "xla") -> None:
         if self.enabled:
-            self.cost.observe(bucket, lanes, depth, k, wall_s)
+            self.cost.observe(bucket, lanes, depth, k, wall_s,
+                              kernel=kernel)
 
     def note_terminal(self, snap: dict, now: float) -> Optional[dict]:
         """Feed one terminal record snapshot (ledger + burn windows);

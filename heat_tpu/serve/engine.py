@@ -68,6 +68,23 @@ reads the fields; it never writes them, so bit-identity is untouched).
 (``runtime/async_io.bounded_call``): a wedged device fetch becomes a
 clean ``BoundedFetchTimeout`` the scheduler turns into per-request
 failures instead of a hung ``heat-tpu serve``.
+
+Pallas-native lane stepping (the ISSUE-9 rework): the chunk program has
+two interchangeable bodies — the vmapped masked XLA stencil above (the
+bit-exactness ORACLE) and the multi-lane Pallas kernel family
+(``ops/pallas_stencil.lane_multistep``): the lane axis becomes a grid
+dimension over the solo hand-tuned halo-slab/3x3 plans, with the
+per-lane interior mask, the per-lane countdown gate, AND the per-lane
+``isfinite`` health reduction fused into the stencil pass itself — lane
+health costs zero extra sweeps over the stack. ``resolve_lane_kernel``
+maps the ``--serve-lane-kernel auto|pallas|xla`` knob to a backend per
+bucket (auto = Pallas on TPU where a kernel plan exists); an
+unavailable Pallas program degrades to XLA as a structured
+``lane_kernel_fallback`` record + counter, never an error. Rollback
+mode additionally drops donation (``donate=False``) so the undonated
+input stack of each chunk IS the previous boundary's snapshot — the
+old per-chunk full-stack copy program is gone from the dispatch path
+entirely (``snapshot_stack``).
 """
 
 from __future__ import annotations
@@ -180,27 +197,67 @@ def _lane_step(T, r, n, lo: int):
     return T.at[ctr].set(jnp.where(mask, upd, T[ctr]))
 
 
-def make_lane_advance(key: BucketKey):
+def make_lane_advance(key: BucketKey, kernel: str = "xla",
+                      donate: bool = True):
     """The jitted chunk program for one bucket: ``advance(fields, r, n,
     remaining, k)`` runs ``k`` masked steps over every lane and returns
     the new state plus the ``(2, L)`` boundary vector — per-lane
     remaining steps stacked with per-lane ``isfinite`` bits, the one
     array a chunk boundary needs to fetch to judge both progress AND
-    health of every lane. Only the field stack is donated (the buffer
-    that matters — it ping-pongs like the solo drive loop's double
-    buffer); the per-lane scalars and the boundary vector are left
-    undonated on purpose, so a boundary handle taken after chunk ``i``
-    survives while chunks ``i+1..`` are dispatched behind it — the
-    foundation of the dispatch-ahead boundary (scheduler.py)."""
+    health of every lane.
+
+    ``kernel`` picks the stepping body: ``"xla"`` — the vmapped masked
+    stencil under ``lax.fori_loop`` (the serving ORACLE: every other
+    backend must match it byte for byte); ``"pallas"`` — the multi-lane
+    Pallas kernel family (``ops/pallas_stencil.lane_multistep``: lane
+    axis as a grid dimension over the solo halo-slab/3x3 plans, per-lane
+    interior mask + countdown gate + isfinite reduction fused into the
+    stencil pass, so lane health costs zero extra sweeps). Both bodies
+    produce the same remaining-count algebra (``max(rem - k, 0)``) and
+    bit-identical fields — gate ``"pallas"`` on ``resolve_lane_kernel``.
+
+    ``donate=True`` donates only the field stack (the buffer that
+    matters — it ping-pongs like the solo drive loop's double buffer);
+    the per-lane scalars and the boundary vector are left undonated on
+    purpose, so a boundary handle taken after chunk ``i`` survives while
+    chunks ``i+1..`` are dispatched behind it — the foundation of the
+    dispatch-ahead boundary (scheduler.py). ``donate=False`` is rollback
+    mode's contract: the undonated input stack IS the previous
+    boundary's snapshot, so keeping boundaries restorable costs no
+    standalone copy program on the dispatch path (see
+    ``LaneEngine.snapshot_stack``)."""
     import jax
     import jax.numpy as jnp
 
     lo = _BC_LO[key.bc]
+    ndim = key.ndim
+    donate_argnums = (0,) if donate else ()
+
+    if kernel == "pallas":
+        from ..ops.pallas_stencil import lane_multistep
+
+        bucket_n = key.n
+
+        @functools.partial(jax.jit, static_argnums=(4,),
+                           donate_argnums=donate_argnums)
+        def advance(fields, r, n, remaining, k: int):
+            # mask + countdown gate + health reduction all live INSIDE
+            # the kernel passes; remaining's update is the same O(L)
+            # algebra the fori_loop body produces step by step
+            fields, finite = lane_multistep(fields, r, n, remaining, k,
+                                            bc_lo=lo, bucket_n=bucket_n)
+            remaining = jnp.maximum(remaining - k, 0)
+            boundary = jnp.stack([remaining,
+                                  finite.astype(remaining.dtype)])
+            return fields, r, n, remaining, boundary
+
+        return advance
+
     step_all = jax.vmap(functools.partial(_lane_step, lo=lo),
                         in_axes=(0, 0, 0))
-    ndim = key.ndim
 
-    @functools.partial(jax.jit, static_argnums=(4,), donate_argnums=(0,))
+    @functools.partial(jax.jit, static_argnums=(4,),
+                       donate_argnums=donate_argnums)
     def advance(fields, r, n, remaining, k: int):
         def body(_, carry):
             f, rem = carry
@@ -220,21 +277,62 @@ def make_lane_advance(key: BucketKey):
     return advance
 
 
-def make_lane_loader(key: BucketKey):
+def make_lane_loader(key: BucketKey, donate: bool = True):
     """The jitted lane-swap program: replace lane ``lane`` (a TRACED scalar
     — one compile covers every lane index) with a new request's buffer and
-    scalars. The field stack is donated like ``advance``'s so swapping
-    never copies the other lanes; the scalar vectors are tiny and stay
-    undonated for the same handle-liveness reason."""
+    scalars. With ``donate=True`` the field stack is donated like
+    ``advance``'s so swapping never copies the other lanes; rollback-mode
+    engines pass ``donate=False`` because live boundary snapshots alias
+    the stack (donating it would invalidate them — admissions then pay
+    one stack copy, chunk dispatch still pays none). The scalar vectors
+    are tiny and stay undonated for the same handle-liveness reason."""
     import jax
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    donate_argnums = (0,) if donate else ()
+
+    @functools.partial(jax.jit, donate_argnums=donate_argnums)
     def load(fields, r, n, remaining, lane, buf, r_new, n_new, steps_new):
         fields = jax.lax.dynamic_update_index_in_dim(fields, buf, lane, 0)
         return (fields, r.at[lane].set(r_new), n.at[lane].set(n_new),
                 remaining.at[lane].set(steps_new))
 
     return load
+
+
+def resolve_lane_kernel(requested: str, key: BucketKey):
+    """Resolve the ``--serve-lane-kernel`` knob for ONE bucket into the
+    backend a lane engine will actually run, plus a fallback reason when
+    the resolution is a degradation the operator should hear about.
+
+    Returns ``(kernel, reason)``: ``kernel`` in {"pallas", "xla"};
+    ``reason`` is None for a clean resolution and a human string when a
+    requested/expected Pallas program is unavailable — the scheduler
+    turns that into a structured ``lane_kernel_fallback`` record plus a
+    counter, never an error (the XLA lane program is the bit-exact
+    oracle; only throughput differs). Rules: ``"xla"`` — always XLA;
+    ``"pallas"`` — Pallas when a kernel plan exists for the bucket
+    (f64 has none: no TPU VPU f64; nor do 3D buckets whose band fits no
+    VMEM plan), loud XLA fallback otherwise; ``"auto"`` — Pallas on TPU
+    when a plan exists, XLA elsewhere (off-TPU the Pallas interpreter
+    loses to the fused XLA program — that is policy, not a fallback)."""
+    if requested == "xla" or key.bc not in _BC_LO:
+        return "xla", None
+    import jax
+
+    from ..ops.pallas_stencil import lane_kernel_available
+
+    avail = lane_kernel_available(key.ndim, key.n, key.dtype)
+    if not avail:
+        reason = ("float64 has no Pallas lane kernel (no f64 on the TPU "
+                  "VPU)" if key.dtype == "float64" else
+                  f"no VMEM-feasible lane band for a {key.ndim}d bucket "
+                  f"of side {key.n}")
+    if requested == "pallas":
+        return ("pallas", None) if avail else ("xla", reason)
+    # auto: Pallas exactly where it is the measured win — on TPU
+    if jax.default_backend() != "tpu":
+        return "xla", None
+    return ("pallas", None) if avail else ("xla", reason)
 
 
 class LaneEngine:
@@ -254,7 +352,8 @@ class LaneEngine:
 
     def __init__(self, key: BucketKey, lanes: int, chunk: int,
                  compiled_cache: Optional[Dict] = None,
-                 on_compile: Optional[Callable[[int, float], None]] = None):
+                 on_compile: Optional[Callable[[int, float], None]] = None,
+                 kernel: str = "xla", donate: bool = True):
         import jax.numpy as jnp
 
         if key.bc not in _BC_LO:
@@ -263,20 +362,43 @@ class LaneEngine:
                 f"wrap at the bucket edge); supported: {sorted(_BC_LO)}")
         if lanes < 1 or chunk < 1:
             raise ValueError(f"lanes/chunk must be >= 1, got {lanes}/{chunk}")
+        if kernel not in ("xla", "pallas"):
+            raise ValueError(f"kernel must be 'xla' or 'pallas' (resolve "
+                             f"'auto' via resolve_lane_kernel), got "
+                             f"{kernel!r}")
         self.key = key
         self.lanes = lanes
         self.chunk = chunk
+        self.kernel = kernel
+        self.donate = donate
         self.tail = tail_size(chunk)
+        if kernel == "pallas":
+            from ..ops.pallas_stencil import lane_state_shape
+
+            shape = lane_state_shape(key.ndim, key.n, key.dtype)
+            if shape is None:
+                raise ValueError(
+                    f"no Pallas lane kernel plan for bucket {key} — gate "
+                    f"construction on resolve_lane_kernel")
+            # the stack lives in the kernel's padded layout for the whole
+            # engine lifetime (alignment padding is frozen by the
+            # per-lane bounds and never read by a live cell), so chunk
+            # dispatch pays zero per-call pad/crop; the request still
+            # occupies the [1 : 1+n] corner, so extraction is unchanged
+            self._lane_shape = shape
+        else:
+            self._lane_shape = key.padded_shape
         dt = jnp_dtype(key.dtype)
         acc = accum_dtype_for(dt)
         self._state = (
-            jnp.zeros((lanes,) + key.padded_shape, dtype=dt),
+            jnp.zeros((lanes,) + self._lane_shape, dtype=dt),
             jnp.zeros((lanes,), dtype=acc),          # per-lane r
             jnp.ones((lanes,), dtype=jnp.int32),     # per-lane request side
             jnp.zeros((lanes,), dtype=jnp.int32),    # per-lane steps left
         )
-        self._load = make_lane_loader(key)
-        self._advance_fn = make_lane_advance(key)
+        self._load = make_lane_loader(key, donate=donate)
+        self._advance_fn = make_lane_advance(key, kernel=kernel,
+                                             donate=donate)
         self._cache = compiled_cache if compiled_cache is not None else {}
         self._on_compile = on_compile
         self.compile_s = 0.0
@@ -286,19 +408,22 @@ class LaneEngine:
 
     def _ensure(self, k: int):
         """Compiled executable for a k-step program, built at most once
-        per (bucket, lane-tier, k) across the scheduler's shared cache."""
-        ckey = (self.key, self.lanes, k)
+        per (bucket, lane-tier, k, kernel, donation mode) across the
+        scheduler's shared cache (rollback-mode programs donate nothing
+        and are distinct executables from the donating default)."""
+        ckey = (self.key, self.lanes, k, self.kernel, self.donate)
         if ckey not in self._cache:
             from ..backends.common import aot_compile_chunks
 
             # the compile-observatory key (runtime/prof.py): which lane
-            # program this was — bucket geometry x tier, steady vs tail
-            # k — so the structured compile log attributes lazy tail/tier
-            # compiles to the group that forced them
+            # program this was — bucket geometry x tier x kernel, steady
+            # vs tail k — so the structured compile log attributes lazy
+            # tail/tier compiles to the group that forced them
             compiled, spent = aot_compile_chunks(
                 self._advance_fn, self._state, [k],
                 label=(f"lanes {self.key.ndim}d n{self.key.n} "
-                       f"{self.key.dtype} {self.key.bc} L{self.lanes}"))
+                       f"{self.key.dtype} {self.key.bc} L{self.lanes}"),
+                kernel=self.kernel)
             self._cache[ckey] = compiled[k]
             self.compile_s += spent
             if self._on_compile is not None:
@@ -321,6 +446,13 @@ class LaneEngine:
         dt = jnp_dtype(self.key.dtype)
         acc = accum_dtype_for(dt)
         buf = lane_buffer(self.key, field, bc_value).astype(dt)
+        if buf.shape != self._lane_shape:
+            # pallas layout: embed the bucket buffer in the kernel-aligned
+            # slab corner; the zero alignment padding is frozen by the
+            # per-lane bounds (finite, never read by a live cell)
+            slab = np.zeros(self._lane_shape, dtype=dt)
+            slab[tuple(slice(0, s) for s in buf.shape)] = buf
+            buf = slab
         self._state = self._load(
             *self._state, np.int32(lane), buf,
             np.asarray(r, acc), np.int32(field.shape[0]),
@@ -411,11 +543,21 @@ class LaneEngine:
         self._state = (f.at[idx].set(jnp.nan), r, nn, rem)
 
     def snapshot_stack(self):
-        """On-device copy of the whole lane stack (``--serve-on-nan
-        rollback`` bookkeeping): taken right after a chunk dispatch, it
-        freezes that boundary's state while the live buffer keeps
-        ping-ponging through donation; a lane judged finite at that
-        boundary can later be restored from its row."""
+        """The post-chunk lane stack as a restorable boundary snapshot
+        (``--serve-on-nan rollback`` bookkeeping): a lane judged finite
+        at that boundary can later be restored from its row.
+
+        Rollback-mode engines are built ``donate=False``, so the live
+        stack handle taken here IS a stable snapshot — no later advance
+        or load consumes its buffer, and keeping every in-flight
+        boundary restorable dispatches NO standalone copy program (the
+        pre-rework shape paid one full-stack on-device copy per
+        dispatched chunk). At most one buffer stays live per in-flight
+        boundary: exactly the advance outputs the pipeline holds anyway.
+        A donating engine (where a scheduler never calls this on the
+        dispatch path) still gets the defensive on-device copy."""
+        if not self.donate:
+            return self._state[0]
         from ..runtime.async_io import device_snapshot
 
         return device_snapshot(self._state[0])

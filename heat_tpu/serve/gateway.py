@@ -185,17 +185,26 @@ def render_metrics(engine: Engine) -> str:
     cm = s.get("cost_model") or []
     metric("heat_tpu_serve_cost_s_per_lane_step", "gauge",
            "Online chunk-cost model: EWMA seconds per lane-step, per "
-           "(bucket, lane-tier, dispatch-depth). The live counterpart of "
-           "calibration_v5e.json (cross-check: heat-tpu perfcheck).",
+           "(bucket, lane-tier, dispatch-depth, kernel). The live "
+           "counterpart of calibration_v5e.json (cross-check: heat-tpu "
+           "perfcheck).",
            [([("bucket", e["bucket"]), ("lanes", e["lanes"]),
-              ("depth", e["depth"])], e["ewma_s_per_lane_step"])
+              ("depth", e["depth"]), ("kernel", e.get("kernel", "xla"))],
+             e["ewma_s_per_lane_step"])
             for e in cm if e["ewma_s_per_lane_step"] is not None]
            or [([], 0)])
     metric("heat_tpu_serve_cost_chunks_observed_total", "counter",
            "Chunk boundaries the cost model has learned from, per key.",
            [([("bucket", e["bucket"]), ("lanes", e["lanes"]),
-              ("depth", e["depth"])], e["chunks"]) for e in cm]
+              ("depth", e["depth"]), ("kernel", e.get("kernel", "xla"))],
+             e["chunks"]) for e in cm]
            or [([], 0)])
+    metric("heat_tpu_serve_lane_kernel_fallbacks_total", "counter",
+           "(bucket, lane-tier) groups that wanted the Pallas lane "
+           "program and degraded to the XLA oracle (--serve-lane-kernel; "
+           "structured lane_kernel_fallback records carry the reasons).",
+           [([("requested", s.get("lane_kernel", "auto"))],
+             s.get("lane_kernel_fallbacks", 0))])
     comp = prof_mod.compile_log().summary()
     metric("heat_tpu_compile_programs_total", "counter",
            "Chunk programs actually compiled by this process "
@@ -317,7 +326,9 @@ def render_statusz(engine: Engine) -> str:
         f"wait(s) {s['boundary_wait_s']:.3f}s, device idle "
         f"{s['device_idle_s']:.3f}s, {s['step_compiles']}+"
         f"{s['tail_compiles']} compiles {s['compile_s']:.2f}s, "
-        f"{s['lane_grows']} lane grow(s)")
+        f"{s['lane_grows']} lane grow(s), lane kernel "
+        f"{s.get('lane_kernel', 'auto')} "
+        f"({s.get('lane_kernel_fallbacks', 0)} fallback(s))")
     lines.append(
         f"faults: {s['lanes_quarantined']} quarantined, "
         f"{s['rollbacks']} rollback(s), {s['deadline_misses']} deadline "
@@ -331,7 +342,8 @@ def render_statusz(engine: Engine) -> str:
     for e in cm:
         ew = e["ewma_s_per_lane_step"]
         lines.append(
-            f"  {e['bucket']} xL{e['lanes']} depth{e['depth']}: "
+            f"  {e['bucket']} xL{e['lanes']} depth{e['depth']} "
+            f"[{e.get('kernel', 'xla')}]: "
             f"{'n/a' if ew is None else format(ew, '.3e')} s/lane-step "
             f"(p95 {e['p95_s_per_lane_step'] or 0:.0e}, "
             f"{e['chunks']} chunk(s), {e['wall_s']:.3f}s observed)")

@@ -72,6 +72,18 @@ The serving contract, in the shape of an inference server's scheduler:
   garbage-stepping at worst) so the host countdown mirror — and the
   desync cross-check — stay exact without an extra device program.
 
+- **Lane-kernel selection** (ISSUE 9): each bucket group resolves
+  ``ServeConfig.lane_kernel`` (``--serve-lane-kernel auto|pallas|xla``)
+  through ``engine.resolve_lane_kernel`` — the multi-lane Pallas kernels
+  where available (auto: on TPU), the vmapped XLA oracle elsewhere. A
+  requested-but-unavailable Pallas bucket degrades to XLA as a
+  per-(bucket, tier) structured ``lane_kernel_fallback`` record +
+  counter + /metrics gauge, never an error. Rollback mode additionally
+  builds its engines ``donate=False``: each dispatched chunk's
+  undonated input stack IS the previous boundary's snapshot, so
+  keeping boundaries restorable costs no standalone copy program on
+  the dispatch path.
+
 Per-request structured JSON records (queue wait, steps/s, lane id) go
 through ``runtime/logging``; each request also keeps a python-level record
 for library callers (``Engine.results()``). Records are mutated from both
@@ -89,15 +101,16 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..config import (DEFAULT_SLO_CLASS, DEFAULT_TENANT, SLO_TARGETS,
-                      HeatConfig, validate_slo_fields)
+from ..config import (DEFAULT_SLO_CLASS, DEFAULT_TENANT, LANE_KERNELS,
+                      SLO_TARGETS, HeatConfig, validate_slo_fields)
 from ..grid import initial_condition
 from ..runtime import async_io, faults
 from ..runtime import prof as prof_mod
 from ..runtime import trace as trace_mod
 from ..runtime.logging import json_record, master_print
 from . import policy as policy_mod
-from .engine import BucketKey, LaneEngine, lane_tier, wall_clock
+from .engine import (BucketKey, LaneEngine, lane_tier, resolve_lane_kernel,
+                     wall_clock)
 
 # Statuses a record can never leave: what poll()/wait() callers and the
 # gateway's streaming responses key on.
@@ -203,6 +216,17 @@ class ServeConfig:
                               # chunk boundaries between device-memory
                               # watermark samples (leak sentinel);
                               # 0 = never sample
+    lane_kernel: str = "auto"  # chunk-program body per bucket
+                              # (--serve-lane-kernel): "auto" = the
+                              # multi-lane Pallas kernels on TPU wherever
+                              # the bucket has a kernel plan, the vmapped
+                              # XLA stencil elsewhere; "pallas"/"xla"
+                              # force it. An unavailable Pallas bucket is
+                              # a per-(bucket, tier) structured
+                              # lane_kernel_fallback record + counter,
+                              # never an error; the XLA program stays the
+                              # bit-exactness oracle (engine.py
+                              # resolve_lane_kernel)
 
     def __post_init__(self):
         if self.lanes < 1:
@@ -260,6 +284,9 @@ class ServeConfig:
         if self.mem_poll_every < 0:
             raise ValueError(f"mem_poll_every must be >= 0 (0 = never "
                              f"sample), got {self.mem_poll_every}")
+        if self.lane_kernel not in LANE_KERNELS:
+            raise ValueError(f"lane_kernel must be one of {LANE_KERNELS}, "
+                             f"got {self.lane_kernel!r}")
         if self.inject:
             # fail at construction, not at a boundary mid-drain (same
             # parse-time contract as HeatConfig.inject)
@@ -340,9 +367,21 @@ class _GroupRunner:
         self.depth = max(1, scfg.dispatch_depth)
         self.rollback = scfg.on_nan == "rollback"
         self.lanes = lane_tier(min(len(q), scfg.lanes), scfg.lanes)
+        # per-bucket kernel resolution (--serve-lane-kernel): a requested
+        # Pallas program this bucket cannot run degrades loudly to the
+        # XLA oracle — structured record + counter, never an error.
+        # Rollback mode drops donation so in-flight boundary snapshots
+        # are plain references to the undonated input stacks (no per-
+        # chunk copy program on the dispatch path — engine.snapshot_stack)
+        self.kernel, self._kernel_fb = resolve_lane_kernel(
+            scfg.lane_kernel, key)
         self.eng = LaneEngine(key, self.lanes, scfg.chunk,
                               compiled_cache=outer._compiled,
-                              on_compile=outer._note_compile)
+                              on_compile=outer._note_compile,
+                              kernel=self.kernel,
+                              donate=not self.rollback)
+        if self._kernel_fb is not None:
+            outer._note_lane_fallback(key, self.lanes, self._kernel_fb)
         self.occupant: List[Optional[Request]] = [None] * self.lanes
         # first dispatch seq whose chunk covers the lane's CURRENT
         # occupant: an in-flight chunk older than the epoch shows the
@@ -697,7 +736,8 @@ class _GroupRunner:
                 base = (t_disp if self.last_fetch_t is None
                         else max(self.last_fetch_t, t_disp))
                 outer.prof.observe_chunk(self.cost_label, self.lanes,
-                                         self.depth, k, t_done - base)
+                                         self.depth, k, t_done - base,
+                                         kernel=self.kernel)
                 self.last_fetch_t = t_done
                 warn = outer.prof.maybe_sample_memory(t_done)
                 if warn is not None:
@@ -761,7 +801,13 @@ class _GroupRunner:
         self.lanes = want
         self.eng = LaneEngine(self.key, want, self.chunk,
                               compiled_cache=outer._compiled,
-                              on_compile=outer._note_compile)
+                              on_compile=outer._note_compile,
+                              kernel=self.kernel,
+                              donate=not self.rollback)
+        if self._kernel_fb is not None:
+            # the fallback contract is per (bucket, tier): the grown tier
+            # is a new compiled program that also fell back
+            outer._note_lane_fallback(self.key, want, self._kernel_fb)
         self.occupant = [None] * want
         self.epoch = [self.seq] * want
         self.dev_rem = np.zeros(want, dtype=np.int64)
@@ -822,7 +868,8 @@ class _GroupRunner:
                 # fenced boundary: the dispatch->fetch wall IS the chunk
                 # service time (cost-model key depth 0, the sync shape)
                 outer.prof.observe_chunk(self.cost_label, self.lanes, 0,
-                                         self.chunk, self.idle_from - t0)
+                                         self.chunk, self.idle_from - t0,
+                                         kernel=self.kernel)
                 warn = outer.prof.maybe_sample_memory(self.idle_from)
                 if warn is not None:
                     outer._mem_warn(warn)
@@ -929,6 +976,11 @@ class Engine:
         self.device_idle_s = 0.0     # est. device idle: per-group gaps with
                                      # nothing in flight at a boundary
         self.timing = None           # runtime.timing.Timing of the last run
+        # lane-kernel observability (ISSUE 9): how many (bucket, tier)
+        # groups wanted Pallas and got the XLA fallback (summary(),
+        # /metrics gauge heat_tpu_serve_lane_kernel_fallbacks_total)
+        self.lane_kernel_fallbacks = 0
+        self._lane_fb_seen: set = set()
         # per-lane fault-domain observability (ISSUE 5)
         self.lanes_quarantined = 0   # requests failed nonfinite
         self.rollbacks = 0           # per-lane restore-and-re-step events
@@ -1101,6 +1153,32 @@ class Engine:
                             "steps": int(steps_done), "chunks": int(chunks),
                             "bytes_written": 0}
         self._emit(rec)
+
+    def _note_lane_fallback(self, key: BucketKey, lanes: int,
+                            reason: str) -> None:
+        """One (bucket, tier) wanted the Pallas lane program and got the
+        XLA oracle instead: degrade LOUDLY — a human line, a structured
+        ``lane_kernel_fallback`` record, the summary counter, and the
+        /metrics gauge — but never an error (results are bit-identical
+        by the oracle contract; only throughput differs). Deduped per
+        (bucket, tier) so warm re-runs of the same group don't spam."""
+        bucket = f"{key.ndim}d/n{key.n}/{key.dtype}/{key.bc}"
+        with self._lock:
+            if (key, lanes) in self._lane_fb_seen:
+                return
+            self._lane_fb_seen.add((key, lanes))
+            self.lane_kernel_fallbacks += 1
+        master_print(
+            f"serve lane-kernel: bucket {bucket} tier {lanes} fell back "
+            f"to the XLA lane program ({reason}); results identical, "
+            f"throughput reduced — see TROUBLESHOOTING.md")
+        json_record("lane_kernel_fallback", bucket=bucket, lanes=lanes,
+                    requested=self.scfg.lane_kernel, reason=reason)
+        if self.tracer.enabled:
+            self.tracer.instant("lane-kernel-fallback",
+                                self.tracer.thread_track("scheduler"),
+                                args={"bucket": bucket, "lanes": lanes,
+                                      "reason": reason})
 
     def _mem_warn(self, warn: dict) -> None:
         """The leak sentinel fired (runtime/prof.py MemWatermark): one
@@ -1615,6 +1693,8 @@ class Engine:
                 "slo_burn": obs["slo_burn"],
                 "flightrec_dumps": self.tracer.dumps,
                 "policy": self.scfg.policy,
+                "lane_kernel": self.scfg.lane_kernel,
+                "lane_kernel_fallbacks": self.lane_kernel_fallbacks,
                 "queued_now": queued,
                 "lane_grows": self.lane_grows,
                 "step_compiles": self.step_compiles,
