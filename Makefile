@@ -9,7 +9,7 @@ SHELL := /bin/bash
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
-        lane-lab perfcheck native run viz clean
+        lane-lab mega-lab perfcheck native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -102,6 +102,12 @@ lane-lab:              # serve lane-kernel A/B: Pallas lane program vs XLA
                        # hard gate; perf gate on TPU, informational on CPU)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_lane_kernel_lab.py
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/lane_kernel_compile_check.py
+
+mega-lab:              # two-tier placement A/B (virtual 8-device mesh):
+                       # oversized requests served as sharded mega-lanes,
+                       # npz byte-identity vs solo sharded drive, packed
+                       # throughput within 10% with a mega-lane resident
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_mega_lab.py
 
 perfcheck:             # CI perf gate: fresh prof-lab vs committed baseline
                        # (tolerance band) + every committed lab's internal

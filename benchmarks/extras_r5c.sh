@@ -113,6 +113,14 @@ phase serve_chaos_lab  1200 env JAX_PLATFORMS=cpu python benchmarks/serve_chaos_
 # ROADMAP's ~90%-of-solo-Pallas bar) is hard on TPU, informational on
 # CPU (interpret-mode kernels). CPU-world: runs with the tunnel down.
 phase serve_lane_kernel_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_lane_kernel_lab.py
+# Two-tier placement A/B (ISSUE 10): the serve_lab small population plus
+# oversized requests on a virtual 8-device CPU mesh — previously-rejected
+# bucket-overflow requests must complete as sharded mega-lanes with zero
+# overflow rejections, npz payloads byte-identical to a solo sharded
+# drive(), and packed-lane aggregate throughput within 10% of a mega-free
+# drain (and of serve_lab.json) while a mega-lane is resident.
+# CPU-world: runs with the tunnel down.
+phase serve_mega_lab   1200 env JAX_PLATFORMS=cpu python benchmarks/serve_mega_lab.py
 # Mosaic compile check for the lane kernels (ISSUE 9): AOT-compile the
 # exact serve chunk programs (both kernels' donation modes, 2D/3D,
 # f32/bf16) against a single v5e chip via the chipless topology path —

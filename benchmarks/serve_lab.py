@@ -70,13 +70,29 @@ def build_requests(count: int, dtype: str = "float64"):
     return reqs
 
 
-def run_engine(reqs, lanes: int, chunk: int, depth: int):
+def build_oversized(dtype: str = "float64"):
+    """Two requests bigger than every bucket (ISSUE 10): on a
+    single-device host they must be REJECTED (bucket-overflow with the
+    mega hint — counted in this lab's ``rejected`` field, permanently
+    regression-locking the rejection path); on a multi-device mesh they
+    are served as sharded mega-lanes instead (the two-tier placement
+    path, measured in depth by benchmarks/serve_mega_lab.py). Side 96
+    divides evenly over every balanced mesh of 2/4/8 devices."""
+    from heat_tpu.config import HeatConfig
+
+    return [HeatConfig(n=96, ntime=32, dtype=dtype, bc="edges", ic="hat"),
+            HeatConfig(n=96, ntime=16, dtype=dtype, bc="ghost",
+                       ic="uniform")]
+
+
+def run_engine(reqs, lanes: int, chunk: int, depth: int, oversized=()):
     from heat_tpu.serve import Engine, ServeConfig
 
     eng = Engine(ServeConfig(lanes=lanes, chunk=chunk, buckets=(32, 48),
                              dispatch_depth=depth, emit_records=False))
     t0 = time.perf_counter()
     ids = [eng.submit(cfg) for cfg in reqs]
+    ids += [eng.submit(cfg) for cfg in oversized]
     records = eng.results()
     wall = time.perf_counter() - t0
     by_id = {r["id"]: r for r in records}
@@ -137,6 +153,10 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     reqs = build_requests(args.requests)
+    # two permanently-oversized requests (ISSUE 10): single-device hosts
+    # reject them (the count lands in the blocks' "rejected" field);
+    # multi-device hosts serve them as mega-lanes
+    big = build_oversized()
     work = sum(cfg.points * cfg.ntime for cfg in reqs)
     sample = sorted({0, len(reqs) // 2, len(reqs) - 1})
 
@@ -144,14 +164,20 @@ def main(argv=None) -> int:
     # sync fallback first so the pipelined run cannot inherit a warmer
     # process (each engine still owns its compiles — separate caches)
     off_wall, off_eng, off_recs = run_engine(reqs, args.lanes, args.chunk,
-                                             depth=0)
+                                             depth=0, oversized=big)
     eng_wall, eng, records = run_engine(reqs, args.lanes, args.chunk,
-                                        depth=args.depth)
+                                        depth=args.depth, oversized=big)
 
     engine_on = _engine_block(work, eng_wall, eng, records, sample,
                               seq_fields)
     engine_off = _engine_block(work, off_wall, off_eng, off_recs, sample,
                                seq_fields)
+    import jax
+
+    ndev = len(jax.devices())
+    mega_capable = ndev > 1
+    big_on = records[args.requests:]
+    big_off = off_recs[args.requests:]
     combos = {(r["bucket"],) for r in records if r["bucket"] is not None}
     speedup = seq_wall / eng_wall if eng_wall > 0 else None
     ab = off_wall / eng_wall if eng_wall > 0 else None
@@ -160,7 +186,19 @@ def main(argv=None) -> int:
         "config": {"requests": args.requests, "lanes": args.lanes,
                    "chunk": args.chunk, "dispatch_depth": args.depth,
                    "buckets": [32, 48], "sides": [24, 32, 48],
-                   "ntimes": [96, 112, 128], "dtype": "float64"},
+                   "ntimes": [96, 112, 128], "dtype": "float64",
+                   "oversized_sides": [c.n for c in big],
+                   "devices": ndev},
+        # the two-tier placement lock (ISSUE 10): oversized requests are
+        # rejected (with the --mega-lanes hint) on a single device and
+        # served as sharded mega-lanes on a mesh — either way, visibly
+        "oversized": {
+            "count": len(big),
+            "expected": "mega" if mega_capable else "rejected",
+            "statuses": sorted(r["status"] for r in big_on + big_off),
+            "hint_present": all("hint" in r for r in big_on + big_off
+                                if r["status"] == "rejected"),
+        },
         "work_cell_steps": work,
         "sequential": {"wall_s": round(seq_wall, 3),
                        "points_per_s": round(work / seq_wall, 1)},
@@ -176,10 +214,17 @@ def main(argv=None) -> int:
     }
     write_atomic(Path(args.out), rec)
     print(json.dumps(rec, indent=2))
-    passed = (engine_on["ok"] == args.requests
-              and engine_off["ok"] == args.requests
-              and engine_on["rejected"] == engine_on["failed"] == 0
-              and engine_off["rejected"] == engine_off["failed"] == 0
+    exp_ok = args.requests + (len(big) if mega_capable else 0)
+    exp_rej = 0 if mega_capable else len(big)
+    big_ok = (all(r["status"] == "ok" for r in big_on + big_off)
+              if mega_capable else
+              all(r["status"] == "rejected" and "hint" in r
+                  for r in big_on + big_off))
+    passed = (engine_on["ok"] == exp_ok
+              and engine_off["ok"] == exp_ok
+              and engine_on["rejected"] == engine_off["rejected"] == exp_rej
+              and engine_on["failed"] == engine_off["failed"] == 0
+              and big_ok
               and rec["bit_identical_sample"]
               and speedup is not None and speedup >= 3.0
               and ab is not None

@@ -1148,6 +1148,73 @@ def make_padded_carry_machinery(cfg: HeatConfig, mesh):
     return seed, advance, crop
 
 
+def make_mega_machinery(cfg: HeatConfig, mesh):
+    """(seed, advance, crop, kf): the padded-carry machinery wrapped in the
+    SERVE dispatch contract (serve/engine.py MegaLaneEngine) — one request
+    spanning the whole device mesh as a *mega-lane*.
+
+    ``advance(Tp, rem, k)`` runs ``k`` steps of the exact chunked body the
+    solo sharded ``drive()`` compiles (``divmod(k, kf)`` fused blocks of
+    the communication-avoiding ``padded_multi`` plus one remainder block —
+    owned-cell values are bit-identical under ANY chunk partition, the
+    same margin argument that makes fused exchanges bit-identical to
+    every-step exchanges) and returns ``(Tp', rem', boundary)``:
+
+    - ``Tp`` is donated (the solo drive's double-buffer ping-pong);
+    - ``rem`` is an undonated ``(1,)`` int32 countdown — ``rem' =
+      max(rem - k, 0)``, the same algebra the lane engine's per-lane
+      countdown produces, so the scheduler's host mirror predicts it;
+    - ``boundary`` is the ``(2, 1)`` int32 vector of [remaining;
+      isfinite] the serve scheduler's boundary fetch expects — the
+      finite bit reduced over OWNED cells only (each shard contributes
+      its interior verdict through the same shard_map program; the
+      garbage ghost margins between exchanges never vote), so mega-lane
+      health rides the boundary D2H exactly like a packed lane's.
+
+    ``seed``/``crop`` are the padded-carry entry/exit programs, returned
+    un-jit-called so the serve engine can AOT-compile them once per
+    (config, mesh) and reuse across admissions."""
+    axis_names = mesh.axis_names
+    axis_sizes = mesh.devices.shape
+    _, padded_multi = make_local_multistep(cfg, axis_names, axis_sizes)
+    kf = fuse_depth_sharded(cfg, axis_sizes)
+    bc_value = cfg.bc_value
+    nd = cfg.ndim
+    spec = P(*axis_names)
+    smap = functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
+                             check_vma=False)
+
+    seed = jax.jit(smap(lambda local: halo_pad(local, bc_value, kf),
+                        out_specs=spec))
+
+    @functools.partial(jax.jit, static_argnums=2, donate_argnums=0)
+    def advance(Tp, rem, k: int):
+        def body(padded):
+            n_fused, r_ = divmod(k, kf)
+            if n_fused:
+                padded = jax.lax.fori_loop(
+                    0, n_fused, lambda i, t: padded_multi(t, kf, kf), padded)
+            if r_:
+                padded = padded_multi(padded, kf, r_)
+            ctr = tuple(slice(kf, -kf) for _ in range(nd))
+            # per-shard owned-interior health bit: reading only (never
+            # writing) the stepped state, so bit-identity is untouched —
+            # the PR-5 lane-engine argument, one mesh wide
+            fin = jnp.isfinite(padded[ctr]).all().reshape((1,) * nd)
+            return padded, fin
+
+        Tp, fins = shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=(spec, spec), check_vma=False)(Tp)
+        rem2 = jnp.maximum(rem - k, 0)
+        finite = jnp.all(fins).astype(rem2.dtype).reshape((1,))
+        return Tp, rem2, jnp.stack([rem2, finite])
+
+    crop = jax.jit(smap(
+        lambda p: p[tuple(slice(kf, -kf) for _ in range(nd))],
+        out_specs=spec))
+    return seed, advance, crop, kf
+
+
 @register("sharded")
 def solve(cfg: HeatConfig, T0: Optional[np.ndarray] = None, mesh=None,
           fetch: bool = True, warm_exec: bool = False,

@@ -192,6 +192,18 @@ def build_parser() -> argparse.ArgumentParser:
                        help="comma-separated grid-side buckets; a request "
                             "is padded up to the smallest side that fits "
                             "(default 256,512,1024)")
+    serve.add_argument("--mega-lanes", dest="mega_lanes", default="auto",
+                       metavar="auto|N",
+                       help="second placement tier: requests whose side "
+                            "overflows every bucket run as sharded "
+                            "mega-lanes — ONE request spanning the whole "
+                            "device mesh (backends/sharded.py shard_map "
+                            "advance) co-scheduled with the packed lanes "
+                            "— instead of being rejected. N = concurrent "
+                            "mega-lane slots; 'auto' (default) = 1 on a "
+                            "multi-device host, 0 single-device; 0 "
+                            "restores the bucket-overflow rejection "
+                            "bit-identically")
     serve.add_argument("--dispatch-depth", default="on", metavar="on|off|N",
                        help="chunk programs kept in flight per bucket "
                             "group: the boundary D2H + bookkeeping of "
@@ -594,6 +606,12 @@ def _serve_report(summary, ok: int, args) -> None:
                  f"({summary['step_compiles']} stepping + "
                  f"{summary['tail_compiles']} tail compile(s), "
                  f"{summary['compile_s']:.3f}s compiling)")
+    pl = summary.get("placement") or {}
+    if pl.get("mega") or summary.get("mega_compiles"):
+        master_print(f"placement: {pl.get('packed', 0)} packed, "
+                     f"{pl.get('mega', 0)} mega (mesh-spanning sharded "
+                     f"lanes; {summary.get('mega_lanes', 0)} slot(s), "
+                     f"{summary.get('mega_compiles', 0)} mega compile(s))")
     master_print(f"dispatch: depth {summary['dispatch_depth']}, "
                  f"policy {summary['policy']}, "
                  f"lane kernel {summary.get('lane_kernel', 'auto')}"
@@ -621,7 +639,7 @@ def _serve_report(summary, ok: int, args) -> None:
         more = f" (+{len(cm) - 3} more)" if len(cm) > 3 else ""
         master_print("cost model: " + "; ".join(
             f"{e['bucket']} xL{e['lanes']} d{e['depth']} "
-            f"[{e.get('kernel', 'xla')}]: "
+            f"[{e.get('kernel', 'xla')}/{e.get('placement', 'packed')}]: "
             f"{e['ewma_s_per_lane_step'] or 0:.3e} s/lane-step "
             f"({e['chunks']} chunks)" for e in tops) + more)
     mem = summary.get("mem") or {}
@@ -648,7 +666,8 @@ def cmd_serve(args) -> int:
     same summary over everything it served.
     """
     from .config import parse_dispatch_depth, parse_listen, \
-        parse_on_off, parse_slo_targets, parse_tenant_weights
+        parse_mega_lanes, parse_on_off, parse_slo_targets, \
+        parse_tenant_weights
     from .serve import Engine, ServeConfig, serve_requests
 
     path = None
@@ -672,6 +691,7 @@ def cmd_serve(args) -> int:
                                args.dispatch_depth),
                            on_nan=args.serve_on_nan,
                            lane_kernel=args.serve_lane_kernel,
+                           mega_lanes=parse_mega_lanes(args.mega_lanes),
                            deadline_ms=args.serve_deadline,
                            max_queue=args.max_queue,
                            fetch_timeout_s=(args.fetch_watchdog
@@ -789,7 +809,8 @@ def cmd_usage(args) -> int:
             ledger.add(d.get("tenant") or "default",
                        d.get("class") or "standard",
                        d.get("status") or "?",
-                       d.get("usage") or empty_usage())
+                       d.get("usage") or empty_usage(),
+                       placement=d.get("placement"))
         if not found:
             print(f"error: no serve_request JSON records found in {src}",
                   file=sys.stderr)
@@ -891,7 +912,12 @@ def cmd_perfcheck(args) -> int:
               ("solo_sample_identical", lambda v: v is True),
               ("zero_fallbacks", lambda v: v is True))),
             ("lane_kernel_compile_check.json",
-             (("all_compile", lambda v: v is True),))):
+             (("all_compile", lambda v: v is True),)),
+            ("serve_mega_lab.json",
+             (("mega_bit_identical", lambda v: v is True),
+              ("zero_overflow_rejections", lambda v: v is True),
+              ("packed_within_10pct", lambda v: v is True),
+              ("packed_within_10pct_of_serve_lab", lambda v: v is True)))):
         p = bdir / fname
         if not p.exists():
             check(False, fname, "committed artifact missing")
@@ -1413,6 +1439,22 @@ def cmd_info(_args) -> int:
           f"off = sync fallback), {_sd.lanes} lanes (power-of-two tiers), "
           f"chunk {_sd.chunk} (+{tail_size(_sd.chunk)}-step tail program, "
           f"compiled on first use), buckets {','.join(map(str, _sd.buckets))}")
+    # two-tier placement (ISSUE 10): where a bucket-overflow request goes
+    # on THIS host — the mesh a mega-lane would span, the auto default,
+    # and the packed ceiling it takes over from
+    from .parallel.mesh import auto_mesh_shape
+
+    _ndev = len(jax.devices())
+    _mshape = "x".join(map(str, auto_mesh_shape(_ndev, 2)))
+    _mega_default = 1 if _ndev > 1 else 0
+    print(f"serve placement: two-tier — packed vmapped lanes up to bucket "
+          f"{max(_sd.buckets)}, then sharded mega-lanes spanning the "
+          f"{_ndev}-device mesh ({_mshape} for 2D); mega-lanes default "
+          f"{_mega_default} on this host (--mega-lanes auto|N; 0 = "
+          f"overflow stays a rejection"
+          + (", the single-device behavior); "
+             if _ndev <= 1 else "); ")
+          + "mega side must divide the mesh axes")
     # serve lane-kernel defaults/availability: which chunk-program body
     # each default bucket would get under --serve-lane-kernel auto on
     # THIS host (the static half; per-run fallbacks print per serve)

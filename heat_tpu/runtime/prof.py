@@ -182,15 +182,16 @@ class CostModel:
 
     def __init__(self, alpha: float = COST_EWMA_ALPHA):
         self.alpha = float(alpha)
-        self._entries: Dict[Tuple[str, int, int, str], _CostEntry] = {}
+        self._entries: Dict[Tuple[str, int, int, str, str], _CostEntry] = {}
         self._lock = threading.Lock()
 
     def observe(self, bucket: str, lanes: int, depth: int, k: int,
-                wall_s: float, kernel: str = "xla") -> None:
+                wall_s: float, kernel: str = "xla",
+                placement: str = "packed") -> None:
         if wall_s < 0 or k < 1 or lanes < 1:
             return
         per = wall_s / (k * lanes)
-        key = (bucket, lanes, depth, kernel)
+        key = (bucket, lanes, depth, kernel, placement)
         with self._lock:
             e = self._entries.get(key)
             if e is None:
@@ -204,20 +205,23 @@ class CostModel:
         e.hist.observe(per)   # histogram carries its own lock
 
     def estimate_s_per_lane_step(self, bucket: str, lanes: int, depth: int,
-                                 kernel: str = "xla") -> Optional[float]:
+                                 kernel: str = "xla",
+                                 placement: str = "packed"
+                                 ) -> Optional[float]:
         with self._lock:
-            e = self._entries.get((bucket, lanes, depth, kernel))
+            e = self._entries.get((bucket, lanes, depth, kernel, placement))
             return None if e is None else e.ewma
 
     def estimate_request_s(self, bucket: str, lanes: int, depth: int,
-                           ntime: int,
-                           kernel: str = "xla") -> Optional[float]:
+                           ntime: int, kernel: str = "xla",
+                           placement: str = "packed") -> Optional[float]:
         """Predicted wall for one request of ``ntime`` steps admitted to
         this (bucket, tier): its lane advances one step whenever the
         whole group does, and a group step costs ``lanes *
         s_per_lane_step`` — queue wait excluded (that is the admission
         policy's number, not the chunk program's)."""
-        per = self.estimate_s_per_lane_step(bucket, lanes, depth, kernel)
+        per = self.estimate_s_per_lane_step(bucket, lanes, depth, kernel,
+                                            placement)
         return None if per is None else per * lanes * ntime
 
     def snapshot(self) -> List[dict]:
@@ -226,11 +230,11 @@ class CostModel:
         with self._lock:
             items = list(self._entries.items())
         out = []
-        for (bucket, lanes, depth, kernel), e in sorted(items):
+        for (bucket, lanes, depth, kernel, placement), e in sorted(items):
             mean = e.wall_s / e.lane_steps if e.lane_steps else None
             out.append({
                 "bucket": bucket, "lanes": lanes, "depth": depth,
-                "kernel": kernel,
+                "kernel": kernel, "placement": placement,
                 "chunks": e.count,
                 "ewma_s_per_lane_step": e.ewma,
                 "mean_s_per_lane_step": mean,
@@ -418,7 +422,7 @@ def empty_usage() -> dict:
 
 class _LedgerCell:
     __slots__ = ("lane_s", "steps", "chunks", "bytes_written", "requests",
-                 "by_status")
+                 "by_status", "by_placement")
 
     def __init__(self):
         self.lane_s = 0.0
@@ -427,11 +431,18 @@ class _LedgerCell:
         self.bytes_written = 0
         self.requests = 0
         self.by_status: collections.Counter = collections.Counter()
+        # placement dimension (ISSUE 10): how many of this cell's
+        # requests ran as packed vmapped lanes vs mesh-spanning mega
+        # lanes ("none" = rejected before placement) — a mega request
+        # occupies the WHOLE mesh for its lane-seconds, so billing and
+        # capacity plans need the split, not just the totals
+        self.by_placement: collections.Counter = collections.Counter()
 
     def asdict(self) -> dict:
         return {"lane_s": round(self.lane_s, 6), "steps": self.steps,
                 "chunks": self.chunks, "bytes_written": self.bytes_written,
-                "requests": self.requests, "by_status": dict(self.by_status)}
+                "requests": self.requests, "by_status": dict(self.by_status),
+                "by_placement": dict(self.by_placement)}
 
 
 class UsageLedger:
@@ -445,7 +456,7 @@ class UsageLedger:
         self._lock = threading.Lock()
 
     def add(self, tenant: str, slo_class: str, status: str,
-            usage: dict) -> None:
+            usage: dict, placement: Optional[str] = None) -> None:
         with self._lock:
             cell = self._cells.get((tenant, slo_class))
             if cell is None:
@@ -456,6 +467,7 @@ class UsageLedger:
             cell.bytes_written += int(usage.get("bytes_written") or 0)
             cell.requests += 1
             cell.by_status[status] += 1
+            cell.by_placement[placement or "none"] += 1
 
     def snapshot(self) -> dict:
         """``/v1/usage`` payload: per-tenant (per-class) aggregates plus
@@ -479,6 +491,7 @@ class UsageLedger:
             totals.bytes_written += d["bytes_written"]
             totals.requests += d["requests"]
             totals.by_status.update(d["by_status"])
+            totals.by_placement.update(d.get("by_placement") or {})
         return {"tenants": tenants, "totals": totals.asdict()}
 
 
@@ -627,10 +640,11 @@ class Observatory:
 
     # -- feeds (scheduler side) --------------------------------------------
     def observe_chunk(self, bucket: str, lanes: int, depth: int, k: int,
-                      wall_s: float, kernel: str = "xla") -> None:
+                      wall_s: float, kernel: str = "xla",
+                      placement: str = "packed") -> None:
         if self.enabled:
             self.cost.observe(bucket, lanes, depth, k, wall_s,
-                              kernel=kernel)
+                              kernel=kernel, placement=placement)
 
     def note_terminal(self, snap: dict, now: float) -> Optional[dict]:
         """Feed one terminal record snapshot (ledger + burn windows);
@@ -641,7 +655,8 @@ class Observatory:
         usage = snap.get("usage") or empty_usage()
         self.ledger.add(snap.get("tenant") or "default",
                         snap.get("class") or "standard",
-                        snap.get("status") or "?", usage)
+                        snap.get("status") or "?", usage,
+                        placement=snap.get("placement"))
         if (snap.get("deadline_ms") is None
                 or snap.get("status") == "rejected"):
             # undated requests have no SLO to burn; rejections never ran

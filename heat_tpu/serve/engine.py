@@ -506,16 +506,8 @@ class LaneEngine:
         forever. ``plan`` is the active fault plan — the ``fetch-hang``
         injection sleeps INSIDE the watchdogged region, so chaos tests
         exercise the exact production path."""
-        def fetch():
-            if plan is not None:
-                plan.maybe_fetch_hang(fetch_index)
-            return host_fetch(handle)
-
-        if timeout_s is None:
-            return fetch()
-        from ..runtime.async_io import bounded_call
-
-        return bounded_call(fetch, timeout_s, "serve boundary fetch")
+        return fetch_boundary(handle, timeout_s=timeout_s, plan=plan,
+                              fetch_index=fetch_index)
 
     def step_chunk(self, timeout_s: Optional[float] = None, plan=None,
                    fetch_index: int = 0) -> np.ndarray:
@@ -574,6 +566,231 @@ class LaneEngine:
         self._state = self._load(
             *self._state, np.int32(lane), buf,
             np.asarray(r, acc), np.int32(n), np.int32(steps))
+
+
+def fetch_boundary(handle, timeout_s: Optional[float] = None, plan=None,
+                   fetch_index: int = 0) -> np.ndarray:
+    """The ONE watchdogged boundary-D2H path, shared by the packed lane
+    engine (``LaneEngine.fetch_remaining``) and the sharded mega-lane
+    (``MegaLaneEngine``): fetch a ``(2, L)`` boundary handle to host,
+    optionally under the ``bounded_call`` watchdog, with the
+    ``fetch-hang`` fault injection firing INSIDE the watchdogged region
+    either way (runtime/faults.py)."""
+    def fetch():
+        if plan is not None:
+            plan.maybe_fetch_hang(fetch_index)
+        return host_fetch(handle)
+
+    if timeout_s is None:
+        return fetch()
+    from ..runtime.async_io import bounded_call
+
+    return bounded_call(fetch, timeout_s, "serve boundary fetch")
+
+
+class MegaLaneEngine:
+    """Device half of ONE mesh-spanning mega-lane occupant.
+
+    The second placement tier (ISSUE 10): a request that overflows every
+    bucket runs as a *sharded mega-lane* — the whole device mesh executes
+    the ``backends/sharded.py`` padded-carry chunked advance for that one
+    request, wrapped in the exact dispatch contract ``LaneEngine``
+    exposes for packed lanes: ``dispatch_chunk(k)`` enqueues one k-step
+    program and returns a DEVICE handle to a ``(2, 1)`` boundary vector
+    (remaining steps + an owned-cells ``isfinite`` bit) with no host
+    round trip; the scheduler's ``fetch_boundary`` is the only D2H; the
+    carried padded state is donated through each chunk like the solo
+    drive's double buffer. One mega-lane is therefore just a bucket
+    group of lane-count one whose "bucket" is the mesh.
+
+    Bit-exactness is inherited, not hoped: the chunk body IS
+    ``make_mega_machinery``'s wrap of the solo padded-carry blocks
+    (same exchange, same kernel, same bounds), the initial state is the
+    same device-built IC + seed the solo path resolves, and owned-cell
+    values are invariant under chunk partitioning (the fused-exchange
+    margin argument) — so serving in ``--chunk``-step slices produces
+    the byte-identical field a solo ``drive()`` of the same config
+    yields in one call.
+
+    Compile economics: the seed/crop programs and every chunk size this
+    occupant will run (the steady ``chunk`` plus at most one remainder)
+    are AOT-compiled at admission through the engine-shared cache, keyed
+    by (config geometry, mesh, k) — re-admitting the same oversized
+    config costs zero compiles, and nothing ever compiles inside the
+    dispatch loop."""
+
+    def __init__(self, cfg, mesh, chunk: int,
+                 compiled_cache: Optional[Dict] = None,
+                 on_compile: Optional[Callable[[int, float], None]] = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.chunk = chunk
+        self._cache = compiled_cache if compiled_cache is not None else {}
+        self._on_compile = on_compile
+        # geometry + physics fields that select a distinct compiled
+        # program family (r folds in sigma/nu/dom_len/n; exchange and
+        # local_kernel shape the shard body)
+        self._ckey = ("mega", cfg.ndim, cfg.n, cfg.dtype, cfg.bc,
+                      repr(cfg.bc_value), repr(float(cfg.r)),
+                      tuple(mesh.devices.shape), cfg.exchange, cfg.comm,
+                      cfg.local_kernel, cfg.fuse_steps)
+        self._label = (f"mega {cfg.ndim}d n{cfg.n} {cfg.dtype} {cfg.bc} "
+                       f"mesh {'x'.join(map(str, mesh.devices.shape))}")
+        m = self._machinery()
+        self.kf = m["kf"]
+        self._advance = m["advance"]
+        self._seed_c = m["seed"]
+        self._crop_c = m["crop"]
+        for k in self.chunk_sizes():
+            self._ensure(k)
+        self.reload()
+
+    # --- compiled-program plumbing ----------------------------------------
+    def _structs(self, kf: int):
+        """(owned, padded) ShapeDtypeStructs the seed/crop programs
+        compile against — the same derivation the sharded compile guard
+        uses (``_probe_state_struct``)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg, mesh = self.cfg, self.mesh
+        sharding = NamedSharding(mesh, P(*mesh.axis_names))
+        dt = jnp_dtype(cfg.dtype)
+        owned = jax.ShapeDtypeStruct(cfg.shape, dt, sharding=sharding)
+        padded = jax.ShapeDtypeStruct(
+            tuple(cfg.n + 2 * kf * int(s) for s in mesh.devices.shape),
+            dt, sharding=sharding)
+        return owned, padded
+
+    def _machinery(self) -> dict:
+        """Build (or fetch warm) the mega machinery for this (config,
+        mesh): the jitted advance plus AOT-compiled seed/crop programs.
+        Cached engine-wide so a second admission of the same oversized
+        config compiles nothing."""
+        key = ("mega-mach",) + self._ckey
+        m = self._cache.get(key)
+        if m is None:
+            from ..backends.sharded import make_mega_machinery
+            from ..runtime import prof
+
+            t0 = time.perf_counter()
+            seed, advance, crop, kf = make_mega_machinery(self.cfg,
+                                                          self.mesh)
+            owned, padded = self._structs(kf)
+            m = {"kf": kf, "advance": advance,
+                 "seed": seed.lower(owned).compile(),
+                 "crop": crop.lower(padded).compile()}
+            spent = time.perf_counter() - t0
+            self._cache[key] = m
+            prof.compile_log().note(self._label + " seed/crop", 0, spent)
+            if self._on_compile is not None:
+                self._on_compile(0, spent)
+        return m
+
+    def chunk_sizes(self) -> list:
+        """Every k the occupant's drain will dispatch: the steady chunk
+        plus at most one remainder (the solo drive's chunk_sizes shape,
+        with the serve chunk as the event interval)."""
+        ntime = self.cfg.ntime
+        if ntime <= 0:
+            return []
+        k0 = min(self.chunk, ntime)
+        sizes = {k0}
+        if ntime % k0:
+            sizes.add(ntime % k0)
+        return sorted(sizes)
+
+    def _ensure(self, k: int):
+        ckey = self._ckey + (k,)
+        if ckey not in self._cache:
+            from ..backends.common import aot_compile_chunks
+
+            import jax
+
+            _, padded = self._structs(self.kf)
+            rem = jax.ShapeDtypeStruct((1,), np.int32)
+            compiled, spent = aot_compile_chunks(
+                self._advance, (padded, rem), [k], label=self._label,
+                kernel="sharded")
+            self._cache[ckey] = compiled[k]
+            if self._on_compile is not None:
+                self._on_compile(k, spent)
+        return self._cache[ckey]
+
+    # --- state lifecycle --------------------------------------------------
+    def reload(self) -> None:
+        """(Re)build the carried padded state from the deterministic
+        initial condition — admission, and the rollback path's
+        no-verified-boundary-yet restart. The IC is the device-built,
+        mesh-sharded construction the solo sharded drive resolves, so
+        the starting bytes match a solo run's exactly."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..grid import initial_condition_device
+
+        sharding = NamedSharding(self.mesh, P(*self.mesh.axis_names))
+        T0 = initial_condition_device(self.cfg, sharding=sharding)
+        self._state = self._seed_c(T0)
+        del T0
+        self._rem = np.asarray([self.cfg.ntime], np.int32)
+
+    def dispatch_chunk(self, k: int):
+        """Enqueue one k-step mesh program and return the DEVICE handle
+        to its ``(2, 1)`` boundary vector — no fence, no host round
+        trip (the mega mirror of ``LaneEngine.dispatch_chunk``)."""
+        fn = self._ensure(k)
+        self._state, self._rem, boundary = fn(self._state, self._rem)
+        return boundary
+
+    def snapshot_state(self):
+        """Restorable on-device copy of the carried state (rollback
+        bookkeeping). The mega state IS donated through each chunk (the
+        whole point of padded-carry), so unlike the packed lanes'
+        aliasing trick this pays one device-side copy per dispatched
+        chunk — only in rollback mode, the PR-5 pre-rework shape."""
+        from ..runtime.async_io import device_snapshot
+
+        return device_snapshot(self._state)
+
+    def restore(self, snap, steps_left: int) -> None:
+        """Roll the mega-lane back to a verified-finite boundary. The
+        snapshot is copied in (not adopted): a second rollback attempt
+        must find it intact."""
+        from ..runtime.async_io import device_snapshot
+
+        self._state = device_snapshot(snap)
+        self._rem = np.asarray([steps_left], np.int32)
+
+    def final_snapshot(self):
+        """Crop the padded carried state to the owned global field — a
+        device program enqueued behind whatever is in flight; the D2H
+        happens in the writer thread via ``extract``."""
+        return self._crop_c(self._state)
+
+    @staticmethod
+    def extract(snap) -> np.ndarray:
+        """D2H a cropped final field (writer thread). Static on purpose:
+        the writeback closure must not pin the multi-shard padded state
+        alive, only the cropped snapshot."""
+        return host_fetch(snap)
+
+    def poison_center(self) -> None:
+        """Chaos-only (``lane-nan`` injection on a mega request): NaN the
+        center OWNED cell of the carried padded state. Device placement
+        is re-pinned to the state's sharding so the compiled advance's
+        input layout contract survives the eager scatter."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg, kf = self.cfg, self.kf
+        idx = []
+        for s in self.mesh.devices.shape:
+            local = cfg.n // int(s)
+            shard, off = divmod(cfg.n // 2, local)
+            idx.append(shard * (local + 2 * kf) + kf + off)
+        poisoned = self._state.at[tuple(idx)].set(jnp.nan)
+        self._state = jax.device_put(poisoned, self._state.sharding)
 
 
 def wall_clock() -> float:

@@ -147,6 +147,20 @@ def render_metrics(engine: Engine) -> str:
            [([("status", st)], s[st]) for st in
             (*TERMINAL_STATUSES, "queued", "running") if s.get(st)]
            or [([("status", "ok")], 0)])
+    metric("heat_tpu_serve_requests_by_placement_total", "counter",
+           "Requests by placement tier (ISSUE 10): packed = vmapped "
+           "bucket lanes, mega = mesh-spanning sharded mega-lane.",
+           [([("placement", p)], c)
+            for p, c in sorted((s.get("placement") or {}).items())]
+           or [([("placement", "packed")], 0)])
+    metric("heat_tpu_serve_mega_lanes", "gauge",
+           "Concurrent mega-lane slots (--mega-lanes; 0 = bucket "
+           "overflow stays a rejection).",
+           [([], s.get("mega_lanes", 0))])
+    metric("heat_tpu_serve_mega_compiles_total", "counter",
+           "Mega-lane programs compiled (chunk/seed/crop; warm "
+           "re-admissions of the same oversized config compile nothing).",
+           [([], s.get("mega_compiles", 0))])
     metric("heat_tpu_serve_queue_depth", "gauge",
            "Requests queued (not yet admitted to a lane), per tenant.",
            [([("tenant", t)], n)
@@ -189,14 +203,16 @@ def render_metrics(engine: Engine) -> str:
            "counterpart of calibration_v5e.json (cross-check: heat-tpu "
            "perfcheck).",
            [([("bucket", e["bucket"]), ("lanes", e["lanes"]),
-              ("depth", e["depth"]), ("kernel", e.get("kernel", "xla"))],
+              ("depth", e["depth"]), ("kernel", e.get("kernel", "xla")),
+              ("placement", e.get("placement", "packed"))],
              e["ewma_s_per_lane_step"])
             for e in cm if e["ewma_s_per_lane_step"] is not None]
            or [([], 0)])
     metric("heat_tpu_serve_cost_chunks_observed_total", "counter",
            "Chunk boundaries the cost model has learned from, per key.",
            [([("bucket", e["bucket"]), ("lanes", e["lanes"]),
-              ("depth", e["depth"]), ("kernel", e.get("kernel", "xla"))],
+              ("depth", e["depth"]), ("kernel", e.get("kernel", "xla")),
+              ("placement", e.get("placement", "packed"))],
              e["chunks"]) for e in cm]
            or [([], 0)])
     metric("heat_tpu_serve_lane_kernel_fallbacks_total", "counter",
@@ -320,6 +336,12 @@ def render_statusz(engine: Engine) -> str:
         + ", ".join(f"{s.get(st, 0)} {st}" for st in
                     (*TERMINAL_STATUSES, "queued", "running")
                     if s.get(st)))
+    pl = s.get("placement") or {}
+    lines.append(
+        f"placement: {pl.get('packed', 0)} packed / "
+        f"{pl.get('mega', 0)} mega — {s.get('mega_lanes', 0)} mega "
+        f"lane slot(s) (--mega-lanes; bucket-overflow requests run on "
+        f"the mesh), {s.get('mega_compiles', 0)} mega compile(s)")
     lines.append(
         f"engine: {s['chunks_dispatched']} chunk(s) "
         f"({s['tail_chunks']} tail), {s['boundary_waits']} boundary "
@@ -343,7 +365,7 @@ def render_statusz(engine: Engine) -> str:
         ew = e["ewma_s_per_lane_step"]
         lines.append(
             f"  {e['bucket']} xL{e['lanes']} depth{e['depth']} "
-            f"[{e.get('kernel', 'xla')}]: "
+            f"[{e.get('kernel', 'xla')}/{e.get('placement', 'packed')}]: "
             f"{'n/a' if ew is None else format(ew, '.3e')} s/lane-step "
             f"(p95 {e['p95_s_per_lane_step'] or 0:.0e}, "
             f"{e['chunks']} chunk(s), {e['wall_s']:.3f}s observed)")

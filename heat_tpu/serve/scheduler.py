@@ -84,6 +84,23 @@ The serving contract, in the shape of an inference server's scheduler:
   keeping boundaries restorable costs no standalone copy program on
   the dispatch path.
 
+- **Two-tier placement** (ISSUE 10): a request whose side overflows
+  every bucket no longer dies as a ``bucket-overflow`` rejection — on a
+  multi-device host it is admitted to the engine-wide mega queue and
+  runs as a **sharded mega-lane**: one request occupying the whole
+  device mesh via the ``backends/sharded.py`` padded-carry chunked
+  advance (``MegaLaneRunner`` + ``serve/engine.py MegaLaneEngine``),
+  under the same dispatch-ahead contract as the packed lanes (boundary
+  handle, dispatch depth, countdown mirror, isfinite bit, deadline /
+  quarantine / rollback / watchdog — one mega-lane is a fault domain of
+  size one-mesh). ``Engine.run``'s round-robin treats mega slots as
+  just more groups, so packed traffic and a resident mega-lane hide
+  each other's boundary bookkeeping. ``--mega-lanes N`` gates the tier
+  (auto: 1 on a multi-device mesh, 0 single-device where overflow stays
+  a rejection — bit-identical to the pre-mega engine); every record,
+  cost-model row, /metrics gauge, usage stamp, and trace row carries a
+  ``placement=packed|mega`` dimension.
+
 Per-request structured JSON records (queue wait, steps/s, lane id) go
 through ``runtime/logging``; each request also keeps a python-level record
 for library callers (``Engine.results()``). Records are mutated from both
@@ -109,8 +126,9 @@ from ..runtime import prof as prof_mod
 from ..runtime import trace as trace_mod
 from ..runtime.logging import json_record, master_print
 from . import policy as policy_mod
-from .engine import (BucketKey, LaneEngine, lane_tier, resolve_lane_kernel,
-                     wall_clock)
+from .engine import (BucketKey, LaneEngine, MegaLaneEngine, lane_tier,
+                     resolve_lane_kernel, wall_clock)
+from .engine import fetch_boundary as engine_fetch_boundary
 
 # Statuses a record can never leave: what poll()/wait() callers and the
 # gateway's streaming responses key on.
@@ -216,6 +234,18 @@ class ServeConfig:
                               # chunk boundaries between device-memory
                               # watermark samples (leak sentinel);
                               # 0 = never sample
+    mega_lanes: Optional[int] = None  # second placement tier (ISSUE 10):
+                              # how many mesh-spanning sharded mega-lanes
+                              # may run concurrently. A request whose side
+                              # overflows every bucket is admitted to the
+                              # mega queue instead of rejected and runs as
+                              # ONE request occupying the whole device
+                              # mesh (backends/sharded.py chunked advance
+                              # under the same dispatch-ahead contract).
+                              # None = auto: 1 when the host has > 1
+                              # device, 0 on single-device hosts where
+                              # overflow stays a rejection; 0 restores
+                              # the pre-mega behavior bit-identically
     lane_kernel: str = "auto"  # chunk-program body per bucket
                               # (--serve-lane-kernel): "auto" = the
                               # multi-lane Pallas kernels on TPU wherever
@@ -287,6 +317,10 @@ class ServeConfig:
         if self.lane_kernel not in LANE_KERNELS:
             raise ValueError(f"lane_kernel must be one of {LANE_KERNELS}, "
                              f"got {self.lane_kernel!r}")
+        if self.mega_lanes is not None and self.mega_lanes < 0:
+            raise ValueError(f"mega_lanes must be >= 0 (None = auto: 1 on "
+                             f"a multi-device mesh, 0 single-device), got "
+                             f"{self.mega_lanes}")
         if self.inject:
             # fail at construction, not at a boundary mid-drain (same
             # parse-time contract as HeatConfig.inject)
@@ -299,6 +333,15 @@ class ServeConfig:
 _MAX_LANE_ROLLBACKS = 2
 
 
+def mega_device_count() -> int:
+    """Devices a mega-lane mesh could span on THIS host — the seam the
+    auto ``--mega-lanes`` default and the overflow rejection text resolve
+    through (tests fake a single-device host by patching it)."""
+    import jax
+
+    return len(jax.devices())
+
+
 @dataclasses.dataclass
 class Request:
     """One admitted solve request."""
@@ -306,7 +349,12 @@ class Request:
     id: str
     cfg: HeatConfig
     submit_t: float
-    key: Optional[BucketKey] = None   # None once rejected
+    key: Optional[BucketKey] = None   # None once rejected, and for
+                                      # mega-placed requests (their
+                                      # "bucket" is the device mesh)
+    placement: str = "packed"         # "packed" (vmapped bucket lanes) |
+                                      # "mega" (mesh-spanning sharded
+                                      # lane) — the ISSUE-10 second tier
     deadline_t: Optional[float] = None  # absolute wall deadline (engine
                                         # clock), resolved at submit from
                                         # the request's deadline_ms or the
@@ -895,6 +943,419 @@ class _GroupRunner:
             self.sync_round()
 
 
+class MegaLaneRunner:
+    """Dispatch-ahead serving for ONE mesh-spanning mega-lane slot.
+
+    The second placement tier (ISSUE 10): a ``_GroupRunner`` peer whose
+    "bucket group" is the whole device mesh and whose lane count is one.
+    Requests that overflow every bucket queue here (``Engine.submit``)
+    and run as the sharded padded-carry chunked advance
+    (``serve/engine.py MegaLaneEngine`` over ``backends/sharded.py``),
+    wrapped in the exact contract the packed runners live by: a device
+    boundary handle per chunk, ``--dispatch-depth`` chunks in flight, a
+    host countdown mirror cross-checked against every fetch, the
+    owned-cells ``isfinite`` bit riding the boundary D2H, and the
+    deadline / quarantine / rollback / watchdog semantics of a fault
+    domain whose blast radius is one mesh. ``Engine.run``'s round-robin
+    treats it as just another group, so a mega-lane's boundary
+    bookkeeping hides under packed-lane compute and vice versa — and,
+    per the roofline note (PAPERS.md), the mega chunk's halo-exchange
+    boundaries are exactly the slack packed-lane chunk dispatches fill.
+
+    One slot serves one request at a time; ``--mega-lanes N`` slots
+    share the engine-wide mega queue (admission order is the engine's
+    policy, same as the packed tier). The mesh being a shared physical
+    resource, a wedged mega fetch (watchdog) fails the whole mega tier's
+    in-flight and queued requests — one mesh, one fault domain."""
+
+    def __init__(self, outer: "Engine", slot: int, q, writer):
+        self.outer = outer
+        self.slot = slot
+        self.q = q
+        self.writer = writer
+        scfg = outer.scfg
+        self.chunk = scfg.chunk
+        self.depth = max(1, scfg.dispatch_depth)
+        self.rollback = scfg.on_nan == "rollback"
+        self.lanes = 1
+        self.kernel = "sharded"
+        self.key = ("mega", slot)
+        # single-lane mirrors of the group runner's per-lane state, so
+        # Engine._fail_group (and the round-robin) treat both alike
+        self.occupant: List[Optional[Request]] = [None]
+        self.epoch = [0]
+        self.dev_rem = np.zeros(1, dtype=np.int64)
+        self.lane_chunks = np.zeros(1, dtype=np.int64)
+        self.nan_pending: List[List[int]] = [[]]
+        self.rb_left = [0]
+        self.last_good: List[Optional[tuple]] = [None]
+        self.seq = 0
+        self.inflight: collections.deque = collections.deque()
+        self.idle_from: Optional[float] = None
+        self.allow_growth = False      # a mega-lane has no tier to grow:
+                                       # it already spans the mesh
+        self.eng = None                # MegaLaneEngine, per occupant
+        self.cost_label = "mega"       # refined per occupant
+        self.last_fetch_t: Optional[float] = None
+        self.tracer = outer.tracer
+        self.track_name = f"mega lane {slot}"
+        self.group_track = self.tracer.track(self.track_name, "dispatch")
+        self.lane_tracks = [self.tracer.track(self.track_name, "mesh")]
+        self._fill()
+
+    # --- admission --------------------------------------------------------
+    def _fill(self) -> None:
+        """Admit the next queued mega request into this slot: build the
+        mesh-spanning engine (seed + AOT chunk compiles, warm via the
+        engine-shared cache) on the scheduler thread. Queued requests
+        past their deadline are shed here, and an engine-construction
+        failure (a compile error on THIS config) fails that one request
+        — never the scheduler loop."""
+        outer = self.outer
+        while self.occupant[0] is None and self.q:
+            with outer._lock:
+                req = self.q.pop()
+                if req is None:
+                    break
+                outer._queued_by_tenant[req.tenant] -= 1
+                outer.admission_trace.append(req.id)
+            now = wall_clock()
+            tr = self.tracer
+            if tr.enabled:
+                policy_mod.note_pop(tr, outer.scfg.policy, req, now)
+            if req.deadline_t is not None and now > req.deadline_t:
+                if tr.enabled:
+                    tr.instant("deadline-shed", self.group_track,
+                               trace_id=req.trace_id,
+                               args={"id": req.id}, ts=now)
+                outer._fail_request(
+                    req, "deadline",
+                    f"deadline: exceeded its "
+                    f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms "
+                    f"budget while still queued (never admitted)")
+                outer.deadline_misses += 1
+                continue
+            if tr.enabled:
+                tr.flow("t", self.lane_tracks[0], req.trace_id, ts=now)
+            rec = outer._by_id[req.id]
+            with outer._lock:
+                rec["lane"] = 0
+                rec["queue_wait_s"] = round(now - req.submit_t, 6)
+                rec["status"] = "running"
+                rec["_start_t"] = now
+            try:
+                mesh = outer._mega_mesh(req.cfg.ndim)
+                self.eng = MegaLaneEngine(
+                    req.cfg, mesh, self.chunk,
+                    compiled_cache=outer._compiled,
+                    on_compile=outer._note_mega_compile)
+            except Exception as e:  # noqa: BLE001 — per-request record
+                outer._fail_request(
+                    req, "error",
+                    f"mega-lane build failed: {type(e).__name__}: {e}",
+                    lane=0)
+                continue
+            self.cost_label = (f"{req.cfg.ndim}d/n{req.cfg.n}/"
+                               f"{req.cfg.dtype}/{req.cfg.bc}")
+            self.occupant[0] = req
+            self.epoch[0] = self.seq
+            self.dev_rem[0] = req.cfg.ntime
+            self.lane_chunks[0] = 0
+            self.nan_pending[0] = outer._lane_nan_steps(req)
+            if self.nan_pending[0]:
+                outer._has_lane_faults = True
+            self.rb_left[0] = _MAX_LANE_ROLLBACKS
+            self.last_good[0] = None
+
+    def maybe_grow(self) -> None:
+        """Interface parity with ``_GroupRunner``: nothing to grow."""
+
+    def has_work(self) -> bool:
+        return (bool(self.inflight) or bool(self.q)
+                or self.occupant[0] is not None)
+
+    # --- dispatch side ----------------------------------------------------
+    def _maybe_poison(self) -> None:
+        req = self.occupant[0]
+        if req is None or not self.nan_pending[0]:
+            return
+        done = req.cfg.ntime - int(self.dev_rem[0])
+        while self.nan_pending[0] and done >= self.nan_pending[0][0]:
+            self.nan_pending[0].pop(0)
+            self.eng.poison_center()
+
+    def dispatch_fill(self) -> None:
+        """Queue mesh chunk programs until ``dispatch_depth`` are in
+        flight or the occupant has no steps left. The chunk size shrinks
+        to the exact remaining count on the final dispatch (the sharded
+        advance has no per-step countdown mask — the host picks k, and
+        the at-most-one remainder program was AOT-compiled at
+        admission)."""
+        outer = self.outer
+        poison = outer._has_lane_faults
+        while len(self.inflight) < self.depth:
+            rem = int(self.dev_rem[0])
+            if self.occupant[0] is None or rem <= 0:
+                break
+            if poison:
+                self._maybe_poison()
+            k = min(self.chunk, rem)
+            t_disp = wall_clock()
+            handle = self.eng.dispatch_chunk(k)
+            if self.idle_from is not None:
+                outer.device_idle_s += t_disp - self.idle_from
+                if self.tracer.enabled:
+                    self.tracer.complete("device-idle", self.group_track,
+                                         self.idle_from, t_disp, cat="idle")
+                self.idle_from = None
+            self.lane_chunks[0] += 1
+            self.dev_rem[0] = rem - k
+            snap = self.eng.snapshot_state() if self.rollback else None
+            self.inflight.append(
+                (self.seq, handle, self.dev_rem.astype(np.int32).copy(),
+                 snap, t_disp, k))
+            self.seq += 1
+            outer.chunks_dispatched += 1
+
+    # --- boundary side ----------------------------------------------------
+    def _fetch(self, handle) -> np.ndarray:
+        outer = self.outer
+        t0 = wall_clock()
+        try:
+            return engine_fetch_boundary(
+                handle, timeout_s=outer.scfg.fetch_timeout_s,
+                plan=outer._plan, fetch_index=outer._fetch_seq)
+        finally:
+            outer._fetch_seq += 1
+            t1 = wall_clock()
+            outer.boundary_wait_s += t1 - t0
+            outer.boundary_waits += 1
+            if self.tracer.enabled:
+                self.tracer.complete("boundary-fetch",
+                                     self.tracer.thread_track("scheduler"),
+                                     t0, t1, cat="boundary",
+                                     args={"bucket": self.track_name})
+
+    def _trace_occupancy(self, lane: int, req: Request, status: str) -> None:
+        tr = self.tracer
+        if not tr.enabled:
+            return
+        t0 = self.outer._by_id[req.id].get("_start_t")
+        if t0 is None:
+            return
+        tr.complete(req.id, self.lane_tracks[0], t0, cat="lane",
+                    trace_id=req.trace_id,
+                    args={"status": status, "n": req.cfg.n,
+                          "ntime": req.cfg.ntime, "placement": "mega"})
+        tr.flow("t", self.lane_tracks[0], req.trace_id)
+
+    def _judge(self, seq: int, rem, finite, snap, sync: bool) -> None:
+        """One boundary's verdict for the single mega-lane: health first
+        (a non-finite field must never be delivered), then completion,
+        then deadline, then last-good promotion — the ``_judge_lanes``
+        order, one lane wide. The epoch guard keeps a chunk dispatched
+        before a swap/rollback from judging the new occupant."""
+        outer = self.outer
+        now = wall_clock()
+        req = self.occupant[0]
+        if req is None or seq < self.epoch[0]:
+            return
+        if finite is not None and not finite[0]:
+            self._handle_nonfinite(req, int(rem[0]), snap)
+        elif rem[0] == 0:
+            self._retire(req, sync)
+        elif req.deadline_t is not None and now > req.deadline_t:
+            done = req.cfg.ntime - int(rem[0])
+            self._trace_occupancy(0, req, "deadline")
+            outer._fail_request(
+                req, "deadline",
+                f"deadline: exceeded its "
+                f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms budget "
+                f"with ~{done} of {req.cfg.ntime} steps done; mega lane "
+                f"preempted at the chunk boundary", lane=0,
+                steps_done=done, chunks=int(self.lane_chunks[0]))
+            outer.deadline_misses += 1
+            self._release()
+        elif self.rollback and snap is not None:
+            self.last_good[0] = (snap, int(rem[0]))
+
+    def _release(self) -> None:
+        """Free the slot (and the multi-shard state) after a terminal
+        verdict; stale in-flight boundaries are drained by seq/epoch."""
+        self.occupant[0] = None
+        self.eng = None
+        self.dev_rem[0] = 0
+        self.nan_pending[0] = []
+        self.last_good[0] = None
+        self.epoch[0] = self.seq
+
+    def _handle_nonfinite(self, req: Request, rem_at: int, snap) -> None:
+        """The mega-lane's finite bit dropped: restore-and-re-step the
+        whole mesh state (rollback mode, budget permitting) or
+        quarantine the request — packed lanes in other groups are
+        untouched either way."""
+        outer = self.outer
+        done = req.cfg.ntime - rem_at
+        if self.rollback and self.rb_left[0] > 0:
+            self.rb_left[0] -= 1
+            outer.rollbacks += 1
+            if self.tracer.enabled:
+                self.tracer.instant("rollback", self.lane_tracks[0],
+                                    trace_id=req.trace_id,
+                                    args={"id": req.id, "at_step": done})
+            if self.last_good[0] is not None:
+                good_snap, steps_left = self.last_good[0]
+                master_print(
+                    f"serve on-nan rollback: mega request {req.id} "
+                    f"non-finite at ~step {done}; restoring the last "
+                    f"verified boundary ({steps_left} steps left, attempt "
+                    f"{_MAX_LANE_ROLLBACKS - self.rb_left[0]}/"
+                    f"{_MAX_LANE_ROLLBACKS})")
+                self.eng.restore(good_snap, steps_left)
+                self.dev_rem[0] = steps_left
+            else:
+                master_print(
+                    f"serve on-nan rollback: mega request {req.id} "
+                    f"non-finite at ~step {done}; re-stepping from the "
+                    f"initial condition (attempt "
+                    f"{_MAX_LANE_ROLLBACKS - self.rb_left[0]}/"
+                    f"{_MAX_LANE_ROLLBACKS})")
+                self.eng.reload()
+                self.dev_rem[0] = req.cfg.ntime
+            self.epoch[0] = self.seq
+            self.last_good[0] = None
+        else:
+            exhausted = self.rollback and self.rb_left[0] == 0
+            tried = (f" after {_MAX_LANE_ROLLBACKS} rollbacks "
+                     f"(deterministic blow-up)" if exhausted else "")
+            if self.tracer.enabled:
+                self.tracer.instant("quarantine", self.lane_tracks[0],
+                                    trace_id=req.trace_id,
+                                    args={"id": req.id, "at_step": done})
+            self._trace_occupancy(0, req, "nonfinite")
+            outer._fail_request(
+                req, "nonfinite",
+                f"nonfinite: non-finite field detected at ~step {done} of "
+                f"{req.cfg.ntime} (mega lane){tried} — check the CFL "
+                f"bound sigma <= 1/(2*ndim) for this request", lane=0,
+                steps_done=done, chunks=int(self.lane_chunks[0]))
+            outer.lanes_quarantined += 1
+            if exhausted:
+                outer._flight_dump("quarantine after "
+                                   f"{_MAX_LANE_ROLLBACKS} rollbacks "
+                                   f"(mega request {req.id})")
+            self._release()
+
+    def _retire(self, req: Request, sync: bool) -> None:
+        """Completion: crop the padded state to the owned field (a device
+        program, enqueued) and hand the D2H + npz publish to the writer
+        thread — the mega mirror of ``_finish_async``/``_finish_sync``.
+        The writeback closure holds only the cropped snapshot, so the
+        padded mesh state is freed with the slot."""
+        outer = self.outer
+        self._trace_occupancy(0, req, "retired")
+        rec = outer._finish_timing(req, chunks=int(self.lane_chunks[0]))
+        snap = self.eng.final_snapshot()
+        if sync:
+            T = MegaLaneEngine.extract(snap)
+            outer._writeback_job(rec, req, self.writer, lambda: T)
+        else:
+            outer._writeback_job(rec, req, self.writer,
+                                 lambda: MegaLaneEngine.extract(snap))
+        self._release()
+
+    def process_boundary(self) -> None:
+        """Take one boundary: fetch the OLDEST in-flight handle, judge,
+        refill — the group runner's shape, with the chunk span on the
+        mega lane's own process row carrying the halo-exchange geometry
+        (fused-exchange count and ghost width) a timeline reader needs
+        to see where the mesh fenced."""
+        if self.inflight:
+            seq, handle, predicted, snap, t_disp, k = self.inflight.popleft()
+            b = self._fetch(handle)
+            t_done = wall_clock()
+            rem, finite = b[0], b[1]
+            if self.tracer.enabled:
+                kf = self.eng.kf if self.eng is not None else 0
+                self.tracer.complete(
+                    f"mega chunk {seq} ({k} steps)", self.group_track,
+                    t_disp, t_done, cat="chunk",
+                    args={"seq": seq, "k": k, "halo_width": kf,
+                          "exchanges": -(-k // kf) if kf else 0})
+            outer = self.outer
+            if outer.prof.enabled:
+                base = (t_disp if self.last_fetch_t is None
+                        else max(self.last_fetch_t, t_disp))
+                outer.prof.observe_chunk(self.cost_label, 1, self.depth,
+                                         k, t_done - base,
+                                         kernel=self.kernel,
+                                         placement="mega")
+                self.last_fetch_t = t_done
+                warn = outer.prof.maybe_sample_memory(t_done)
+                if warn is not None:
+                    outer._mem_warn(warn)
+            if not self.inflight:
+                self.idle_from = t_done
+            if not np.array_equal(rem, predicted):
+                raise RuntimeError(
+                    f"serve dispatch-ahead desync for mega lane "
+                    f"{self.slot}: device remaining {rem.tolist()} != "
+                    f"host-predicted {predicted.tolist()} at chunk {seq} "
+                    f"— the mega countdown contract broke; results "
+                    f"cannot be trusted")
+            self._judge(seq, rem, finite, snap, sync=False)
+        else:
+            self._judge(self.seq, self.dev_rem, None, None, sync=False)
+        self._fill()
+
+    # --- synchronous fallback (--dispatch-depth off) ----------------------
+    def sync_round(self) -> None:
+        outer = self.outer
+        finite = None
+        snap = None
+        rem_vec = self.dev_rem
+        req = self.occupant[0]
+        if req is not None and int(self.dev_rem[0]) > 0:
+            if outer._has_lane_faults:
+                self._maybe_poison()
+            k = min(self.chunk, int(self.dev_rem[0]))
+            t0 = wall_clock()
+            if self.idle_from is not None:
+                outer.device_idle_s += t0 - self.idle_from
+                if self.tracer.enabled:
+                    self.tracer.complete("device-idle", self.group_track,
+                                         self.idle_from, t0, cat="idle")
+            b = self._fetch(self.eng.dispatch_chunk(k))
+            rem_vec, finite = b[0], b[1]
+            outer.chunks_dispatched += 1
+            self.idle_from = wall_clock()
+            if self.tracer.enabled:
+                self.tracer.complete(
+                    f"mega chunk {self.seq} ({k} steps, fenced)",
+                    self.group_track, t0, self.idle_from, cat="chunk",
+                    args={"seq": self.seq, "k": k,
+                          "halo_width": self.eng.kf})
+            if outer.prof.enabled:
+                outer.prof.observe_chunk(self.cost_label, 1, 0, k,
+                                         self.idle_from - t0,
+                                         kernel=self.kernel,
+                                         placement="mega")
+                warn = outer.prof.maybe_sample_memory(self.idle_from)
+                if warn is not None:
+                    outer._mem_warn(warn)
+            self.lane_chunks[0] += 1
+            self.dev_rem[0] = int(self.dev_rem[0]) - k
+            if self.rollback:
+                snap = self.eng.snapshot_state()
+        self._judge(self.seq, rem_vec, finite, snap, sync=True)
+        self.seq += 1
+        self._fill()
+
+    def run_sync(self) -> None:
+        while self.has_work():
+            self.sync_round()
+
+
 class Engine:
     """Request-driven batched execution engine (library API).
 
@@ -931,6 +1392,14 @@ class Engine:
             slo_slow_window_s=scfg.slo_slow_window_s,
             slo_burn_threshold=scfg.slo_burn_threshold)
         self._queues: Dict[BucketKey, object] = {}  # policy queues
+        # second placement tier (ISSUE 10): the engine-wide mega-lane
+        # admission queue (same policy object as the bucket queues) plus
+        # per-ndim mesh cache; lazily built so packed-only engines never
+        # touch the mesh layer
+        self._mega_queue = None
+        self._mega_meshes: Dict[int, object] = {}
+        self._mega_lanes_resolved: Optional[int] = None
+        self.mega_compiles = 0    # mega chunk/seed/crop programs built
         self._records: List[dict] = []
         self._by_id: Dict[str, dict] = {}
         self._seq = 0
@@ -995,6 +1464,78 @@ class Engine:
         self._fetch_seq = 0            # boundary-fetch counter (fetch-hang
                                        # @N addressing)
 
+    # --- mega-lane placement (ISSUE 10) -----------------------------------
+    @property
+    def mega_lanes(self) -> int:
+        """Resolved concurrent-mega-lane budget: the configured value, or
+        the auto default (1 when this host has more than one device, 0
+        on single-device hosts where overflow stays a rejection).
+        Resolved lazily and once — the first overflow admission, summary
+        or /metrics render pins it."""
+        if self._mega_lanes_resolved is None:
+            self._mega_lanes_resolved = (
+                self.scfg.mega_lanes if self.scfg.mega_lanes is not None
+                else (1 if mega_device_count() > 1 else 0))
+        return self._mega_lanes_resolved
+
+    def _mega_shape(self, ndim: int) -> tuple:
+        """The mesh shape a mega-lane of this rank would span (built
+        meshes win; the auto factorization otherwise)."""
+        mesh = self._mega_meshes.get(ndim)
+        if mesh is not None:
+            return tuple(mesh.devices.shape)
+        from ..parallel.mesh import auto_mesh_shape
+
+        return auto_mesh_shape(mega_device_count(), ndim)
+
+    def _mega_mesh(self, ndim: int):
+        mesh = self._mega_meshes.get(ndim)
+        if mesh is None:
+            from ..parallel.mesh import build_mesh
+
+            mesh = self._mega_meshes[ndim] = build_mesh(ndim, None)
+        return mesh
+
+    def _mega_overflow_reason(self, cfg: HeatConfig):
+        """``(reason, hint)`` when a bucket-overflow request can NOT run
+        as a mega-lane (the enriched rejection record, with the mesh
+        capacity ceiling and — when flipping one knob would serve it —
+        a machine-readable hint); ``(None, None)`` when it can."""
+        biggest = max(self.scfg.buckets)
+        base = (f"bucket-overflow: request side {cfg.n} exceeds the "
+                f"biggest bucket {biggest}")
+        ndev = mega_device_count()
+        if self.mega_lanes <= 0:
+            shape = "x".join(map(str, self._mega_shape(cfg.ndim)))
+            why = ("auto enables mega-lanes only on multi-device hosts"
+                   if ndev <= 1 and self.scfg.mega_lanes is None
+                   else "--mega-lanes 0")
+            return (base + f"; mega-lane placement is off ({why}) though "
+                    f"this host's {ndev}-device {shape} mesh could serve "
+                    f"it", "enable --mega-lanes")
+        shape = self._mega_shape(cfg.ndim)
+        bad = [int(s) for s in shape if cfg.n % int(s)]
+        if bad:
+            return (base + f"; side {cfg.n} does not divide evenly over "
+                    f"the {'x'.join(map(str, shape))} device mesh "
+                    f"(mega-lane shard constraint) — resubmit at a side "
+                    f"divisible by {max(int(s) for s in shape)}", None)
+        return None, None
+
+    def _note_mega_compile(self, k: int, seconds: float) -> None:
+        """Compile accounting for the mega tier (chunk programs, and the
+        k=0 seed/crop pair), kept out of the packed tier's
+        one-per-(bucket, tier) step/tail counters."""
+        self.mega_compiles += 1
+        self.compile_s += seconds
+        if self.tracer.enabled:
+            t1 = wall_clock()
+            self.tracer.complete(f"mega compile k={k}",
+                                 self.tracer.thread_track("compiler"),
+                                 t1 - seconds, t1, cat="compile",
+                                 args={"k": k,
+                                       "seconds": round(seconds, 4)})
+
     def _note_compile(self, k: int, seconds: float) -> None:
         if k == self.scfg.chunk:
             self.step_compiles += 1
@@ -1045,6 +1586,7 @@ class Engine:
             rec = {"id": rid, "n": cfg.n, "ndim": cfg.ndim,
                    "ntime": cfg.ntime, "dtype": cfg.dtype, "bc": cfg.bc,
                    "tenant": tenant, "class": slo_class, "status": "queued",
+                   "placement": None,
                    "bucket": None, "lane": None, "queue_wait_s": None,
                    "solve_s": None, "steps_per_s": None, "error": None,
                    "deadline_ms": deadline_ms, "trace_id": trace_id,
@@ -1063,14 +1605,23 @@ class Engine:
                               "edge, not the request edge)")
             return rid
         b = _bucket_for(cfg, self.scfg.buckets)
+        key = None
+        placement = "packed"
         if b is None:
-            self._reject(rec, f"bucket-overflow: request side {cfg.n} "
-                              f"exceeds the biggest bucket "
-                              f"{max(self.scfg.buckets)}")
-            return rid
-        key = BucketKey(ndim=cfg.ndim, n=b, dtype=cfg.dtype, bc=cfg.bc)
+            # two-tier placement (ISSUE 10): bucket overflow falls
+            # through to the mega-lane admission queue — one request
+            # spanning the whole device mesh — instead of a rejection,
+            # wherever mega-lanes are on and the side shards evenly
+            reason, hint = self._mega_overflow_reason(cfg)
+            if reason is not None:
+                self._reject(rec, reason, hint=hint)
+                return rid
+            placement = "mega"
+        else:
+            key = BucketKey(ndim=cfg.ndim, n=b, dtype=cfg.dtype, bc=cfg.bc)
         with self._cond:
-            queued = sum(len(q) for q in self._queues.values())
+            queued = (sum(len(q) for q in self._queues.values())
+                      + (len(self._mega_queue) if self._mega_queue else 0))
             if self.scfg.max_queue and queued >= self.scfg.max_queue:
                 self.shed += 1
                 shed_reason = (f"overloaded: admission queue full "
@@ -1086,13 +1637,21 @@ class Engine:
                                f"{self.scfg.tenant_quota}; resubmit later")
             else:
                 rec["bucket"] = b
+                rec["placement"] = placement
                 submit_t = rec["_submit_t"]
-                q = self._queues.get(key)
-                if q is None:
-                    q = self._queues[key] = policy_mod.make_queue(
-                        self.scfg.policy, self.scfg.tenant_weights)
+                if placement == "mega":
+                    q = self._mega_queue
+                    if q is None:
+                        q = self._mega_queue = policy_mod.make_queue(
+                            self.scfg.policy, self.scfg.tenant_weights)
+                else:
+                    q = self._queues.get(key)
+                    if q is None:
+                        q = self._queues[key] = policy_mod.make_queue(
+                            self.scfg.policy, self.scfg.tenant_weights)
                 req = Request(
                     id=rid, cfg=cfg, submit_t=submit_t, key=key,
+                    placement=placement,
                     deadline_t=(submit_t + deadline_ms / 1e3
                                 if deadline_ms is not None else None),
                     tenant=tenant, slo_class=slo_class, seq=seq,
@@ -1120,10 +1679,15 @@ class Engine:
             steps.update(p.lane_nan_steps(req.id))
         return sorted(steps)
 
-    def _reject(self, rec: dict, reason: str) -> None:
+    def _reject(self, rec: dict, reason: str,
+                hint: Optional[str] = None) -> None:
         with self._lock:
             rec["status"] = "rejected"
             rec["error"] = reason
+            if hint is not None:
+                # machine-readable remedy (ISSUE 10: an overflow a mesh
+                # could have served names the knob that would serve it)
+                rec["hint"] = hint
             rec["usage"] = prof_mod.empty_usage()   # schema-stable stamp
         self._emit(rec)
 
@@ -1390,6 +1954,15 @@ class Engine:
                 _GroupRunner(self, key, self._queues[key], writer)
                 for key in list(self._queues) if self._queues[key]
             ]
+            if (self._mega_queue and len(self._mega_queue)
+                    and self.mega_lanes > 0):
+                # one runner per occupied mega slot: round-robined with
+                # the packed groups, so a mega boundary's bookkeeping
+                # hides under packed compute and vice versa
+                runners += [
+                    MegaLaneRunner(self, i, self._mega_queue, writer)
+                    for i in range(min(self.mega_lanes,
+                                       len(self._mega_queue)))]
             if self.scfg.dispatch_depth == 0:
                 # synchronous debugging fallback: groups drain one at a
                 # time with a fence at every boundary (the PR-3 shape)
@@ -1456,7 +2029,8 @@ class Engine:
 
     def results(self) -> List[dict]:
         """``run`` + records (the common library call)."""
-        if any(self._queues.values()):
+        if (any(self._queues.values())
+                or (self._mega_queue and len(self._mega_queue))):
             self.run()
         return list(self._records)
 
@@ -1521,7 +2095,8 @@ class Engine:
         from ..runtime.timing import Timing
 
         writer = async_io.SnapshotWriter(tracer=self.tracer)
-        runners: Dict[BucketKey, _GroupRunner] = {}
+        # bucket groups keyed by BucketKey; mega slots by ("mega-slot", i)
+        runners: Dict[object, object] = {}
         t0 = wall_clock()
         try:
             while True:
@@ -1536,11 +2111,27 @@ class Engine:
                     else:
                         r.maybe_grow()
                         r._fill()
+                if (self._mega_queue and len(self._mega_queue)
+                        and self.mega_lanes > 0):
+                    # mega slots appear as their first overflow request
+                    # arrives and persist for the engine's lifetime,
+                    # like the bucket runners
+                    for i in range(self.mega_lanes):
+                        mkey = ("mega-slot", i)
+                        mr = runners.get(mkey)
+                        if mr is None:
+                            runners[mkey] = MegaLaneRunner(
+                                self, i, self._mega_queue, writer)
+                        else:
+                            mr._fill()
                 live = [r for r in runners.values() if r.has_work()]
                 if not live:
                     with self._cond:
-                        if self._draining and not any(
-                                q for q in self._queues.values()):
+                        if (self._draining
+                                and not any(
+                                    q for q in self._queues.values())
+                                and not (self._mega_queue
+                                         and len(self._mega_queue))):
                             break
                         # parked: a submit()/begin_drain() notify wakes us;
                         # the timeout only bounds lost-wakeup worst cases
@@ -1681,8 +2272,12 @@ class Engine:
         with self._lock:
             by_status = collections.Counter(
                 r["status"] for r in self._records)
+            by_placement = collections.Counter(
+                r["placement"] for r in self._records
+                if r.get("placement"))
             n = len(self._records)
-            queued = sum(len(q) for q in self._queues.values())
+            queued = (sum(len(q) for q in self._queues.values())
+                      + (len(self._mega_queue) if self._mega_queue else 0))
         # observatory snapshots AFTER the engine lock is released
         # (engine -> prof lock order; see Engine.__init__)
         obs = self.prof.summary(wall_clock())
@@ -1695,6 +2290,9 @@ class Engine:
                 "policy": self.scfg.policy,
                 "lane_kernel": self.scfg.lane_kernel,
                 "lane_kernel_fallbacks": self.lane_kernel_fallbacks,
+                "placement": dict(by_placement),
+                "mega_lanes": self.mega_lanes,
+                "mega_compiles": self.mega_compiles,
                 "queued_now": queued,
                 "lane_grows": self.lane_grows,
                 "step_compiles": self.step_compiles,

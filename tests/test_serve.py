@@ -303,12 +303,16 @@ def test_summary_surfaces_dispatch_counters():
 
 
 def test_bucket_overflow_is_a_per_request_rejection():
-    eng = Engine(quiet(buckets=(32, 64)))
+    # mega_lanes=0 pins the single-tier shape (ISSUE 10: on a
+    # multi-device host, overflow otherwise runs as a sharded mega-lane
+    # — tests/test_serve_mega.py owns that path)
+    eng = Engine(quiet(buckets=(32, 64), mega_lanes=0))
     big = eng.submit(HeatConfig(n=100, ntime=5))
     ok = eng.submit(HeatConfig(n=16, ntime=5, dtype="float64"))
     recs = {r["id"]: r for r in eng.results()}
     assert recs[big]["status"] == "rejected"
     assert "bucket-overflow" in recs[big]["error"]
+    assert recs[big]["hint"] == "enable --mega-lanes"
     assert recs[ok]["status"] == "ok"  # the engine kept serving
 
 
@@ -490,8 +494,19 @@ def test_serve_lab_ab_harness_smoke(tmp_path, capsys):
         sys.path.remove(str(bench_dir))
     rec = json.loads(out.read_text())
     assert rec["bench"] == "serve_lab"
+    # the 2 permanently-oversized requests (ISSUE 10): served as mega
+    # lanes on this 8-device harness, rejected-with-hint on one device
+    over = rec["oversized"]
+    assert over["count"] == 2
+    if over["expected"] == "mega":
+        exp_ok, exp_rej = 6 + 2, 0
+        assert over["statuses"] == ["ok"] * 4
+    else:
+        exp_ok, exp_rej = 6, 2
+        assert over["hint_present"] is True
     for side in ("engine", "engine_sync"):
-        assert rec[side]["ok"] == 6
+        assert rec[side]["ok"] == exp_ok
+        assert rec[side]["rejected"] == exp_rej
         assert rec[side]["bit_identical_sample"] is True
         assert rec[side]["boundary_wait_s"] >= 0
         assert "device_idle_frac_est" in rec[side]
