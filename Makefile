@@ -5,7 +5,7 @@ PY ?= python
 # tier1 needs pipefail (a dash /bin/sh has no `set -o pipefail`)
 SHELL := /bin/bash
 
-.PHONY: test tier1 chaos lint bench bench-all bench-smoke chip-check \
+.PHONY: test tier1 chaos lint check bench bench-all bench-smoke chip-check \
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
@@ -38,6 +38,12 @@ lint:           # ruff when installed; syntax-level fallback otherwise
 	  echo "lint: ruff not installed — falling back to compileall syntax check"; \
 	  $(PY) -m compileall -q heat_tpu tests benchmarks; \
 	fi
+
+check: lint     # the invariant gate (ISSUE 11): generic lint + the
+                # project-native analyzer (hot-path purity, lock
+                # discipline, traced determinism, Mosaic kernel safety)
+                # + the record-schema drift gate — all in heat-tpu check
+	$(PY) -m heat_tpu check
 
 bench:
 	$(PY) bench.py

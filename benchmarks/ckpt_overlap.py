@@ -79,9 +79,8 @@ def main() -> int:
                                          / "ckpt_overlap.json"))
     args = ap.parse_args()
 
-    import numpy as np
-
     import jax
+    import numpy as np
 
     from heat_tpu.config import HeatConfig
     from heat_tpu.runtime import checkpoint

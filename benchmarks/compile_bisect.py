@@ -59,9 +59,10 @@ def child(k: int, n: int, steps: int, smoke: bool,
 
     import jax
 
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from heat_tpu.backends.sharded import make_padded_carry_machinery
     from heat_tpu.config import HeatConfig
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     if smoke or topology:
         jax.config.update("jax_platforms", "cpu")

@@ -147,9 +147,17 @@ phase trace_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/trace_over
 # dispatch depths 0 and 2, with the usage ledger reconciling exactly
 # against the per-record stamps. CPU-world: runs with the tunnel down.
 phase prof_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/prof_overhead_lab.py
+# Invariant guard (ISSUE 11): lint + the project-native static-analysis
+# suite (hot-path purity, lock discipline, traced-code determinism,
+# Mosaic kernel safety) + the record-schema drift gate. Pure AST — no
+# device, seconds of wall — so it runs first among the gates and with
+# the tunnel down.
+phase static_check 600 make check
 # Perf regression gate (ISSUE 8): fresh prof_overhead_lab vs the
 # committed baseline within a tolerance band, every committed lab's
-# internal gates re-validated, and the online cost model cross-checked
-# against calibration_v5e.json (hard gate on TPU, informational on CPU).
+# internal gates re-validated, the online cost model cross-checked
+# against calibration_v5e.json (hard gate on TPU, informational on
+# CPU), and (ISSUE 11) the HEAT_TPU_LOCKCHECK=1 lock-order watchdog's
+# serve-wave overhead verified noise-level with zero inversions.
 phase perfcheck 1800 env JAX_PLATFORMS=cpu python -m heat_tpu perfcheck
 echo "=== extras_r5c done at $(date)"

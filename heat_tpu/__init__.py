@@ -14,8 +14,8 @@ backends over one core:
 See SURVEY.md at the repo root for the reference analysis this build follows.
 """
 
-from .config import HeatConfig, parse_input, variant_config, VARIANTS  # noqa: F401
+from .backends import SolveResult, solve  # noqa: F401
+from .config import VARIANTS, HeatConfig, parse_input, variant_config  # noqa: F401
 from .grid import coords, initial_condition  # noqa: F401
-from .backends import solve, SolveResult  # noqa: F401
 
 __version__ = "0.1.0"

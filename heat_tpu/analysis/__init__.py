@@ -1,0 +1,36 @@
+"""Invariant guard: the project-native static-analysis suite.
+
+Ten PRs in, the serving stack's hardest-won properties were enforced
+only by convention — the zero-sync hot loop, the engine→observatory
+lock order, the Mosaic kernel hardening lessons, the never-flickering
+record schema. Dapper's lesson (PAPERS.md) is that cross-cutting
+guarantees survive only when checked mechanically at every site; this
+package is that check, exposed as ``heat-tpu check`` / ``make check``.
+
+Five rule families (one module each, registered into
+``core.RULE_FAMILIES``):
+
+====================  =====================================================
+``hot-path-purity``   no device syncs / eager fetches in the serve
+                      dispatch paths outside the allow-marked seams
+``lock-discipline``   gateway < engine < observatory lock order, no
+                      I/O/device work under the engine lock (static
+                      half; ``HEAT_TPU_LOCKCHECK=1`` arms the dynamic
+                      watchdog in ``runtime/debug.py``)
+``traced-determinism``  no clocks/entropy/env/set-iteration reachable
+                      from jit / pallas_call / shard_map entries
+``mosaic-kernel-safety``  the PR-9 Mosaic lessons as lints over
+                      ``ops/pallas_stencil.py`` kernel bodies
+``record-schema``     every ``json_record`` site statically resolved and
+                      gated against ``analysis/schemas/records.json``
+====================  =====================================================
+
+Sanctioned exceptions carry ``# heat-tpu: allow[rule-id] reason`` markers
+next to the code (reason mandatory). The suite is pure ``ast`` — it lints
+a tree it never imports, so it runs in seconds with no device, no JAX
+session, and inside CI's smallest box.
+"""
+
+from . import determinism, locks, mosaic, purity, schema  # noqa: F401
+from .core import (RULE_DOCS, RULE_FAMILIES, Context, Violation,  # noqa: F401
+                   run_checks)

@@ -1,0 +1,254 @@
+"""Shared infrastructure for the invariant-guard checker suite.
+
+Everything here is plain ``ast`` walking over the package source — the
+checkers never import the modules they analyze (an analyzer that needs a
+working JAX install to lint a file cannot run in a broken tree, which is
+exactly when you want it). The pieces:
+
+- :class:`Violation` — one finding, formatted ``path:line: [rule] msg``.
+- :class:`Source` — a parsed file: AST (with parent/qualname annotations),
+  raw lines, and the **allowlist markers** extracted from comments.
+- :class:`Context` — every Source under the scanned root plus per-run
+  options; rules receive it whole (the lock and schema rules are
+  cross-file by nature).
+- :func:`run_checks` — load, dispatch to the registered rule families,
+  filter allow-marked findings, return the survivors.
+
+Allowlist marker grammar (the sanctioned-seam escape hatch)::
+
+    some_call()   # heat-tpu: allow[rule-id] why this site is sanctioned
+
+The marker covers the physical lines of the statement it sits on (or the
+statement directly below, when written on its own line). The reason text
+is MANDATORY — a bare marker is itself a violation: the whole point is
+that every exception to an invariant carries its justification next to
+the code, reviewable in the same diff.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
+
+_MARKER_RE = re.compile(
+    r"#\s*heat-tpu:\s*allow\[(?P<rule>[a-z0-9-]+)\]\s*(?P<reason>.*)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding. ``rule`` is the family id (``RULE_FAMILIES`` key);
+    ``kind`` a finer sub-rule slug carried in the message for families
+    with several detectors (mosaic-kernel-safety)."""
+
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Source:
+    """One parsed Python file with qualname-annotated AST and markers."""
+
+    def __init__(self, root: Path, path: Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(path))
+        self._annotate()
+        # lineno -> {rule_id: reason}; rule "*" would defeat the point and
+        # is deliberately not supported.
+        self.allows: Dict[int, Dict[str, str]] = {}
+        self.bare_markers: List[int] = []
+        for i, line in enumerate(self.lines, 1):
+            m = _MARKER_RE.search(line)
+            if not m:
+                continue
+            if not m.group("reason").strip():
+                self.bare_markers.append(i)
+                continue
+            self.allows.setdefault(i, {})[m.group("rule")] = (
+                m.group("reason").strip())
+
+    def _annotate(self) -> None:
+        """Attach ``_qualname`` to every FunctionDef and ``_parent`` to
+        every node (the purity/mosaic scopes are qualname lists; parents
+        let detectors look outward from a match)."""
+
+        def visit(node, parents: Tuple[str, ...]):
+            for child in ast.iter_child_nodes(node):
+                child._parent = node  # type: ignore[attr-defined]
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    q = parents + (child.name,)
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        child._qualname = ".".join(q)  # type: ignore
+                    visit(child, q)
+                else:
+                    visit(child, parents)
+
+        self.tree._parent = None  # type: ignore[attr-defined]
+        visit(self.tree, ())
+
+    def functions(self) -> List[ast.FunctionDef]:
+        return [n for n in ast.walk(self.tree)
+                if isinstance(n, ast.FunctionDef)]
+
+    def allowed(self, rule: str, node: ast.AST) -> bool:
+        """Is this node's finding covered by an allow marker? Checked on
+        every physical line the statement spans plus the line above it
+        (a marker on its own line annotates the statement below)."""
+        lo = getattr(node, "lineno", 0)
+        hi = getattr(node, "end_lineno", lo) or lo
+        for ln in range(max(1, lo - 1), hi + 1):
+            if rule in self.allows.get(ln, {}):
+                return True
+        return False
+
+
+class Context:
+    """All sources under one root + run options, handed to every rule."""
+
+    def __init__(self, root: Path, schema_registry: Optional[Path] = None,
+                 update_schemas: bool = False):
+        self.root = Path(root)
+        self.schema_registry = (Path(schema_registry) if schema_registry
+                                else self.root / "analysis" / "schemas"
+                                / "records.json")
+        self.update_schemas = update_schemas
+        self.sources: List[Source] = []
+        self.errors: List[Violation] = []
+        for p in sorted(self.root.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            try:
+                self.sources.append(Source(self.root, p))
+            except (SyntaxError, UnicodeDecodeError) as e:
+                self.errors.append(Violation(
+                    "parse", p.relative_to(self.root).as_posix(),
+                    getattr(e, "lineno", 0) or 0,
+                    f"cannot parse: {type(e).__name__}: {e}"))
+
+    def source(self, rel_suffix: str) -> Optional[Source]:
+        """The unique source whose relative path ends with ``rel_suffix``
+        (e.g. ``serve/scheduler.py``), or None."""
+        hits = [s for s in self.sources if s.rel.endswith(rel_suffix)]
+        return hits[0] if len(hits) == 1 else None
+
+
+# --- small AST helpers shared by the rule modules ---------------------------
+
+def call_name(node: ast.Call) -> str:
+    """The called name: ``f`` for ``f(...)``, ``attr`` for ``x.y.attr(...)``."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def attr_chain(node: ast.AST) -> List[str]:
+    """``["self", "prof", "note_terminal"]`` for ``self.prof.note_terminal``;
+    empty when the expression is not a plain name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def dotted(node: ast.AST) -> str:
+    return ".".join(attr_chain(node))
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.FunctionDef]:
+    cur = getattr(node, "_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.FunctionDef):
+            return cur
+        cur = getattr(cur, "_parent", None)
+    return None
+
+
+# --- registry ----------------------------------------------------------------
+
+# family id -> check(ctx) -> List[Violation]; populated by register() calls
+# at the bottom of each rule module (importing heat_tpu.analysis loads all).
+RULE_FAMILIES: Dict[str, Callable[[Context], List[Violation]]] = {}
+RULE_DOCS: Dict[str, str] = {}
+
+
+def register(rule_id: str, doc: str):
+    def deco(fn):
+        RULE_FAMILIES[rule_id] = fn
+        RULE_DOCS[rule_id] = doc
+        return fn
+    return deco
+
+
+def run_checks(root, rules: Optional[List[str]] = None,
+               schema_registry=None, update_schemas: bool = False
+               ) -> Tuple[List[Violation], dict]:
+    """Run the requested rule families (default: all) over ``root``.
+
+    Returns ``(violations, stats)``. Allow-marked findings are dropped
+    here (every rule reports raw and this one chokepoint applies the
+    markers, so marker semantics cannot drift per rule); a marker with
+    no reason text is converted into its own violation.
+    """
+    from . import determinism, locks, mosaic, purity, schema  # noqa: F401
+    # (imports register the families; flake-quiet because the side effect
+    # IS the point)
+
+    ctx = Context(root, schema_registry=schema_registry,
+                  update_schemas=update_schemas)
+    selected = list(RULE_FAMILIES) if not rules else list(rules)
+    unknown = [r for r in selected if r not in RULE_FAMILIES]
+    if unknown:
+        raise ValueError(f"unknown rule families {unknown}; "
+                         f"known: {sorted(RULE_FAMILIES)}")
+    out: List[Violation] = list(ctx.errors)
+    for src in ctx.sources:
+        for ln in src.bare_markers:
+            out.append(Violation(
+                "allow-marker", src.rel, ln,
+                "allow marker without a reason — every sanctioned "
+                "exception must carry its justification"))
+    per_rule: Dict[str, int] = {}
+    for rid in selected:
+        found = RULE_FAMILIES[rid](ctx)
+        kept = []
+        for v in found:
+            src = next((s for s in ctx.sources if s.rel == v.path), None)
+            if src is not None and _line_allowed(src, v.rule, v.line):
+                continue
+            kept.append(v)
+        per_rule[rid] = len(kept)
+        out.extend(kept)
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    stats = {"files": len(ctx.sources), "rules": selected,
+             "violations": len(out), "per_rule": per_rule,
+             "allow_markers": sum(len(d) for s in ctx.sources
+                                  for d in s.allows.values())}
+    return out, stats
+
+
+def _line_allowed(src: Source, rule: str, line: int) -> bool:
+    # the marker may sit on the flagged line, within the two lines above
+    # (the tail of a comment block annotating a short statement pair), or
+    # — for a call spanning lines — on a trailing continuation line
+    for ln in range(line - 2, line + 3):
+        if rule in src.allows.get(ln, {}):
+            return True
+    return False

@@ -1,0 +1,226 @@
+"""Rule family 3 — **traced-code determinism** (``traced-determinism``).
+
+Everything the engine promises — bit-identical replay, golden-trace
+regression locks, the dispatch-ahead countdown mirror's exactness — rests
+on traced code being a pure function of its inputs. A ``time.time()``
+read, a ``random`` draw, or iteration over an unordered ``set`` inside a
+function that gets traced by ``jit`` / ``pallas_call`` / ``shard_map``
+bakes one arbitrary value (or one arbitrary *program order*) into the
+compiled executable: results then differ between compiles, the
+persistent-cache key stops meaning anything, and the byte-identity gates
+fail unreproducibly — the worst kind of flake.
+
+Mechanics: the rule finds trace **entry points** (functions decorated
+with ``jit``/``partial(jax.jit, ...)``, passed to ``pallas_call`` /
+``shard_map`` / ``jax.jit(...)``/``jax.vmap(...)``), builds a
+conservative same-repo call graph (name references inside the entry and
+its enclosing factory, with function-scoped ``from ..x import y`` imports
+resolved across scanned modules), and bans inside every reachable
+function:
+
+- wall-clock reads: ``time.*``, ``perf_counter``/``monotonic``,
+  ``datetime.*``, ``wall_clock`` (the engine's own clock seam);
+- entropy: ``random.*``, ``np.random.*``, ``secrets.*``, ``uuid.*``;
+- environment reads: ``os.environ`` / ``os.getenv`` (a traced branch on
+  an env var is a compile-time fork nobody versioned);
+- iteration over an unordered ``set`` (``for x in set(...)``, set
+  literals/comprehensions) — ``sorted(...)`` around it is the fix and
+  passes automatically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Context, Violation, attr_chain, register
+
+_CLOCK_BASES = {"time", "datetime"}
+_ENTROPY_BASES = {"random", "secrets", "uuid"}
+_BANNED_NAMES = {"perf_counter", "monotonic", "wall_clock", "time_ns",
+                 "getenv"}
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    chain = attr_chain(dec)
+    if chain and chain[-1] in ("jit", "pallas_call", "shard_map"):
+        return True
+    if isinstance(dec, ast.Call):
+        # functools.partial(jax.jit, ...) and jax.jit(...) forms
+        fchain = attr_chain(dec.func)
+        if fchain and fchain[-1] in ("jit", "pallas_call", "shard_map"):
+            return True
+        if fchain and fchain[-1] == "partial" and dec.args:
+            achain = attr_chain(dec.args[0])
+            if achain and achain[-1] in ("jit", "pallas_call",
+                                         "shard_map"):
+                return True
+    return False
+
+
+def _entry_functions(src) -> List[ast.FunctionDef]:
+    entries = []
+    byname: Dict[str, ast.FunctionDef] = {f.name: f
+                                          for f in src.functions()}
+    for fn in src.functions():
+        if any(_is_jit_decorator(d) for d in fn.decorator_list):
+            entries.append(fn)
+    # functions passed by name into jit/pallas_call/shard_map/vmap calls
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if not chain or chain[-1] not in ("jit", "pallas_call",
+                                          "shard_map", "vmap"):
+            continue
+        for arg in node.args[:1]:
+            ref = None
+            if isinstance(arg, ast.Name):
+                ref = arg.id
+            elif isinstance(arg, ast.Call):
+                # pallas_call(_make_kernel(...)) — the factory's inner
+                # defs are the kernel bodies
+                achain = attr_chain(arg.func)
+                ref = achain[-1] if achain else None
+            if ref and ref in byname:
+                f = byname[ref]
+                entries.append(f)
+                entries.extend(n for n in ast.walk(f)
+                               if isinstance(n, ast.FunctionDef))
+    return entries
+
+
+def _function_scope_imports(fn: ast.FunctionDef) -> Dict[str, str]:
+    """name -> source module tail, for ``from ..x.y import name`` inside
+    the function (the deferred-import idiom this repo uses)."""
+    out = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                out[alias.asname or alias.name] = (
+                    node.module.rsplit(".", 1)[-1] + ":" + alias.name)
+    return out
+
+
+def _reachable(ctx: Context, src, entry: ast.FunctionDef
+               ) -> List[Tuple[object, ast.FunctionDef]]:
+    """(source, function) pairs conservatively reachable from ``entry``:
+    same-module functions referenced by name from the entry or its
+    enclosing factory chain, plus cross-module functions named in
+    function-scoped imports, one hop deep per module."""
+    by_src: Dict[str, Dict[str, ast.FunctionDef]] = {}
+    mod_of: Dict[str, List] = {}
+    for s in ctx.sources:
+        by_src[s.rel] = {f.name: f for f in s.functions()}
+        mod_of.setdefault(s.path.stem, []).append(s)
+
+    seen: Set[Tuple[str, str]] = set()
+    work: List[Tuple[object, ast.FunctionDef]] = [(src, entry)]
+    # the enclosing factory's locals (step_all = vmap(partial(f, ...)))
+    # bind helpers the entry calls through; include the factory itself
+    parent = getattr(entry, "_parent", None)
+    while parent is not None:
+        if isinstance(parent, ast.FunctionDef):
+            work.append((src, parent))
+        parent = getattr(parent, "_parent", None)
+    out = []
+    while work:
+        s, fn = work.pop()
+        key = (s.rel, getattr(fn, "_qualname", fn.name))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append((s, fn))
+        imports = _function_scope_imports(fn)
+        names = {n.id for n in ast.walk(fn) if isinstance(n, ast.Name)}
+        local = by_src[s.rel]
+        for name in names:
+            if name in local and local[name] is not fn:
+                work.append((s, local[name]))
+            elif name in imports:
+                mod_tail, fname = imports[name].split(":")
+                for cand in mod_of.get(mod_tail, []):
+                    f2 = by_src[cand.rel].get(fname)
+                    if f2 is not None:
+                        work.append((cand, f2))
+    return out
+
+
+def _check_body(src, fn: ast.FunctionDef, entry_q: str,
+                out: List[Violation], seen: Set) -> None:
+    q = getattr(fn, "_qualname", fn.name)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            base = chain[0] if chain else ""
+            leaf = chain[-1] if chain else ""
+            bad = None
+            if base in _CLOCK_BASES or leaf in _BANNED_NAMES & {
+                    "perf_counter", "monotonic", "wall_clock", "time_ns"}:
+                bad = "wall-clock read"
+            elif base in _ENTROPY_BASES or (
+                    len(chain) >= 2 and chain[:2] == ["np", "random"]):
+                bad = "entropy source"
+            elif leaf == "getenv" or (len(chain) >= 2
+                                      and chain[-2:] == ["os", "environ"]):
+                bad = "environment read"
+            if bad:
+                key = (src.rel, node.lineno, bad)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(Violation(
+                        "traced-determinism", src.rel, node.lineno,
+                        f"{bad} `{'.'.join(chain)}` in {q}, reachable "
+                        f"from traced entry {entry_q} — traced code must "
+                        f"be a pure function of its inputs (hoist the "
+                        f"value to an argument)"))
+        if isinstance(node, ast.Attribute) and node.attr == "environ":
+            chain = attr_chain(node)
+            if chain[:1] == ["os"]:
+                key = (src.rel, node.lineno, "environ")
+                if key not in seen:
+                    seen.add(key)
+                    out.append(Violation(
+                        "traced-determinism", src.rel, node.lineno,
+                        f"environment read `os.environ` in {q}, "
+                        f"reachable from traced entry {entry_q} — a "
+                        f"traced env branch is an unversioned "
+                        f"compile-time fork"))
+        it = None
+        if isinstance(node, (ast.For,)):
+            it = node.iter
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                               ast.DictComp)):
+            it = node.generators[0].iter
+        if it is not None and _is_unordered(it):
+            key = (src.rel, it.lineno, "set-iter")
+            if key not in seen:
+                seen.add(key)
+                out.append(Violation(
+                    "traced-determinism", src.rel, it.lineno,
+                    f"iteration over an unordered set in {q}, reachable "
+                    f"from traced entry {entry_q} — program order bakes "
+                    f"into the compiled executable; wrap in sorted()"))
+
+
+def _is_unordered(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        return bool(chain) and chain[-1] in ("set", "frozenset")
+    return False
+
+
+@register("traced-determinism",
+          "no clocks/entropy/env reads/set iteration reachable from "
+          "jit/pallas_call/shard_map entry points")
+def check(ctx: Context) -> List[Violation]:
+    out: List[Violation] = []
+    seen: Set = set()
+    for src in ctx.sources:
+        for entry in _entry_functions(src):
+            entry_q = getattr(entry, "_qualname", entry.name)
+            for s, fn in _reachable(ctx, src, entry):
+                _check_body(s, fn, f"{src.rel}:{entry_q}", out, seen)
+    return out

@@ -1,0 +1,270 @@
+"""Rule family 4 — **Mosaic kernel safety** (``mosaic-kernel-safety``).
+
+PR 9 hardened the lane kernels against the *real* Mosaic compiler the
+hard way: interpret-mode tier-1 passed while chipless v5e AOT compiles
+rejected the kernels one missing lowering at a time. Each lesson became a
+code pattern; this rule codifies them as lints scoped to the kernel
+bodies of ``ops/pallas_stencil.py`` so the next kernel author hits a
+``heat-tpu check`` failure in seconds instead of a Mosaic stack trace in
+the compile-check lab (or worse, at serve time on a chip):
+
+- ``isfinite``: no ``jnp.isfinite`` / ``lax.is_finite`` in a kernel body
+  — Mosaic has no ``is_finite`` lowering; spell it ``|x| < inf`` (false
+  for NaN and both infinities — compares with NaN are false).
+- ``narrow-select``: no ``jnp.where`` whose operand was just downcast to
+  a sub-32-bit dtype — Mosaic rejects sub-32-bit selects; keep the band
+  in the 32-bit accumulation dtype holding storage-rounded values
+  (``.astype(store).astype(acc)``) and select in 32 bits.
+- ``multiply-mask``: no mask-multiplied updates (``mask * upd`` where the
+  mask derives from a comparison or a 0/1 ``where``) in lane kernels —
+  ``0 * NaN`` is NaN, so a poisoned lane leaks through the very mask
+  meant to confine it; use a select (``jnp.where(keep, upd, band)``).
+  The *solo* kernels' multiplicative freeze is allow-marked: their bands
+  are NaN-free by construction (no foreign lanes) and the form is the
+  reference's interior guard.
+- ``shrinking-roll``: no rotates of *shrunken* slices — a roll whose
+  operand traces back to a bounded-slice subscript hands Mosaic a
+  sublane-misaligned rotate shape, rejected outright by current
+  compilers; run constant-shape full-band rotates every mini-step (the
+  lane kernels' proven shape discipline). The solo 3D kernel's aligned
+  shrinking slices predate the rule and are allow-marked with the lab
+  that proves them.
+
+Kernel bodies are found structurally: functions passed (directly or via
+a ``_make_*`` factory call) as the first argument of ``pl.pallas_call``,
+every ``def`` nested inside those factories, plus same-file helpers the
+bodies call (``_lane_finite_accumulate``, ``_assemble_band``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Context, Violation, attr_chain, register
+
+_ACC_NAMES = {"acc_dt", "acc", "accum", "float32", "f32", "int32"}
+_NARROW_NAMES = {"store_dt", "store", "bfloat16", "float16", "bf16", "f16"}
+
+
+def _kernel_bodies(src) -> List[ast.FunctionDef]:
+    byname: Dict[str, ast.FunctionDef] = {f.name: f for f in src.functions()}
+    roots: List[ast.FunctionDef] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if not chain or chain[-1] != "pallas_call":
+            continue
+        arg = node.args[0] if node.args else None
+        ref: Optional[str] = None
+        if isinstance(arg, ast.Name):
+            ref = arg.id
+        elif isinstance(arg, ast.Call):
+            achain = attr_chain(arg.func)
+            ref = achain[-1] if achain else None
+        if ref and ref in byname:
+            roots.append(byname[ref])
+    bodies: List[ast.FunctionDef] = []
+    seen: Set[str] = set()
+    work = list(roots)
+    while work:
+        fn = work.pop()
+        q = getattr(fn, "_qualname", fn.name)
+        if q in seen:
+            continue
+        seen.add(q)
+        bodies.append(fn)
+        # nested defs (the factory's inner `kernel`) and same-file helper
+        # calls from the body
+        for inner in ast.walk(fn):
+            if isinstance(inner, ast.FunctionDef) and inner is not fn:
+                work.append(inner)
+            if isinstance(inner, ast.Call):
+                chain = attr_chain(inner.func)
+                if chain and chain[-1] in byname:
+                    work.append(byname[chain[-1]])
+    return bodies
+
+
+def _bindings(fn: ast.FunctionDef) -> Dict[str, List[ast.AST]]:
+    """name -> every expression assigned to it in this function (simple
+    single-target assignments only) — the one-hop dataflow the detectors
+    resolve Names through."""
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            out.setdefault(node.targets[0].id, []).append(node.value)
+    return out
+
+
+def _resolve(expr: ast.AST, env: Dict[str, List[ast.AST]],
+             depth: int = 0, seen: Optional[Set[str]] = None
+             ) -> List[ast.AST]:
+    """The expression plus everything its Names bind to (bounded)."""
+    if seen is None:
+        seen = set()
+    out = [expr]
+    if depth >= 4:
+        return out
+    for name_node in ast.walk(expr):
+        if isinstance(name_node, ast.Name) and name_node.id not in seen:
+            seen.add(name_node.id)
+            for bound in env.get(name_node.id, []):
+                out.extend(_resolve(bound, env, depth + 1, seen))
+    return out
+
+
+def _mask_sources(expr: ast.AST, env, depth: int = 0):
+    """Yield the *top-level* expressions a mult operand ultimately names,
+    unwrapping ``.astype(...)`` chains and subscripts and following Name
+    bindings a few hops — deliberately shallow (no subtree walking): a
+    select result that merely *contains* a comparison deep inside is not
+    a mask, but a value whose top node IS a comparison (or a 0-branch
+    where) is."""
+    if depth > 3:
+        return
+    e = expr
+    while True:
+        if (isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute)
+                and e.func.attr == "astype"):
+            e = e.func.value
+            continue
+        if isinstance(e, ast.Subscript):
+            e = e.value
+            continue
+        break
+    if isinstance(e, ast.Name):
+        for bound in env.get(e.id, []):
+            yield from _mask_sources(bound, env, depth + 1)
+        return
+    yield e
+
+
+def _num_const(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float))
+    return (isinstance(node, ast.UnaryOp)
+            and isinstance(node.operand, ast.Constant))
+
+
+def _is_masky(expr: ast.AST, env) -> bool:
+    """Is this mult operand a *mask* (comparison-derived, or a where with
+    a constant branch — the ``where(frozen, 0.0, r)`` freeze form) that
+    multiplication would leak ``0 * NaN`` through?"""
+    for e in _mask_sources(expr, env):
+        if isinstance(e, ast.Compare):
+            return True
+        if isinstance(e, ast.Call):
+            chain = attr_chain(e.func)
+            if (chain and chain[-1] == "where"
+                    and any(_num_const(a) for a in e.args[1:3])):
+                return True
+    return False
+
+
+def _astype_target_narrow(call: ast.Call) -> bool:
+    """``x.astype(<narrow>)`` where <narrow> names a sub-32-bit dtype."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "astype" and call.args):
+        return False
+    t = call.args[0]
+    names = {n.id for n in ast.walk(t) if isinstance(n, ast.Name)}
+    names |= {n.attr for n in ast.walk(t) if isinstance(n, ast.Attribute)}
+    if isinstance(t, ast.Constant) and isinstance(t.value, str):
+        names.add(t.value)
+    return bool(names & _NARROW_NAMES) and not (names & _ACC_NAMES)
+
+
+def _has_shrunk_slice(expr: ast.AST, env) -> bool:
+    """Does the rolled operand resolve (through Name bindings) to a
+    value whose TOP-LEVEL form is a bounded-slice subscript — the
+    shrinking-band shape? Only top-level resolved expressions are
+    inspected: a helper call that merely *takes* a sliced argument
+    (``_assemble_band(refs[:9], ...)`` — a tuple-of-refs slice) is not a
+    shrunken array."""
+    for e in _resolve(expr, env):
+        while (isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute)
+               and e.func.attr == "astype"):
+            e = e.func.value
+        if isinstance(e, ast.Subscript):
+            sl = e.slice
+            elts = (sl.elts if isinstance(sl, ast.Tuple) else [sl])
+            for part in elts:
+                if isinstance(part, ast.Slice) and (
+                        part.lower is not None
+                        or part.upper is not None):
+                    return True
+    return False
+
+
+@register("mosaic-kernel-safety",
+          "PR-9 Mosaic lessons as lints over pallas_stencil kernel "
+          "bodies: no isfinite, no sub-32-bit select, no multiply-"
+          "masking, no shrinking-slice rotates")
+def check(ctx: Context) -> List[Violation]:
+    out: List[Violation] = []
+    reported: Set[Tuple[str, int, str]] = set()
+
+    def emit(src, lineno, kind, msg):
+        key = (src.rel, lineno, kind)
+        if key in reported:
+            return
+        reported.add(key)
+        out.append(Violation("mosaic-kernel-safety", src.rel, lineno, msg))
+
+    for src in ctx.sources:
+        if not src.rel.endswith("ops/pallas_stencil.py"):
+            continue
+        for fn in _kernel_bodies(src):
+            env = _bindings(fn)
+            q = getattr(fn, "_qualname", fn.name)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    chain = attr_chain(node.func)
+                    leaf = chain[-1] if chain else ""
+                    if leaf in ("isfinite", "is_finite"):
+                        emit(src, node.lineno, "isfinite",
+                             f"isfinite: `{'.'.join(chain)}` in kernel "
+                             f"body {q} — Mosaic has no is_finite "
+                             f"lowering; spell it `|x| < inf` (false for "
+                             f"NaN and both infinities)")
+                    elif leaf == "where" and chain[0] in ("jnp", "lax",
+                                                          "jax"):
+                        for arg in node.args[1:3]:
+                            narrow = any(
+                                isinstance(e, ast.Call)
+                                and _astype_target_narrow(e)
+                                for e in _resolve(arg, env))
+                            if narrow:
+                                emit(src, node.lineno, "narrow-select",
+                                     f"narrow-select: jnp.where over a "
+                                     f"sub-32-bit operand in kernel body "
+                                     f"{q} — Mosaic rejects sub-32-bit "
+                                     f"selects; round through storage "
+                                     f"but select in the 32-bit "
+                                     f"accumulation dtype "
+                                     f"(.astype(store).astype(acc))")
+                                break
+                    elif leaf == "roll":
+                        if node.args and _has_shrunk_slice(node.args[0],
+                                                           env):
+                            emit(src, node.lineno, "shrinking-roll",
+                                 f"shrinking-roll: rotate of a shrunken "
+                                 f"slice in kernel body {q} — sublane-"
+                                 f"misaligned rotate shapes are rejected "
+                                 f"by Mosaic; use constant-shape "
+                                 f"full-band rotates every mini-step")
+                if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                              ast.Mult):
+                    for side in (node.left, node.right):
+                        if _is_masky(side, env):
+                            emit(src, node.lineno, "multiply-mask",
+                                 f"multiply-mask: mask-multiplied update "
+                                 f"in kernel body {q} — 0*NaN is NaN, so "
+                                 f"a poisoned value leaks through the "
+                                 f"mask; select instead "
+                                 f"(jnp.where(keep, upd, band))")
+                            break
+    return out

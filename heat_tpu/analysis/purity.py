@@ -1,0 +1,131 @@
+"""Rule family 1 — **hot-path purity** (``hot-path-purity``).
+
+The serve hot loop's whole performance story (the dispatch-ahead rework,
+PR 4; the roofline argument in PAPERS.md) rests on one discipline: the
+dispatch and round-robin paths enqueue device work and NEVER wait on it —
+the only device→host transfer is the boundary fetch, funneled through the
+``host_fetch`` / ``fetch_boundary`` seams so it can be watchdogged,
+traced, and monkeypatch-proven. One stray ``.item()`` or eager
+``jnp.asarray`` in ``dispatch_fill`` silently re-fences every chunk and
+the A/B labs degrade to the sync fallback without anyone changing a flag.
+
+This rule bans, inside the **hot function set** (the dispatch/round-robin
+paths of ``serve/scheduler.py`` and the chunk-program builders of
+``serve/engine.py``):
+
+- ``.item()`` / ``.block_until_ready()`` / ``jax.device_get`` — explicit
+  device syncs;
+- ``np.asarray`` / ``np.array`` / ``jnp.asarray`` / ``jnp.array`` /
+  direct ``host_fetch`` — eager host round trips of device buffers;
+- any eager ``jnp.*`` call in the *scheduler-side* hot functions (every
+  ``jnp`` dispatch there is a python→device round trip; traced builder
+  bodies are exempt — their ``jnp`` is staged, not eager);
+- ``float(...)`` / ``int(...)`` applied to a boundary ``handle`` (the
+  classic scalarization sync).
+
+The sanctioned seams — ``host_fetch``, ``fetch_boundary``,
+``LaneEngine.fetch_remaining`` — are *in* the hot set and carry explicit
+``# heat-tpu: allow[hot-path-purity]`` markers: the rule proves every
+other site clean and the markers document why those three are the
+exception (ISSUE 11's allowlist contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import (Context, Violation, attr_chain, call_name, dotted,
+                   register)
+
+# qualnames (suffix-matched against FunctionDef._qualname) per file.
+# Scheduler side: eager jnp is banned too. Builder side: only the
+# sync/round-trip calls (their bodies are traced — jnp there is staged).
+SCHEDULER_HOT = (
+    "_GroupRunner.dispatch_fill", "_GroupRunner.process_boundary",
+    "_GroupRunner.sync_round", "_GroupRunner._judge_lanes",
+    "_GroupRunner._maybe_poison",
+    "MegaLaneRunner.dispatch_fill", "MegaLaneRunner.process_boundary",
+    "MegaLaneRunner.sync_round", "MegaLaneRunner._judge",
+    "MegaLaneRunner._maybe_poison",
+    "Engine.run", "Engine._serve_loop",
+)
+ENGINE_HOT = (
+    "LaneEngine.dispatch_chunk", "MegaLaneEngine.dispatch_chunk",
+    "make_lane_advance", "make_lane_loader", "_lane_step",
+    # the sanctioned seams themselves — their D2H calls carry markers
+    "host_fetch", "fetch_boundary", "LaneEngine.fetch_remaining",
+)
+
+_SYNC_CALLS = {"item", "block_until_ready", "device_get"}
+_FETCH_CALLS = {"asarray", "array", "host_fetch"}
+_ARRAY_MODULES = {"np", "numpy", "jnp"}
+
+
+def _hot_functions(src, quals):
+    for fn in src.functions():
+        q = getattr(fn, "_qualname", fn.name)
+        for want in quals:
+            if q == want or q.endswith("." + want):
+                yield fn, want
+                break
+
+
+def _check_fn(src, fn: ast.FunctionDef, ban_eager_jnp: bool,
+              out: List[Violation]) -> None:
+    seen_lines = set()
+
+    def report(node, msg):
+        key = (node.lineno, msg)
+        if key in seen_lines:
+            return
+        seen_lines.add(key)
+        out.append(Violation("hot-path-purity", src.rel, node.lineno, msg))
+
+    q = getattr(fn, "_qualname", fn.name)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        chain = attr_chain(node.func)
+        if name in _SYNC_CALLS:
+            report(node, f"device sync `{dotted(node.func) or name}()` in "
+                         f"hot function {q} — the dispatch path must "
+                         f"never fence (route through the boundary-fetch "
+                         f"seam)")
+        elif name in _FETCH_CALLS and (
+                name == "host_fetch"
+                or (chain and chain[0] in _ARRAY_MODULES)):
+            report(node, f"eager host round trip "
+                         f"`{dotted(node.func) or name}(...)` in hot "
+                         f"function {q} — the only sanctioned D2H is the "
+                         f"host_fetch/fetch_boundary seam")
+        elif (ban_eager_jnp and chain and chain[0] == "jnp"
+              and len(chain) >= 2):
+            report(node, f"eager `{'.'.join(chain)}` dispatch in "
+                         f"scheduler hot function {q} — every jnp call "
+                         f"here is a python->device round trip per "
+                         f"boundary (use numpy on the host mirror, or "
+                         f"move it into the compiled chunk program)")
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in ("float", "int") and node.args):
+            arg_names = {n.id for n in ast.walk(node.args[0])
+                         if isinstance(n, ast.Name)}
+            if arg_names & {"handle", "boundary_handle"}:
+                report(node, f"`{node.func.id}()` scalarization of a "
+                             f"device boundary handle in hot function "
+                             f"{q} — fetch through the seam instead")
+
+
+@register("hot-path-purity",
+          "no device syncs / eager fetches in the serve dispatch paths")
+def check(ctx: Context) -> List[Violation]:
+    out: List[Violation] = []
+    for src in ctx.sources:
+        if src.rel.endswith("serve/scheduler.py"):
+            for fn, _ in _hot_functions(src, SCHEDULER_HOT):
+                _check_fn(src, fn, ban_eager_jnp=True, out=out)
+        elif src.rel.endswith("serve/engine.py"):
+            for fn, _ in _hot_functions(src, ENGINE_HOT):
+                _check_fn(src, fn, ban_eager_jnp=False, out=out)
+    return out

@@ -352,6 +352,31 @@ def build_parser() -> argparse.ArgumentParser:
                     help="baseline prof_overhead_lab JSON (default: the "
                          "committed benchmarks/prof_overhead_lab.json)")
 
+    chk = sub.add_parser(
+        "check",
+        help="invariant guard: run the project-native static-analysis "
+             "suite (heat_tpu/analysis) over the package source — "
+             "hot-path purity, lock discipline, traced-code determinism, "
+             "Mosaic kernel safety, record-schema drift. Exit 0 = clean; "
+             "pure AST, no device, runs in seconds")
+    chk.add_argument("--rules", metavar="LIST",
+                     help="comma-separated rule families to run "
+                          "(default: all; see --list-rules)")
+    chk.add_argument("--list-rules", action="store_true",
+                     help="print the rule-family table and exit")
+    chk.add_argument("--update-schemas", action="store_true",
+                     help="regenerate analysis/schemas/records.json from "
+                          "the current source instead of gating against "
+                          "it — the intentional-schema-drift workflow: "
+                          "commit the registry diff with the code change "
+                          "so consumers see the schema change reviewed")
+    chk.add_argument("--root", metavar="DIR",
+                     help="package root to analyze (default: the "
+                          "installed heat_tpu package directory)")
+    chk.add_argument("--json", action="store_true",
+                     help="machine-readable results (one JSON object: "
+                          "stats + violations)")
+
     trc = sub.add_parser(
         "trace",
         help="render a text timeline summary from a trace file (a "
@@ -959,6 +984,57 @@ def cmd_perfcheck(args) -> int:
                 check(False, "fresh-vs-baseline band",
                       "points_per_s missing from lab output")
 
+    if args.fresh:
+        # dynamic lockcheck overhead (ISSUE 11): the HEAT_TPU_LOCKCHECK=1
+        # watchdog wraps every engine/observatory lock in per-acquire
+        # bookkeeping — it must stay noise-level on a serve wave (it is
+        # meant to ride the chaos suite and soak tests, not to be a mode
+        # you budget for). Interleaved best-of-2 walls, in-process: the
+        # env flag is read at lock CREATION, so each engine picks up its
+        # own mode. Also a correctness gate: the armed waves must record
+        # zero lock-order inversions.
+        import time as _time
+
+        from .config import HeatConfig
+        from .runtime import debug as _debug
+        from .serve import Engine, ServeConfig
+
+        def _wave() -> float:
+            eng = Engine(ServeConfig(lanes=4, chunk=8, buckets=(64,),
+                                     emit_records=False))
+            for i in range(12):
+                eng.submit(HeatConfig(n=48, ntime=96, dtype="float32",
+                                      ic="hat", bc="edges"))
+            t0 = _time.perf_counter()
+            eng.run()
+            return _time.perf_counter() - t0
+
+        _debug.reset_lock_order_stats()
+        walls = {"off": [], "on": []}
+        prev = os.environ.pop("HEAT_TPU_LOCKCHECK", None)
+        try:
+            for mode in ("off", "on", "off", "on"):
+                if mode == "on":
+                    os.environ["HEAT_TPU_LOCKCHECK"] = "1"
+                else:
+                    os.environ.pop("HEAT_TPU_LOCKCHECK", None)
+                walls[mode].append(_wave())
+        finally:
+            if prev is None:
+                os.environ.pop("HEAT_TPU_LOCKCHECK", None)
+            else:
+                os.environ["HEAT_TPU_LOCKCHECK"] = prev
+        ratio = min(walls["on"]) / min(walls["off"])
+        check(_band_ok(ratio, max(args.tolerance, 0.5)),
+              "lockcheck overhead",
+              f"serve wave with the lock-order watchdog armed runs at "
+              f"{ratio:.3f}x the unarmed wall (noise-level band)")
+        stats = _debug.lock_order_stats()
+        check(not stats["violations"], "lockcheck inversions",
+              f"zero lock-order inversions under the armed waves "
+              f"(saw {len(stats['violations'])}; edges observed: "
+              f"{len(stats['edges'])})")
+
     # lane-kernel cost rows (ISSUE 9): the committed kernel A/B must be
     # internally consistent — the cost model's kernel-keyed rows imply
     # the same pallas/xla cost ratio the measured drain walls show, and
@@ -1050,6 +1126,56 @@ def cmd_perfcheck(args) -> int:
     print(f"perfcheck: {'OK' if not failed else 'FAILED'} — "
           f"{len(results) - len(failed)}/{len(results)} checks passed")
     return 0 if not failed else 1
+
+
+def cmd_check(args) -> int:
+    """The invariant guard (ISSUE 11): run the AST-based checker suite
+    over the package source. Exit codes: 0 clean, 1 violations, 2 usage
+    error — batch drivers and ``make check`` key off them."""
+    import json as _json
+
+    from .analysis import RULE_DOCS, RULE_FAMILIES, run_checks
+
+    if args.list_rules:
+        for rid in sorted(RULE_FAMILIES):
+            print(f"{rid:<22} {RULE_DOCS[rid]}")
+        return 0
+    root = Path(args.root) if args.root else Path(__file__).resolve().parent
+    if not root.is_dir():
+        print(f"error: {root} is not a directory", file=sys.stderr)
+        return 2
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        violations, stats = run_checks(root, rules=rules,
+                                       update_schemas=args.update_schemas)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(_json.dumps({"stats": stats,
+                           "violations": [dataclasses.asdict(v)
+                                          for v in violations]},
+                          sort_keys=True))
+        return 0 if not violations else 1
+    for v in violations:
+        print(v.format())
+    per = ", ".join(f"{r}={n}" for r, n in sorted(stats["per_rule"].items())
+                    if n) or "none"
+    verdict = "OK" if not violations else "FAILED"
+    print(f"heat-tpu check: {verdict} — {stats['files']} file(s), "
+          f"{len(stats['rules'])} rule famil"
+          f"{'y' if len(stats['rules']) == 1 else 'ies'}, "
+          f"{stats['allow_markers']} allow marker(s), "
+          f"{stats['violations']} violation(s)"
+          + (f" ({per})" if violations else "")
+          + ("; schema registry rewritten — review & commit the diff"
+             if args.update_schemas else ""))
+    if violations:
+        print("each line is path:line: [rule] message; sanctioned "
+              "exceptions take a `# heat-tpu: allow[rule] reason` marker "
+              "— see TROUBLESHOOTING.md 'Static analysis'")
+    return 0 if not violations else 1
 
 
 def cmd_trace(args) -> int:
@@ -1526,6 +1652,29 @@ def cmd_info(_args) -> int:
           f"GET /v1/requests/<id> /healthz /metrics, POST /drainz "
           f"(graceful drain; overload answers 429 + Retry-After)")
 
+    # invariant guard (ISSUE 11): the static-analysis suite's static
+    # half — rule families, committed schema registry population, and
+    # whether THIS process's locks were built with the dynamic
+    # lock-order watchdog armed
+    from .analysis import RULE_FAMILIES
+    from .analysis.schema import load_registry
+    from .runtime import debug as _debug
+
+    _reg = load_registry(Path(__file__).resolve().parent / "analysis"
+                         / "schemas" / "records.json")
+    _nev = len((_reg or {}).get("events", {}))
+    print(f"static analysis: {len(RULE_FAMILIES)} rule families "
+          f"(heat-tpu check / make check: "
+          f"{', '.join(sorted(RULE_FAMILIES))}), schema registry "
+          f"{_nev} event(s)"
+          + ("" if _reg else " — MISSING, run heat-tpu check "
+             "--update-schemas") +
+          f"; lock-order watchdog "
+          f"{'ARMED' if _debug.lockcheck_enabled() else 'available'} "
+          f"(HEAT_TPU_LOCKCHECK=1; order "
+          + " < ".join(sorted(_debug.LOCK_RANKS,
+                              key=_debug.LOCK_RANKS.get)) + ")")
+
     # persistent compile cache: which programs are already warm (serve
     # buckets, backend advance programs, guard probes all land here) —
     # entry names are XLA key hashes, so report population, not keys
@@ -1561,7 +1710,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     return {"run": cmd_run, "viz": cmd_viz, "info": cmd_info,
             "launch": cmd_launch, "plan": cmd_plan, "serve": cmd_serve,
             "bench": cmd_bench, "calibrate": cmd_calibrate,
-            "trace": cmd_trace, "usage": cmd_usage,
+            "trace": cmd_trace, "usage": cmd_usage, "check": cmd_check,
             "perfcheck": cmd_perfcheck}[args.command](args)
 
 

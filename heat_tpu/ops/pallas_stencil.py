@@ -243,6 +243,9 @@ def _make_kernel_2d(r: float, tile: int, kpad: int, n_pad: int, ksteps: int):
             dn = pltpu.roll(band, rows - 1, 0)
             lf = pltpu.roll(band, 1, 1)
             rt = pltpu.roll(band, n_pad - 1, 1)
+            # solo band is NaN-free by construction (no foreign lanes);
+            # the multiplicative freeze is the reference's interior guard
+            # heat-tpu: allow[mosaic-kernel-safety] solo NaN-free freeze
             band = band + maskr * (up + dn + lf + rt - 4.0 * band)
         out_ref[:] = band[kpad : kpad + tile].astype(store_dt)
 
@@ -460,6 +463,10 @@ def _make_kernel_3d(r: float, R: int, M: int, k: int, km: int, n_pad: int,
 
         cur = band
         for s in range(ksteps):  # static unroll, shrinking shapes
+            # rolls are on the full lane axis only; the shrink is on the
+            # non-lane axes with alignment held by construction, proven
+            # by the chipless v5e compile labs (benchmarks/chip_check)
+            # heat-tpu: allow[mosaic-kernel-safety] lane-axis-only rolls
             lf = pltpu.roll(cur, 1, 2)
             rt = pltpu.roll(cur, n_pad - 1, 2)
             ctr = cur[1:-1, 1:-1, :]
@@ -467,6 +474,8 @@ def _make_kernel_3d(r: float, R: int, M: int, k: int, km: int, n_pad: int,
                    + cur[1:-1, 2:, :] + cur[1:-1, :-2, :]
                    + lf[1:-1, 1:-1, :] + rt[1:-1, 1:-1, :] - 6.0 * ctr)
             m_s = maskr[s + 1: rows - s - 1, s + 1: mids - s - 1, :]
+            # solo band is NaN-free by construction (reference form)
+            # heat-tpu: allow[mosaic-kernel-safety] solo NaN-free freeze
             cur = ctr + m_s * lap
         out_ref[:] = jax.lax.slice(
             cur, (k - ksteps, km - ksteps, 0),
@@ -610,6 +619,8 @@ def _make_kernel_2d_coltiled(r: float, R: int, C: int, kr: int, kc: int,
             dn = pltpu.roll(band, rows - 1, 0)
             lf = pltpu.roll(band, 1, 1)
             rt = pltpu.roll(band, cols - 1, 1)
+            # solo band is NaN-free by construction (reference form)
+            # heat-tpu: allow[mosaic-kernel-safety] solo NaN-free freeze
             band = band + maskr * (up + dn + lf + rt - 4.0 * band)
         out_ref[:] = band[kr: kr + R, kc: kc + C].astype(store_dt)
 

@@ -1,2 +1,2 @@
-from .mesh import auto_mesh_shape, build_mesh, MESH_AXES  # noqa: F401
 from .halo import halo_exchange, halo_pad  # noqa: F401
+from .mesh import MESH_AXES, auto_mesh_shape, build_mesh  # noqa: F401

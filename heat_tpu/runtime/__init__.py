@@ -1,2 +1,2 @@
-from .timing import Timing, sync  # noqa: F401
 from .logging import get_logger, master_print  # noqa: F401
+from .timing import Timing, sync  # noqa: F401

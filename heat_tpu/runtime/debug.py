@@ -1,4 +1,4 @@
-"""Numerics checking and profiler hooks.
+"""Numerics checking, profiler hooks, and the lock-order watchdog.
 
 The reference has no sanitizer story beyond hard device syncs after every
 kernel (fortran/hip/heat.F90:207,220,225,246) — races are impossible in
@@ -7,12 +7,180 @@ XLA's functional model, so the debug mode that actually matters on TPU is
 bound) at the step where they appear instead of in the final output.
 Profiling upgrades the reference's two wall-clock timers (SURVEY.md §5) to
 a real trace (``jax.profiler``) viewable in TensorBoard/Perfetto.
+
+The **lock-order watchdog** (``HEAT_TPU_LOCKCHECK=1``) is the dynamic
+half of the ``lock-discipline`` static rule (``heat_tpu/analysis``): the
+serving stack's locks form a documented partial order —
+
+    gateway  <  engine  <  observatory (prof / trace instruments)
+
+(the engine calls *into* the observatory, sometimes while holding its own
+lock, e.g. ``Engine._emit``; observatory instruments never take the
+engine lock, so a /metrics scrape can never deadlock the boundary hot
+path). With the env flag set, every lock the stack creates through
+:func:`make_lock` becomes an :class:`_OrderedLock` that tracks the
+calling thread's held-lock stack and **raises** :class:`LockOrderError`
+at the exact acquisition that would invert the order — turning a
+some-day deadlock into a deterministic test failure. Off (the default),
+``make_lock`` returns a plain ``threading.Lock``: zero overhead, zero
+behavior change. The chaos suite and ``heat-tpu perfcheck`` run with the
+watchdog armed and assert zero inversions.
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Optional
+import os
+import threading
+from typing import List, Optional
+
+# --------------------------------------------------------------------------
+# lock-order watchdog (opt-in: HEAT_TPU_LOCKCHECK=1)
+# --------------------------------------------------------------------------
+
+# The documented acquisition order, lowest first. A thread may only
+# acquire a lock of STRICTLY greater rank than anything it already holds:
+# two same-rank locks must never nest (the observatory instruments each
+# carry their own lock precisely so they never have to), and the reverse
+# order (observatory -> engine) is the deadlock the PR-8 contract rules
+# out. Rank names are the prefix before ":" in a make_lock name, so
+# "observatory:ledger" and "observatory:burn" share a rank.
+LOCK_RANKS = {"gateway": 0, "engine": 10, "writer": 20, "observatory": 30}
+
+
+class LockOrderError(RuntimeError):
+    """An acquisition that inverts the documented lock order."""
+
+
+_tls = threading.local()
+_stats_lock = threading.Lock()
+_edges: set = set()          # (held_name, acquired_name) pairs observed
+_violations: List[str] = []  # human-readable inversion descriptions
+
+
+def lockcheck_enabled() -> bool:
+    """Is the dynamic lock-order watchdog armed (HEAT_TPU_LOCKCHECK=1)?
+    Read at lock *creation* time: engines built after the env flips get
+    ordered locks, existing plain locks are untouched."""
+    return os.environ.get("HEAT_TPU_LOCKCHECK", "") == "1"
+
+
+def _held() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+class _OrderedLock:
+    """A ``threading.Lock`` that enforces the LOCK_RANKS partial order.
+
+    Duck-types the subset of the Lock API the stack uses (``acquire`` /
+    ``release`` / context manager), which is also exactly what
+    ``threading.Condition`` needs to wrap it — ``Condition.wait`` falls
+    back to plain release/acquire pairs, each of which keeps the
+    held-stack bookkeeping exact. ``acquire(blocking=False)`` performs
+    the order check only on a SUCCESSFUL acquisition: Condition's
+    ``_is_owned`` probe try-acquires a lock the thread already holds and
+    must get a quiet ``False``, not an error."""
+
+    __slots__ = ("name", "rank", "_lock")
+
+    def __init__(self, name: str, rank: int):
+        self.name = name
+        self.rank = rank
+        self._lock = threading.Lock()
+
+    def _check_order(self) -> None:
+        stack = _held()
+        if not stack:
+            return
+        worst = max(stack, key=lambda l: l.rank)
+        if any(l is self for l in stack):
+            msg = (f"reentrant acquire of lock {self.name!r} "
+                   f"(non-reentrant by design; this would deadlock)")
+        elif self.rank <= worst.rank:
+            msg = (f"lock order inversion: acquiring {self.name!r} "
+                   f"(rank {self.rank}) while holding {worst.name!r} "
+                   f"(rank {worst.rank}) — documented order is "
+                   + " < ".join(sorted(LOCK_RANKS, key=LOCK_RANKS.get)))
+        else:
+            return
+        with _stats_lock:
+            _violations.append(msg)
+        raise LockOrderError(msg)
+
+    def _note_acquired(self) -> None:
+        stack = _held()
+        if stack:
+            with _stats_lock:
+                _edges.add((stack[-1].name, self.name))
+        stack.append(self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            # check BEFORE blocking: an inversion must raise, not deadlock
+            self._check_order()
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            if not blocking:
+                try:
+                    self._check_order()
+                except LockOrderError:
+                    self._lock.release()
+                    raise
+            self._note_acquired()
+        return ok
+
+    def release(self) -> None:
+        stack = _held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def make_lock(name: str):
+    """The one lock factory of the serving stack: a plain
+    ``threading.Lock`` normally, an order-enforcing :class:`_OrderedLock`
+    under ``HEAT_TPU_LOCKCHECK=1``. ``name`` is ``"<rank>[:<detail>]"``
+    with ``<rank>`` a LOCK_RANKS key (unknown ranks raise at creation —
+    a misnamed lock must not silently opt out of the discipline)."""
+    rank_name = name.split(":", 1)[0]
+    if rank_name not in LOCK_RANKS:
+        raise ValueError(f"unknown lock rank {rank_name!r} in lock name "
+                         f"{name!r}; known: {sorted(LOCK_RANKS)}")
+    if not lockcheck_enabled():
+        return threading.Lock()
+    return _OrderedLock(name, LOCK_RANKS[rank_name])
+
+
+def held_locks() -> List[str]:
+    """Names of ordered locks the calling thread holds (tests)."""
+    return [l.name for l in _held()]
+
+
+def lock_order_stats() -> dict:
+    """Watchdog observations so far: every (held -> acquired) edge seen
+    and every inversion raised. The chaos suite asserts
+    ``violations == []`` after a full fault-injected drain."""
+    with _stats_lock:
+        return {"edges": sorted(_edges), "violations": list(_violations)}
+
+
+def reset_lock_order_stats() -> None:
+    with _stats_lock:
+        _edges.clear()
+        _violations.clear()
 
 
 @contextlib.contextmanager

@@ -57,6 +57,7 @@ import time
 from pathlib import Path
 from typing import Dict, List, Optional, Tuple
 
+from . import debug
 from .logging import master_print
 
 # Ring capacity default: tuples are ~150 B, so the always-on recorder
@@ -102,7 +103,8 @@ class Tracer:
         self.enabled = self.capacity > 0
         self._buf: collections.deque = collections.deque(
             maxlen=max(1, self.capacity))
-        self._lock = threading.Lock()       # track registry + export only;
+        self._lock = debug.make_lock(
+            "observatory:trace")          # track registry + export only;
                                             # never taken on the event path
         self._procs: Dict[str, int] = {}    # process name -> pid
         self._tracks: Dict[Tuple[str, str], Tuple[int, int]] = {}

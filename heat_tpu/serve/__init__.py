@@ -29,12 +29,12 @@ paper's FTCS stencil:
   backpressure, graceful drain, and the /metrics surface.
 """
 
-from .engine import (BucketKey, LaneEngine, lane_buffer,  # noqa: F401
-                     lane_tier, tail_size)
-from .scheduler import (Engine, Request, ServeConfig,  # noqa: F401
-                        TERMINAL_STATUSES)
 from .api import (ParsedRequest, load_requests,  # noqa: F401
                   parse_request_obj, serve_requests, submit_parsed)
+from .engine import (BucketKey, LaneEngine, lane_buffer,  # noqa: F401
+                     lane_tier, tail_size)
+from .scheduler import (TERMINAL_STATUSES, Engine,  # noqa: F401
+                        Request, ServeConfig)
 
 
 def __getattr__(name):

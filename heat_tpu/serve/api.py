@@ -117,13 +117,17 @@ def submit_parsed(eng: Engine, row: ParsedRequest) -> str:
                       slo_class=row.slo_class)
 
 
-def serve_requests(path, scfg: ServeConfig = ServeConfig(),
+def serve_requests(path, scfg: Optional[ServeConfig] = None,
                    engine: Optional[Engine] = None) -> Tuple[List[dict], dict]:
     """Serve every request in a JSONL file; returns (records, summary).
 
     Parse failures become status='rejected' records alongside the engine's
     own admission rejections, so the records list covers every input line.
+    ``scfg`` defaults to ``ServeConfig()`` (resolved per call, not at
+    definition — the B008 mutable-default-adjacent footgun ruff now
+    gates).
     """
+    scfg = scfg if scfg is not None else ServeConfig()
     eng = engine or Engine(scfg)
     parse_failures = []
     for i, row in enumerate(load_requests(path)):

@@ -111,6 +111,7 @@ def host_fetch(x) -> np.ndarray:
     Every boundary inspection and lane extraction funnels through here so
     tests can monkeypatch it to prove the dispatch path never fences
     (ISSUE 4 regression contract) and to count fetches per boundary."""
+    # heat-tpu: allow[hot-path-purity] THE sanctioned D2H seam itself
     return np.asarray(x)
 
 
@@ -579,6 +580,7 @@ def fetch_boundary(handle, timeout_s: Optional[float] = None, plan=None,
     def fetch():
         if plan is not None:
             plan.maybe_fetch_hang(fetch_index)
+        # heat-tpu: allow[hot-path-purity] the watchdogged boundary D2H
         return host_fetch(handle)
 
     if timeout_s is None:

@@ -76,6 +76,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
 from ..config import SLO_CLASSES
+from ..runtime import debug
 from ..runtime import prof as prof_mod
 from ..runtime import trace as trace_mod
 from ..runtime.logging import master_print
@@ -445,7 +446,7 @@ class Gateway:
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
         self._drainer: Optional[threading.Thread] = None
-        self._drain_lock = threading.Lock()
+        self._drain_lock = debug.make_lock("gateway:drain")
         self._drained = threading.Event()
 
     @property

@@ -122,6 +122,7 @@ from ..config import (DEFAULT_SLO_CLASS, DEFAULT_TENANT, LANE_KERNELS,
                       SLO_TARGETS, HeatConfig, validate_slo_fields)
 from ..grid import initial_condition
 from ..runtime import async_io, faults
+from ..runtime import debug as debug_mod
 from ..runtime import prof as prof_mod
 from ..runtime import trace as trace_mod
 from ..runtime.logging import json_record, master_print
@@ -1368,7 +1369,12 @@ class Engine:
     returns the records in submit order.
     """
 
-    def __init__(self, scfg: ServeConfig = ServeConfig()):
+    def __init__(self, scfg: Optional[ServeConfig] = None):
+        # default resolved per call (ruff B008: a call in a default is
+        # evaluated once at definition — harmless for a frozen dataclass,
+        # but the pattern is banned uniformly so the one day it guards a
+        # mutable default it actually fires)
+        scfg = scfg if scfg is not None else ServeConfig()
         self.scfg = scfg
         # request-scoped tracing + always-on flight recorder
         # (runtime/trace.py): every request mints a trace id at submit,
@@ -1409,7 +1415,9 @@ class Engine:
         # The same lock guards every policy-queue push/pop (the gateway's
         # HTTP threads submit while the scheduler thread pops) and backs
         # the condition the online loop + wait() callers sleep on.
-        self._lock = threading.Lock()
+        # Created through runtime/debug.make_lock so HEAT_TPU_LOCKCHECK=1
+        # arms the engine<observatory order watchdog on this exact lock.
+        self._lock = debug_mod.make_lock("engine")
         self._cond = threading.Condition(self._lock)
         self._listeners: List[Callable[[dict], None]] = []
         # online mode (serve/gateway.py): a background scheduler thread
@@ -1862,8 +1870,13 @@ class Engine:
             # the terminal snapshot (their own locks — engine->prof lock
             # order only); an slo_alert payload is emitted OUTSIDE this
             # lock, like the listeners
+            # heat-tpu: allow[lock-discipline] the documented engine->
+            # observatory direction: note_terminal takes only instrument
+            # locks and can never wait on the engine lock it is under
             alert = self.prof.note_terminal(snap, now)
             if self.scfg.emit_records:
+                # heat-tpu: allow[lock-discipline] the engine lock IS the
+                # serialization point: record lines must not interleave
                 json_record("serve_request", **snap)
             self._cond.notify_all()
         if alert is not None:

@@ -103,13 +103,13 @@ def test_multistep_kernel_matches_sequential():
     """Temporal blocking must be step-for-step identical to sequential."""
     import jax.numpy as jnp
 
+    from heat_tpu.grid import initial_condition
     from heat_tpu.ops.pallas_stencil import (
         ftcs_multistep_edges_pallas,
         ftcs_multistep_ghost_pallas,
         ftcs_step_edges_pallas,
         ftcs_step_ghost_pallas,
     )
-    from heat_tpu.grid import initial_condition
 
     cfg = HeatConfig(n=128, dtype="float32", ic="hat")
     T = jnp.asarray(initial_condition(cfg), jnp.float32)

@@ -169,3 +169,48 @@ def test_corrupt_newest_shard_checkpoint_falls_back(tmp_cwd, capfd):
     healed = _soln_shards(tmp_cwd)
     for c, h in zip(clean, healed):
         np.testing.assert_array_equal(c, h)
+
+
+def test_serve_chaos_under_lockcheck_zero_inversions(tmp_cwd, capsys,
+                                                     monkeypatch):
+    """ISSUE 11: the full fault-injected serve surface — lane-nan
+    quarantine, rollback heal, fetch-watchdog group failure — under the
+    armed lock-order watchdog (HEAT_TPU_LOCKCHECK=1). Every lock
+    acquisition across the scheduler, writer, observatory, and tracer
+    threads must respect the documented gateway < engine < observatory
+    order: zero inversions, with the engine->observatory edges actually
+    exercised (the watchdog saw real cross-thread traffic, not an idle
+    engine)."""
+    import json
+
+    from heat_tpu.runtime import debug, faults
+
+    monkeypatch.setenv("HEAT_TPU_LOCKCHECK", "1")
+    debug.reset_lock_order_stats()
+
+    reqs = tmp_cwd / "reqs.jsonl"
+    lines = [{"id": f"r{i}", "n": (16, 24, 32)[i % 3], "ntime": 40,
+              "dtype": "float64"} for i in range(12)]
+    reqs.write_text("".join(json.dumps(d) + "\n" for d in lines))
+    base = ["serve", "--requests", "reqs.jsonl", "--buckets", "32",
+            "--chunk", "8", "--lanes", "4"]
+
+    # quarantine + rollback heal under the armed watchdog
+    faults.reset()
+    assert main([*base, "--inject", "lane-nan@16:req=r5",
+                 "--serve-on-nan", "rollback"]) == 0
+    recs = {r["id"]: r for r in
+            (json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{") and '"serve_request"' in l)}
+    assert all(r["status"] == "ok" for r in recs.values())
+
+    # wedged fetch -> watchdog group failure, still no lock inversion
+    faults.reset()
+    assert main([*base, "--inject", "fetch-hang:ms=2000",
+                 "--fetch-watchdog", "0.4"]) == 1
+    capsys.readouterr()
+
+    stats = debug.lock_order_stats()
+    assert stats["violations"] == [], stats["violations"]
+    assert any(e[0] == "engine" and e[1].startswith("observatory")
+               for e in stats["edges"])

@@ -163,8 +163,8 @@ def test_cli_crash_resume_flow(tmp_cwd, capsys):
 
     # the "crashed" first attempt: same config, killed after step 4
     # (emulated by a shorter ntime writing the same checkpoint stream)
-    from heat_tpu.config import HeatConfig, parse_input
     from heat_tpu.backends import solve as _solve
+    from heat_tpu.config import HeatConfig, parse_input
 
     cfg = parse_input("input.dat").with_(backend="serial", dtype="float64",
                                          checkpoint_every=2)
