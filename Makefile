@@ -5,7 +5,7 @@ PY ?= python
 # tier1 needs pipefail (a dash /bin/sh has no `set -o pipefail`)
 SHELL := /bin/bash
 
-.PHONY: test tier1 chaos lint check bench bench-all bench-smoke chip-check \
+.PHONY: test tier1 chaos lint check audit bench bench-all bench-smoke chip-check \
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
@@ -39,11 +39,22 @@ lint:           # ruff when installed; syntax-level fallback otherwise
 	  $(PY) -m compileall -q heat_tpu tests benchmarks; \
 	fi
 
-check: lint     # the invariant gate (ISSUE 11): generic lint + the
+check: lint     # the invariant gate (ISSUE 11 + 13): generic lint + the
                 # project-native analyzer (hot-path purity, lock
                 # discipline, traced determinism, Mosaic kernel safety)
-                # + the record-schema drift gate — all in heat-tpu check
+                # + the record-schema drift gate — all in heat-tpu check —
+                # plus the fast tier of the program auditor (digest /
+                # donation / purity / budget contracts over traced
+                # jaxprs; full audit = `make audit` / extras_r5c)
 	$(PY) -m heat_tpu check
+	env JAX_PLATFORMS=cpu $(PY) -m heat_tpu audit --fast
+
+audit:          # the full program auditor (ISSUE 13): every registered
+                # family traced to jaxpr + AOT StableHLO on abstract
+                # inputs (no device) and gated on all five contract
+                # families, dtype discipline and roofline extraction
+                # included
+	env JAX_PLATFORMS=cpu $(PY) -m heat_tpu audit
 
 bench:
 	$(PY) bench.py

@@ -153,6 +153,15 @@ phase prof_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/prof_overhe
 # device, seconds of wall — so it runs first among the gates and with
 # the tunnel down.
 phase static_check 600 make check
+# Program auditor, full tier (ISSUE 13): every registered program family
+# traced to jaxpr + AOT-lowered StableHLO on abstract inputs and gated
+# on all five contract families — donation honored in the alias table
+# (rollback provably not aliasing), zero host callbacks in hot programs,
+# dtype discipline under x64, compile-key budget vs the enumerated
+# ServeConfig key space, and digest drift vs the committed registry.
+# `make check` above ran the fast tier; this is the full one. No device,
+# no execution — runs with the tunnel down.
+phase program_audit 900 env JAX_PLATFORMS=cpu python -m heat_tpu audit
 # Perf regression gate (ISSUE 8): fresh prof_overhead_lab vs the
 # committed baseline within a tolerance band, every committed lab's
 # internal gates re-validated, the online cost model cross-checked

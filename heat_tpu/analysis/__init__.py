@@ -26,11 +26,23 @@ Five rule families (one module each, registered into
 ====================  =====================================================
 
 Sanctioned exceptions carry ``# heat-tpu: allow[rule-id] reason`` markers
-next to the code (reason mandatory). The suite is pure ``ast`` — it lints
-a tree it never imports, so it runs in seconds with no device, no JAX
-session, and inside CI's smallest box.
+next to the code (reason mandatory). Markers that no longer suppress
+anything are reported as stale (``heat-tpu check`` warns;
+``--strict-allows`` fails), and ``heat-tpu check --dead-code`` lists
+public functions outside the reachability closure (``deadcode``). The
+suite is pure ``ast`` — it lints a tree it never imports, so it runs in
+seconds with no device, no JAX session, and inside CI's smallest box.
+
+A second, separate tier — the **program auditor** (``programs``, exposed
+as ``heat-tpu audit``) — checks contracts that no AST lint can see:
+it traces every registered program family to jaxprs and AOT-lowered
+StableHLO on abstract inputs (no execution, no chip) and machine-checks
+donation, traced purity, dtype discipline, the compile-key budget, and
+drift-gated program digests (``analysis/digests/programs.json``). It
+needs JAX importable but nothing else, so it is NOT imported here: the
+AST tier must keep running in a tree where JAX is broken.
 """
 
-from . import determinism, locks, mosaic, purity, schema  # noqa: F401
+from . import deadcode, determinism, locks, mosaic, purity, schema  # noqa: F401
 from .core import (RULE_DOCS, RULE_FAMILIES, Context, Violation,  # noqa: F401
                    run_checks)

@@ -29,7 +29,9 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import re
+import tokenize
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -63,13 +65,19 @@ class Source:
         self.tree = ast.parse(self.text, filename=str(path))
         self._annotate()
         # lineno -> {rule_id: reason}; rule "*" would defeat the point and
-        # is deliberately not supported.
+        # is deliberately not supported. Scanned over COMMENT tokens, not
+        # raw lines: marker grammar quoted inside a string literal (help
+        # text, docs) must not become a live — and instantly stale —
+        # marker.
         self.allows: Dict[int, Dict[str, str]] = {}
         self.bare_markers: List[int] = []
-        for i, line in enumerate(self.lines, 1):
-            m = _MARKER_RE.search(line)
+        for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _MARKER_RE.search(tok.string)
             if not m:
                 continue
+            i = tok.start[0]
             if not m.group("reason").strip():
                 self.bare_markers.append(i)
                 continue
@@ -100,17 +108,6 @@ class Source:
     def functions(self) -> List[ast.FunctionDef]:
         return [n for n in ast.walk(self.tree)
                 if isinstance(n, ast.FunctionDef)]
-
-    def allowed(self, rule: str, node: ast.AST) -> bool:
-        """Is this node's finding covered by an allow marker? Checked on
-        every physical line the statement spans plus the line above it
-        (a marker on its own line annotates the statement below)."""
-        lo = getattr(node, "lineno", 0)
-        hi = getattr(node, "end_lineno", lo) or lo
-        for ln in range(max(1, lo - 1), hi + 1):
-            if rule in self.allows.get(ln, {}):
-                return True
-        return False
 
 
 class Context:
@@ -198,7 +195,8 @@ def register(rule_id: str, doc: str):
 
 
 def run_checks(root, rules: Optional[List[str]] = None,
-               schema_registry=None, update_schemas: bool = False
+               schema_registry=None, update_schemas: bool = False,
+               strict_allows: bool = False
                ) -> Tuple[List[Violation], dict]:
     """Run the requested rule families (default: all) over ``root``.
 
@@ -206,6 +204,15 @@ def run_checks(root, rules: Optional[List[str]] = None,
     here (every rule reports raw and this one chokepoint applies the
     markers, so marker semantics cannot drift per rule); a marker with
     no reason text is converted into its own violation.
+
+    Because filtering happens at this chokepoint, we also know which
+    markers actually suppressed something. The rest are **stale**: the
+    rule id is unknown (typo, or the rule was removed), or the rule ran
+    and no longer fires at that site (the code was fixed but the marker
+    stayed, silently pre-authorizing a future regression). Stale markers
+    are reported in ``stats["stale_allows"]``; with ``strict_allows``
+    they become ``stale-allow`` violations. Markers for known rules that
+    were not selected this run are left alone — we cannot tell.
     """
     from . import determinism, locks, mosaic, purity, schema  # noqa: F401
     # (imports register the families; flake-quiet because the side effect
@@ -226,29 +233,57 @@ def run_checks(root, rules: Optional[List[str]] = None,
                 "allow marker without a reason — every sanctioned "
                 "exception must carry its justification"))
     per_rule: Dict[str, int] = {}
+    consumed: set = set()  # (rel, marker_line, rule) that suppressed a hit
     for rid in selected:
         found = RULE_FAMILIES[rid](ctx)
         kept = []
         for v in found:
             src = next((s for s in ctx.sources if s.rel == v.path), None)
-            if src is not None and _line_allowed(src, v.rule, v.line):
+            marker = (None if src is None
+                      else _allow_line(src, v.rule, v.line))
+            if marker is not None:
+                consumed.add((v.path, marker, v.rule))
                 continue
             kept.append(v)
         per_rule[rid] = len(kept)
         out.extend(kept)
+    stale: List[dict] = []
+    for src in ctx.sources:
+        for ln, rules_here in sorted(src.allows.items()):
+            for rule, reason in sorted(rules_here.items()):
+                if rule not in RULE_FAMILIES:
+                    why = (f"unknown rule id {rule!r} — typo, or the "
+                           "rule was removed")
+                elif rule not in selected:
+                    continue  # rule didn't run: can't judge the marker
+                elif (src.rel, ln, rule) not in consumed:
+                    why = ("rule no longer fires here — the marker "
+                           "silently pre-authorizes a regression")
+                else:
+                    continue
+                stale.append({"path": src.rel, "line": ln, "rule": rule,
+                              "reason": reason, "why": why})
+    if strict_allows:
+        out.extend(Violation("stale-allow", s["path"], s["line"],
+                             f"stale allow[{s['rule']}] marker: {s['why']}"
+                             f" (reason given: {s['reason']!r})")
+                   for s in stale)
     out.sort(key=lambda v: (v.path, v.line, v.rule))
     stats = {"files": len(ctx.sources), "rules": selected,
              "violations": len(out), "per_rule": per_rule,
              "allow_markers": sum(len(d) for s in ctx.sources
-                                  for d in s.allows.values())}
+                                  for d in s.allows.values()),
+             "stale_allows": stale}
     return out, stats
 
 
-def _line_allowed(src: Source, rule: str, line: int) -> bool:
-    # the marker may sit on the flagged line, within the two lines above
-    # (the tail of a comment block annotating a short statement pair), or
-    # — for a call spanning lines — on a trailing continuation line
+def _allow_line(src: Source, rule: str, line: int) -> Optional[int]:
+    """The line of the allow marker covering ``line`` for ``rule``, or
+    None. The marker may sit on the flagged line, within the two lines
+    above (the tail of a comment block annotating a short statement
+    pair), or — for a call spanning lines — on a trailing continuation
+    line."""
     for ln in range(line - 2, line + 3):
         if rule in src.allows.get(ln, {}):
-            return True
-    return False
+            return ln
+    return None

@@ -1,0 +1,654 @@
+"""Program auditor (ISSUE 13): jaxpr-level contracts for every compiled
+family — the semantic tier of the invariant guard.
+
+The AST suite (``heat-tpu check``) checks what the *source text*
+promises; this module checks what the *compiler is actually handed*.
+Every registered program family — the solo chunked advance
+(``backends/common.solo_program_specs``), the packed-lane stepping,
+tail, rollback, and loader programs plus the sharded mega-lane
+(``serve/engine.lane_program_specs`` / ``mega_program_specs``) — is
+traced on abstract inputs (``jax.make_jaxpr`` under ``enable_x64`` so a
+silent f64 widening cannot hide behind x64-off canonicalization) and
+AOT-lowered on whatever backend is present. Nothing executes; no chip
+is needed. Five contract families, exposed as ``heat-tpu audit``:
+
+``program-donation``
+    Every buffer a family declares donated (the solo T/T_old double
+    buffer, the serve chunk stacks, the mega-lane carried state) must
+    appear in the lowered program's input/output alias table
+    (``tf.aliasing_output``) — donation that quietly degrades to a copy
+    is a silent 2x memory and bandwidth tax. Rollback-mode lane
+    programs must provably NOT alias the field stack: the undonated
+    input stack IS the boundary snapshot (the PR-9 no-copy contract,
+    previously guarded only by a runtime spy test).
+``program-purity``
+    Zero ``pure_callback`` / ``io_callback`` / ``debug_callback`` /
+    host-callback primitives anywhere in a hot program's jaxpr — the
+    trace-level complement of the AST ``hot-path-purity`` rule, which
+    cannot see through closures or library calls.
+``program-dtype``
+    No silent f64 promotion in any non-f64 family (traced under x64,
+    where an unpinned python/numpy scalar widens visibly), f64 families
+    must actually carry f64, and bfloat16 families must show the
+    storage-round ``convert_element_type`` pairs INSIDE the step loop —
+    the byte-identity mechanism, until now a convention.
+``compile-budget``
+    The full stepping-program key space implied by a ``ServeConfig``
+    (bucket x tier x chunk/tail x kernel x donation, plus one loader
+    per bucket x tier) is enumerated through the engine's own
+    ``chunk_cache_key`` seam and gated against the budget declared in
+    the committed registry; the key *dimensions* are read off the
+    seam's signature — a refactor that adds a recompile dimension fails
+    here instead of as a production compile storm (PR 4's at-most-one-
+    compile-per-combo guarantee, made mechanical). Mega-lane programs
+    are keyed per request geometry and are deliberately outside this
+    bound (admission, not compilation, limits them).
+``program-digest``
+    A canonicalized jaxpr digest per family, committed to
+    ``analysis/digests/programs.json`` exactly like the record-schema
+    registry: drift fails the audit with the op-level delta named, and
+    ``--update-digests`` is the reviewed-change workflow. The registry
+    also exports each program's static FLOP/byte estimate (XLA cost
+    analysis plus the Williams-roofline bytes-per-lane-step model) —
+    ``heat-tpu perfcheck`` cross-checks the learned cost model against
+    that prior (0.1-10x band, informational off-TPU).
+
+GSPMD's lesson (PAPERS.md) is that the compiled program is the ground
+truth worth inspecting; the digests make inspecting it a diff review
+instead of an archaeology project.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import inspect
+import json
+import re
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .core import Violation
+
+# Host-callback primitives that must never appear in a hot program: each
+# one fences the dispatch pipeline on every execution. debug.print
+# lowers to debug_callback; outside_call/host_callback_call are the
+# legacy host-callback spellings.
+BANNED_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "host_callback_call"})
+
+# Primitives whose sub-jaxpr executes per step / per grid cell: a
+# storage-round convert found under one of these runs every mini-step,
+# which is what the bf16 byte-identity contract requires.
+_LOOP_PRIMS = frozenset({"while", "scan", "pallas_call"})
+
+_HEX_RE = re.compile(r"0x[0-9a-fA-F]+")
+_MAIN_SIG_RE = re.compile(r"@main\((.*?)\)\s*->", re.S)
+_BUCKET_RE = re.compile(r"(\d+)d/n(\d+)/([a-z0-9]+)/")
+_DTYPE_BYTES = {"float64": 8, "float32": 4, "bfloat16": 2}
+
+# contract id -> one-line doc (the audit's analogue of core.RULE_DOCS)
+CONTRACTS: Dict[str, str] = {
+    "program-donation": "declared-donated buffers appear in the lowered "
+                        "alias table; rollback programs alias nothing",
+    "program-purity": "no host-callback primitives in any hot program's "
+                      "jaxpr",
+    "program-dtype": "no silent f64 promotion (x64 trace); bf16 "
+                     "families storage-round inside the step loop",
+    "compile-budget": "stepping-program key space enumerated via "
+                      "chunk_cache_key and gated against the declared "
+                      "budget",
+    "program-digest": "canonical jaxpr digest per family gated against "
+                      "digests/programs.json (op-level delta on drift)",
+}
+
+# `make check` runs these; the dtype contract rides the same trace but
+# its verdicts are the slowest-moving, so the full set is the lab tier
+# (benchmarks/extras_r5c.sh) per the ISSUE's fast/full split.
+FAST_CONTRACTS: Tuple[str, ...] = (
+    "program-digest", "program-donation", "program-purity",
+    "compile-budget")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One registered program family, abstractly buildable.
+
+    ``build()`` returns ``(fn, args, static_argnums)``: a jitted
+    callable, the argument tuple to trace/lower it with (array slots as
+    ``jax.ShapeDtypeStruct``, static slots as python scalars), and the
+    static argument positions for ``jax.make_jaxpr``. ``donated`` holds
+    FLAT lowered-argument indices (every registered family takes a flat
+    list of array arguments, so python position == MLIR %arg index).
+    """
+
+    name: str
+    build: Callable[[], tuple]
+    donated: Tuple[int, ...] = ()
+    no_alias: bool = False       # rollback contract: alias table empty
+    hot: bool = True             # on the serve/solve dispatch path
+    dtype: str = "float32"
+    storage_round: bool = False  # bf16: convert pairs inside the loop
+    steps: int = 0               # static chunk size traced with
+    lanes: int = 1
+    kernel: str = "xla"
+    family: str = "lane"         # solo | lane | loader | mega
+    bucket: Optional[str] = None  # cost-model bucket label, when lane
+
+
+# spec.name -> trace dict; tracing every family costs seconds, and the
+# audit, its tests, and cmd_info may all want the same traces in one
+# process. Seeded-violation fixtures use fresh names (or cache=False).
+_TRACE_CACHE: Dict[str, dict] = {}
+
+
+def iter_program_specs() -> List[ProgramSpec]:
+    """Every registered program family, collected through the registry
+    seams. Building specs is cheap (no tracing happens until
+    ``trace_program``)."""
+    from ..backends.common import solo_program_specs
+    from ..serve.engine import lane_program_specs, mega_program_specs
+
+    return (solo_program_specs() + lane_program_specs()
+            + mega_program_specs())
+
+
+def _sub_jaxprs(val) -> list:
+    """Jaxprs hiding in one eqn-param value (closed or open, possibly
+    nested in lists/tuples) — duck-typed so no private jax imports."""
+    if hasattr(val, "eqns"):
+        return [val]
+    if hasattr(val, "jaxpr"):
+        return _sub_jaxprs(val.jaxpr)
+    if isinstance(val, (list, tuple)):
+        return [j for v in val for j in _sub_jaxprs(v)]
+    return []
+
+
+def _walk_eqns(jaxpr, in_loop: bool = False):
+    """Yield ``(eqn, in_loop)`` over a jaxpr and every nested sub-jaxpr;
+    ``in_loop`` is True once the ancestor chain crosses a primitive
+    whose body executes per step (while/scan/pallas grid)."""
+    for eqn in jaxpr.eqns:
+        yield eqn, in_loop
+        child_loop = in_loop or eqn.primitive.name in _LOOP_PRIMS
+        for val in eqn.params.values():
+            for sub in _sub_jaxprs(val):
+                yield from _walk_eqns(sub, child_loop)
+
+
+def trace_program(spec: ProgramSpec, cache: bool = True) -> dict:
+    """Trace + lower one family on abstract inputs; no execution.
+
+    The jaxpr is taken under ``enable_x64`` (uniformly, so f64 families
+    keep their dtype and a silent widening in any family becomes
+    visible); the lowering runs in the production dtype mode (donation
+    and cost are mode-independent, and it is the program a real run
+    compiles). Returns primitive histogram, aval dtypes, storage-round
+    converts, canonical digest, alias text, and static cost."""
+    if cache and spec.name in _TRACE_CACHE:
+        return _TRACE_CACHE[spec.name]
+    import jax
+
+    fn, args, static_argnums = spec.build()
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(fn, static_argnums=tuple(static_argnums))(
+            *args)
+    prims: collections.Counter = collections.Counter()
+    avals: Set[str] = set()
+    converts: List[Tuple[bool, str]] = []
+    for var in list(closed.jaxpr.invars) + list(closed.jaxpr.outvars):
+        a = getattr(var, "aval", None)
+        if a is not None and hasattr(a, "dtype"):
+            avals.add(str(a.dtype))
+    for eqn, in_loop in _walk_eqns(closed.jaxpr):
+        prims[eqn.primitive.name] += 1
+        for var in list(eqn.invars) + list(eqn.outvars):
+            a = getattr(var, "aval", None)
+            if a is not None and hasattr(a, "dtype"):
+                avals.add(str(a.dtype))
+        if eqn.primitive.name == "convert_element_type":
+            converts.append((in_loop, str(eqn.params.get("new_dtype"))))
+    canon = _HEX_RE.sub("0xX", str(closed))
+    digest = hashlib.sha256(canon.encode()).hexdigest()[:16]
+    lowered_text = cost = lower_error = None
+    try:
+        lowered = fn.lower(*args)
+        lowered_text = lowered.as_text()
+        try:
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if ca:
+                cost = {"flops": int(ca.get("flops", 0) or 0),
+                        "bytes": int(ca.get("bytes accessed", 0) or 0)}
+        except Exception:     # cost analysis is best-effort per backend
+            cost = None
+    except Exception as e:    # lowering failure is a finding, not a crash
+        lower_error = f"{type(e).__name__}: {e}"
+    tr = {"digest": digest, "prims": prims, "avals": avals,
+          "converts": converts, "lowered_text": lowered_text,
+          "cost": cost, "lower_error": lower_error}
+    if cache:
+        _TRACE_CACHE[spec.name] = tr
+    return tr
+
+
+def donated_arg_indices(lowered_text: str) -> Set[int]:
+    """Flat %arg indices carrying ``tf.aliasing_output`` in the lowered
+    module's public @main signature — the input/output alias table the
+    compiler is handed. Segment the signature on %argN tokens (argument
+    types never contain %arg, so the split is safe even with loc()/
+    sharding attributes in between)."""
+    m = _MAIN_SIG_RE.search(lowered_text)
+    if m is None:
+        return set()
+    parts = re.split(r"%arg(\d+)", m.group(1))
+    return {int(parts[i]) for i in range(1, len(parts) - 1, 2)
+            if "tf.aliasing_output" in parts[i + 1]}
+
+
+# --- the five contract checkers ---------------------------------------------
+# Each takes (spec, trace) [budget takes explicit inputs] and returns
+# plain Violations with the family name as the path, so seeded-violation
+# fixtures exercise them without touching the real registry.
+
+def check_donation(spec: ProgramSpec, tr: dict) -> List[Violation]:
+    loc = f"<{spec.name}>"
+    if tr["lowered_text"] is None:
+        if spec.donated or spec.no_alias:
+            return [Violation(
+                "program-donation", loc, 0,
+                f"family could not be lowered, so its alias table is "
+                f"unverifiable ({tr['lower_error']})")]
+        return []
+    aliased = donated_arg_indices(tr["lowered_text"])
+    out: List[Violation] = []
+    for i in spec.donated:
+        if i not in aliased:
+            out.append(Violation(
+                "program-donation", loc, 0,
+                f"arg {i} is declared donated but the lowered program's "
+                f"alias table does not alias it to any output (aliased "
+                f"args: {sorted(aliased) or 'none'}) — the double-buffer "
+                f"ping-pong silently became a copy"))
+    if spec.no_alias and aliased:
+        out.append(Violation(
+            "program-donation", loc, 0,
+            f"rollback-mode program must NOT alias its inputs (the "
+            f"undonated input stack IS the boundary snapshot — PR-9 "
+            f"no-copy contract) but args {sorted(aliased)} alias "
+            f"outputs: a restore would read a consumed buffer"))
+    return out
+
+
+def check_purity(spec: ProgramSpec, tr: dict) -> List[Violation]:
+    if not spec.hot:
+        return []
+    return [Violation(
+        "program-purity", f"<{spec.name}>", 0,
+        f"hot program contains `{prim}` x{tr['prims'][prim]} — a host "
+        f"callback inside a chunk program fences the dispatch pipeline "
+        f"on every execution (jaxpr-level complement of the AST "
+        f"hot-path-purity rule)")
+        for prim in sorted(BANNED_CALLBACK_PRIMS & set(tr["prims"]))]
+
+
+def check_dtype(spec: ProgramSpec, tr: dict) -> List[Violation]:
+    loc = f"<{spec.name}>"
+    out: List[Violation] = []
+    if spec.dtype != "float64" and "float64" in tr["avals"]:
+        out.append(Violation(
+            "program-dtype", loc, 0,
+            f"silent f64 promotion: a {spec.dtype} family traced under "
+            f"enable_x64 carries float64 intermediates (avals: "
+            f"{sorted(tr['avals'])}) — an unpinned python/numpy scalar "
+            f"widened the computation"))
+    if spec.dtype == "float64" and "float64" not in tr["avals"]:
+        out.append(Violation(
+            "program-dtype", loc, 0,
+            f"float64 family shows no float64 avals (saw "
+            f"{sorted(tr['avals'])}) — the storage dtype was lost in "
+            f"tracing"))
+    if spec.storage_round:
+        in_loop = {nd for il, nd in tr["converts"] if il}
+        if not ({"bfloat16", "float32"} <= in_loop):
+            out.append(Violation(
+                "program-dtype", loc, 0,
+                f"bfloat16 family must round through storage on every "
+                f"mini-step: expected convert_element_type pairs "
+                f"(->float32 upcast, ->bfloat16 round) INSIDE the step "
+                f"loop, saw {sorted(in_loop) or 'none'} — byte-identity "
+                f"with the solo path rests on this mechanism"))
+    return out
+
+
+def enumerate_step_keys(scfg=None) -> Dict[str, int]:
+    """The full distinct-program key space a ServeConfig implies, walked
+    through the engine's own ``chunk_cache_key`` seam: every (bucket
+    geometry x dtype x bc) x lane-tier x {chunk, tail} x available
+    kernel under the config's donation mode, plus one loader program
+    per (bucket, tier). This is the worst case a serving process can
+    compile — the scheduler only ever builds a subset."""
+    from ..ops.pallas_stencil import lane_kernel_available
+    from ..serve.engine import (_BC_LO, BucketKey, chunk_cache_key,
+                                lane_tier, tail_size)
+
+    if scfg is None:
+        from ..serve.scheduler import ServeConfig
+
+        scfg = ServeConfig()
+    donate = scfg.on_nan != "rollback"
+    tiers = sorted({lane_tier(i, scfg.lanes)
+                    for i in range(1, scfg.lanes + 1)})
+    ks = [scfg.chunk]
+    tail = tail_size(scfg.chunk)
+    if tail:
+        ks.append(tail)
+    step_keys: set = set()
+    loaders: set = set()
+    for ndim in (2, 3):
+        for side in scfg.buckets:
+            for dtype in sorted(_DTYPE_BYTES):
+                for bc in sorted(_BC_LO):
+                    bk = BucketKey(ndim, side, dtype, bc)
+                    kernels = ["xla"]
+                    if (scfg.lane_kernel != "xla" and dtype != "float64"
+                            and lane_kernel_available(ndim, side, dtype)):
+                        kernels.append("pallas")
+                    for tier in tiers:
+                        loaders.add((bk, tier, donate))
+                        for k in ks:
+                            for kern in kernels:
+                                step_keys.add(chunk_cache_key(
+                                    bk, tier, k, kern, donate))
+    return {"step_keys": len(step_keys), "loaders": len(loaders),
+            "total": len(step_keys) + len(loaders)}
+
+
+def check_compile_budget(registry: Optional[dict],
+                         key_dims: Optional[Tuple[str, ...]] = None,
+                         enumerated: Optional[int] = None
+                         ) -> List[Violation]:
+    """Gate the stepping-program key space against the declared budget.
+    ``key_dims``/``enumerated`` default to the live seam (signature of
+    ``chunk_cache_key`` / ``enumerate_step_keys()``); tests pass fakes
+    to seed violations without monkeypatching the engine."""
+    from ..serve.engine import STEP_KEY_DIMS, chunk_cache_key
+
+    loc = "analysis/digests/programs.json"
+    live_dims = key_dims is None
+    if key_dims is None:
+        key_dims = tuple(inspect.signature(chunk_cache_key).parameters)
+    if enumerated is None:
+        enumerated = enumerate_step_keys()["total"]
+    out: List[Violation] = []
+    if live_dims and key_dims != STEP_KEY_DIMS:
+        out.append(Violation(
+            "compile-budget", "serve/engine.py", 0,
+            f"chunk_cache_key signature {list(key_dims)} disagrees with "
+            f"its own STEP_KEY_DIMS declaration {list(STEP_KEY_DIMS)} — "
+            f"update both together"))
+    decl = (registry or {}).get("compile_budget")
+    if not decl:
+        out.append(Violation(
+            "compile-budget", loc, 0,
+            "no declared compile budget in the digest registry — run "
+            "`heat-tpu audit --update-digests` and commit it"))
+        return out
+    if list(key_dims) != list(decl.get("key_dims", [])):
+        out.append(Violation(
+            "compile-budget", loc, 0,
+            f"stepping-program key dimensions changed: declared "
+            f"{decl.get('key_dims')}, live {list(key_dims)} — a new "
+            f"recompile dimension multiplies the program count; if "
+            f"intentional, `heat-tpu audit --update-digests` and commit "
+            f"the reviewed budget"))
+    max_programs = decl.get("max_programs", 0)
+    if enumerated > max_programs:
+        out.append(Violation(
+            "compile-budget", loc, 0,
+            f"enumerated stepping-program key space ({enumerated}) "
+            f"exceeds the declared budget ({max_programs}) — a compile "
+            f"storm in waiting; if the growth is intentional, "
+            f"`heat-tpu audit --update-digests` re-declares the budget"))
+    return out
+
+
+def _op_delta(old_ops: Dict[str, int], new_ops: Dict[str, int]) -> str:
+    added = sorted(set(new_ops) - set(old_ops))
+    removed = sorted(set(old_ops) - set(new_ops))
+    changed = sorted(k for k in set(old_ops) & set(new_ops)
+                     if old_ops[k] != new_ops[k])
+    parts = []
+    if added:
+        parts.append("added " + ", ".join(f"{k} x{new_ops[k]}"
+                                          for k in added))
+    if removed:
+        parts.append("removed " + ", ".join(f"{k} x{old_ops[k]}"
+                                            for k in removed))
+    if changed:
+        parts.append("count " + ", ".join(
+            f"{k} {old_ops[k]}->{new_ops[k]}" for k in changed))
+    return "; ".join(parts) or ("identical op histogram — operand "
+                                "structure or constants changed")
+
+
+def check_digests(table: Dict[str, dict], registry: Optional[dict]
+                  ) -> List[Violation]:
+    loc = "analysis/digests/programs.json"
+    if registry is None:
+        return [Violation(
+            "program-digest", loc, 0,
+            "digest registry missing/unreadable — generate it with "
+            "`heat-tpu audit --update-digests` and commit it")]
+    old = registry.get("programs", {})
+    out: List[Violation] = []
+    for name in sorted(set(old) | set(table)):
+        if name not in table:
+            out.append(Violation(
+                "program-digest", loc, 0,
+                f"program family {name!r} is in the committed registry "
+                f"but no longer registered — if intentional, `heat-tpu "
+                f"audit --update-digests` and commit the diff"))
+        elif name not in old:
+            out.append(Violation(
+                "program-digest", loc, 0,
+                f"new program family {name!r} (digest "
+                f"{table[name]['digest']}) not in the committed registry "
+                f"— run `heat-tpu audit --update-digests` so the new "
+                f"program lands reviewed"))
+        elif old[name].get("digest") != table[name]["digest"]:
+            out.append(Violation(
+                "program-digest", loc, 0,
+                f"program digest drifted for {name!r}: "
+                f"{old[name].get('digest')} -> {table[name]['digest']}; "
+                f"op-level delta: "
+                f"{_op_delta(old[name].get('ops', {}), table[name]['ops'])}"
+                f" — the compiled program changed; if intentional, "
+                f"`heat-tpu audit --update-digests` and review the diff "
+                f"(TROUBLESHOOTING.md: program digest drifted)"))
+    return out
+
+
+# --- static cost model (the roofline prior) ---------------------------------
+
+def roofline_lane_step_bytes(ndim: int, n: int, dtype: str) -> int:
+    """One masked stencil step over one lane's padded bucket buffer
+    moves the full state twice — one read, one write of (B+2)^ndim
+    cells (Williams et al. roofline: the stencil is bandwidth-bound at
+    ~0.4 flops/byte, so bytes are the cost)."""
+    return 2 * (n + 2) ** ndim * _DTYPE_BYTES[dtype]
+
+
+def lane_static_prior(bucket: str, kernel: str = "xla"
+                      ) -> Optional[float]:
+    """Static seconds-per-lane-step prior for a cost-model bucket label
+    (``2d/n256/float32/edges``): roofline bytes over the machine model's
+    sustained HBM bandwidth. Kernel choice does not move the bandwidth
+    bound, so it only disambiguates the label. None when the label does
+    not parse — callers treat that as 'no prior'."""
+    m = _BUCKET_RE.match(bucket)
+    if m is None or m.group(3) not in _DTYPE_BYTES:
+        return None
+    from .. import machine
+
+    bw = machine.current().hbm_bytes_per_s
+    if not bw:
+        return None
+    return roofline_lane_step_bytes(
+        int(m.group(1)), int(m.group(2)), m.group(3)) / bw
+
+
+# --- registry ----------------------------------------------------------------
+
+def default_registry_path() -> Path:
+    return Path(__file__).resolve().parent / "digests" / "programs.json"
+
+
+def load_registry(path) -> Optional[dict]:
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def digest_table(specs: List[ProgramSpec], traces: Dict[str, dict]
+                 ) -> Dict[str, dict]:
+    """The per-family registry payload: canonical digest, op histogram
+    (so drift reports can name the delta), and the static cost export
+    perfcheck cross-checks the learned model against."""
+    table: Dict[str, dict] = {}
+    for spec in specs:
+        tr = traces.get(spec.name)
+        if tr is None:
+            continue
+        ent = {"digest": tr["digest"],
+               "ops": {k: int(v) for k, v in sorted(tr["prims"].items())},
+               "dtype": spec.dtype, "kernel": spec.kernel,
+               "family": spec.family, "steps": spec.steps}
+        if tr.get("cost"):
+            ent["flops"] = tr["cost"]["flops"]
+            ent["bytes_accessed"] = tr["cost"]["bytes"]
+        if spec.bucket:
+            m = _BUCKET_RE.match(spec.bucket)
+            ent["bucket"] = spec.bucket
+            if m:
+                ent["roofline_bytes_per_lane_step"] = (
+                    roofline_lane_step_bytes(int(m.group(1)),
+                                             int(m.group(2)), m.group(3)))
+        table[spec.name] = ent
+    return table
+
+
+def write_registry(path, table: Dict[str, dict],
+                   enumerated: Dict[str, int],
+                   key_dims: Optional[Tuple[str, ...]] = None) -> None:
+    import jax
+
+    from ..serve.engine import chunk_cache_key
+
+    if key_dims is None:
+        key_dims = tuple(inspect.signature(chunk_cache_key).parameters)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = {
+        "version": 1,
+        "jax": jax.__version__,
+        "comment": "committed program-digest registry — regenerate with "
+                   "`heat-tpu audit --update-digests` and review the "
+                   "diff (TROUBLESHOOTING.md: program digest drifted). "
+                   "Digests canonicalize the traced jaxpr; flops/bytes "
+                   "are this platform's static cost analysis and are "
+                   "informational.",
+        "compile_budget": {"key_dims": list(key_dims),
+                           "max_programs": enumerated["total"],
+                           "enumerated": dict(sorted(enumerated.items()))},
+        "programs": table,
+    }
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+
+
+# --- the audit entry point ---------------------------------------------------
+
+def audit(registry_path=None, update_digests: bool = False,
+          contracts=None, specs: Optional[List[ProgramSpec]] = None
+          ) -> Tuple[List[Violation], dict]:
+    """Run the program audit: trace every registered family, apply the
+    selected contract families (default: all of ``CONTRACTS``), gate
+    digests/budget against the committed registry (or rewrite it with
+    ``update_digests``). Returns ``(violations, report)`` — exit-code
+    semantics and printing live in the CLI."""
+    import jax
+
+    reg_path = Path(registry_path) if registry_path else (
+        default_registry_path())
+    selected = tuple(contracts) if contracts else tuple(CONTRACTS)
+    unknown = [c for c in selected if c not in CONTRACTS]
+    if unknown:
+        raise ValueError(f"unknown contract families {unknown}; "
+                         f"known: {sorted(CONTRACTS)}")
+    specs = list(specs) if specs is not None else iter_program_specs()
+    out: List[Violation] = []
+    traces: Dict[str, dict] = {}
+    for spec in specs:
+        try:
+            traces[spec.name] = trace_program(spec)
+        except Exception as e:   # an untraceable family is a finding
+            out.append(Violation(
+                "program-trace", f"<{spec.name}>", 0,
+                f"family failed to trace: {type(e).__name__}: {e}"))
+    for spec in specs:
+        tr = traces.get(spec.name)
+        if tr is None:
+            continue
+        if "program-donation" in selected:
+            out.extend(check_donation(spec, tr))
+        if "program-purity" in selected:
+            out.extend(check_purity(spec, tr))
+        if "program-dtype" in selected:
+            out.extend(check_dtype(spec, tr))
+    table = digest_table(specs, traces)
+    enum = enumerate_step_keys() if (
+        "compile-budget" in selected or update_digests) else None
+    if update_digests:
+        write_registry(reg_path, table, enum)
+    registry = load_registry(reg_path)
+    if "compile-budget" in selected:
+        out.extend(check_compile_budget(registry,
+                                        enumerated=enum["total"]))
+    digest_gate = "updated" if update_digests else "skipped"
+    if "program-digest" in selected and not update_digests:
+        skew = (registry is not None
+                and registry.get("jax") != jax.__version__)
+        if skew:
+            # a jax upgrade legitimately reshapes jaxprs; the gate
+            # resumes once the registry is regenerated under the new
+            # version — drift within one version stays a hard failure
+            digest_gate = (f"skipped — registry written under jax "
+                           f"{registry.get('jax')}, running "
+                           f"{jax.__version__}; regenerate with "
+                           f"--update-digests")
+        else:
+            digest_gate = "checked"
+            out.extend(check_digests(table, registry))
+    out.sort(key=lambda v: (v.path, v.line, v.rule, v.message))
+    report = {
+        "families": len(specs),
+        "traced": len(traces),
+        "contracts": list(selected),
+        "jax": jax.__version__,
+        "registry": str(reg_path),
+        "registry_programs": len((registry or {}).get("programs", {})),
+        "budget": {
+            "declared": ((registry or {}).get("compile_budget") or {}
+                         ).get("max_programs"),
+            "enumerated": enum,
+        },
+        "digest_gate": digest_gate,
+        "programs": table,
+        "violations": len(out),
+    }
+    return out, report

@@ -125,6 +125,41 @@ def aot_compile_chunks(advance, example, sizes, compiled=None, label=None,
     return compiled, time.perf_counter() - t0
 
 
+def solo_program_specs():
+    """Program-registry seam (ISSUE 13): the solo drive's chunked advance
+    families as abstract ProgramSpecs — `heat-tpu audit` traces/lowers
+    them on shape structs to check donation (the T/T_old double-buffer
+    ping-pong this module's docstring promises), purity, dtype
+    discipline, and digest drift, without running a solve."""
+    from ..analysis.programs import ProgramSpec
+    from ..utils import jnp_dtype
+
+    def _spec(ndim, n, dtype, bc, steps=8):
+        def build():
+            from .xla import make_advance
+
+            cfg = HeatConfig(n=n, ndim=ndim, dtype=dtype, bc=bc,
+                             ntime=steps, backend="xla")
+            adv = make_advance(cfg)
+            T = jax.ShapeDtypeStruct(cfg.shape, jnp_dtype(dtype))
+            return adv, (T, steps), (1,)
+
+        return ProgramSpec(
+            name=f"solo/xla/{ndim}d/n{n}/{dtype}/{bc}", build=build,
+            donated=(0,), dtype=dtype,
+            storage_round=(dtype == "bfloat16"), steps=steps,
+            kernel="xla", family="solo")
+
+    return [
+        _spec(2, 48, "float32", "edges"),
+        _spec(2, 48, "float32", "ghost"),
+        _spec(2, 48, "float32", "periodic"),
+        _spec(2, 48, "bfloat16", "edges"),
+        _spec(2, 48, "float64", "ghost"),
+        _spec(3, 16, "float32", "ghost"),
+    ]
+
+
 def drive(
     cfg: HeatConfig,
     T_dev: jax.Array,

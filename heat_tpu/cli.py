@@ -376,6 +376,51 @@ def build_parser() -> argparse.ArgumentParser:
     chk.add_argument("--json", action="store_true",
                      help="machine-readable results (one JSON object: "
                           "stats + violations)")
+    chk.add_argument("--strict-allows", action="store_true",
+                     help="fail on stale allow markers (markers whose "
+                          "rule no longer fires at that site, or whose "
+                          "rule id is unknown). Default: warn only — a "
+                          "stale marker silently pre-authorizes a future "
+                          "regression, but fixing it is a separate diff")
+    chk.add_argument("--dead-code", action="store_true",
+                     help="informational: list public package functions "
+                          "unreachable from any entry point (tests, "
+                          "benchmarks, module-level code, decorated "
+                          "defs) and exit 0 — the closure is "
+                          "conservative, so every listed function "
+                          "really is unreferenced")
+
+    aud = sub.add_parser(
+        "audit",
+        help="program auditor: trace every registered program family "
+             "(solo step, lane advance/loader, sharded mega) to jaxprs "
+             "and AOT-lowered StableHLO on abstract inputs — no "
+             "execution, no chip — and machine-check donation, traced "
+             "purity, dtype discipline, the compile-key budget, and "
+             "drift-gated program digests. Exit 0 = all contracts hold")
+    aud.add_argument("--update-digests", action="store_true",
+                     help="regenerate analysis/digests/programs.json "
+                          "from the current source instead of gating "
+                          "against it — the intentional-drift workflow: "
+                          "commit the registry diff with the code change "
+                          "so the program change is reviewed")
+    aud.add_argument("--contracts", metavar="LIST",
+                     help="comma-separated contract families to check "
+                          "(default: all; see --list-contracts)")
+    aud.add_argument("--fast", action="store_true",
+                     help="skip the per-program cost/roofline extraction "
+                          "detail and run only the cheap contracts "
+                          "(digest, donation, purity, budget) — the "
+                          "make-check tier; full audits run in "
+                          "benchmarks/extras")
+    aud.add_argument("--list-contracts", action="store_true",
+                     help="print the contract-family table and exit")
+    aud.add_argument("--registry", metavar="FILE",
+                     help="digest registry path (default: the committed "
+                          "heat_tpu/analysis/digests/programs.json)")
+    aud.add_argument("--json", action="store_true",
+                     help="machine-readable report (one JSON object: "
+                          "families, budget, digests, violations)")
 
     trc = sub.add_parser(
         "trace",
@@ -1120,6 +1165,34 @@ def cmd_perfcheck(args) -> int:
                 check(True, "calibration cross-check (informational, "
                       f"platform={(fresh or base).get('platform')})", line)
 
+    # cost model vs the program auditor's static roofline prior (ISSUE
+    # 13): the audit registry carries a bytes/bandwidth floor per lane
+    # bucket computed from the jaxpr-level traffic model — no
+    # measurement at all — so learned-vs-static agreement within an
+    # order of magnitude catches a units bug in EITHER model
+    if cm:
+        from .runtime.prof import static_prior_s_per_lane_step
+        on_tpu = str((fresh or base).get("platform", "")) == "tpu"
+        for e in cm:
+            per = e.get("ewma_s_per_lane_step")
+            prior = static_prior_s_per_lane_step(
+                e.get("bucket", ""), e.get("kernel", "xla"))
+            if not per or not prior:
+                continue
+            ratio = per / prior
+            line = (f"bucket {e['bucket']}: learned "
+                    f"{per:.3e}s/lane-step = {ratio:.2f}x the static "
+                    f"roofline prior {prior:.3e}s")
+            if on_tpu:
+                # the prior is a bandwidth floor for the chip the model
+                # was calibrated against, so 0.1-10x is generous — only
+                # a units/exponent bug escapes it
+                check(0.1 <= ratio <= 10.0, "static-prior band", line)
+            else:
+                check(True, "static-prior band (informational, "
+                      f"platform={(fresh or base).get('platform')})",
+                      line)
+
     failed = [line for ok, line in results if not ok]
     for ok, line in results:
         print(("OK   " if ok else "FAIL ") + line)
@@ -1144,11 +1217,25 @@ def cmd_check(args) -> int:
     if not root.is_dir():
         print(f"error: {root} is not a directory", file=sys.stderr)
         return 2
+    if args.dead_code:
+        from .analysis.deadcode import dead_code_report
+        rows = dead_code_report(root)
+        if args.json:
+            print(_json.dumps({"dead_code": rows}, sort_keys=True))
+            return 0
+        for row in rows:
+            print(f"{row['path']}:{row['line']}: {row['qualname']} — "
+                  "public function unreachable from any entry point")
+        print(f"heat-tpu check --dead-code: {len(rows)} candidate(s) "
+              "(informational — the reachability closure is "
+              "conservative, so these really are unreferenced)")
+        return 0
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
     try:
         violations, stats = run_checks(root, rules=rules,
-                                       update_schemas=args.update_schemas)
+                                       update_schemas=args.update_schemas,
+                                       strict_allows=args.strict_allows)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -1158,6 +1245,11 @@ def cmd_check(args) -> int:
                                           for v in violations]},
                           sort_keys=True))
         return 0 if not violations else 1
+    if not args.strict_allows:
+        for s in stats.get("stale_allows", ()):
+            print(f"warning: {s['path']}:{s['line']}: stale "
+                  f"allow[{s['rule']}] marker — {s['why']} "
+                  "(--strict-allows makes this fail)")
     for v in violations:
         print(v.format())
     per = ", ".join(f"{r}={n}" for r, n in sorted(stats["per_rule"].items())
@@ -1175,6 +1267,61 @@ def cmd_check(args) -> int:
         print("each line is path:line: [rule] message; sanctioned "
               "exceptions take a `# heat-tpu: allow[rule] reason` marker "
               "— see TROUBLESHOOTING.md 'Static analysis'")
+    return 0 if not violations else 1
+
+
+def cmd_audit(args) -> int:
+    """The program auditor (ISSUE 13): trace every registered program
+    family to jaxprs/StableHLO on abstract inputs — no execution — and
+    machine-check the contracts the AST tier cannot see (donation,
+    traced purity, dtype discipline, compile budget, digest drift).
+    Exit codes mirror ``check``: 0 clean, 1 violations, 2 usage error."""
+    import json as _json
+
+    from .analysis.programs import CONTRACTS, FAST_CONTRACTS, audit
+
+    if args.list_contracts:
+        for cid, doc in sorted(CONTRACTS.items()):
+            print(f"{cid:<18} {doc}")
+        return 0
+    contracts = ([c.strip() for c in args.contracts.split(",") if c.strip()]
+                 if args.contracts else None)
+    if args.fast:
+        if contracts:
+            print("error: --fast and --contracts are mutually exclusive",
+                  file=sys.stderr)
+            return 2
+        contracts = list(FAST_CONTRACTS)
+    try:
+        violations, report = audit(registry_path=args.registry,
+                                   update_digests=args.update_digests,
+                                   contracts=contracts)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        report["violation_list"] = [dataclasses.asdict(v)
+                                    for v in violations]
+        print(_json.dumps(report, sort_keys=True))
+        return 0 if not violations else 1
+    for v in violations:
+        print(v.format())
+    enum = report["budget"]["enumerated"]
+    verdict = "OK" if not violations else "FAILED"
+    print(f"heat-tpu audit: {verdict} — "
+          f"{report['traced']}/{report['families']} families traced, "
+          f"{len(report['contracts'])} contract"
+          f"{'' if len(report['contracts']) == 1 else 's'}, "
+          f"digest gate {report['digest_gate']}, budget "
+          f"declared={report['budget']['declared']} "
+          f"enumerated={enum['total'] if enum else 'n/a'}, "
+          f"{report['violations']} violation(s)"
+          + ("; digest registry rewritten — review & commit the diff"
+             if args.update_digests else ""))
+    if violations:
+        print("see TROUBLESHOOTING.md 'Program audit' — intentional "
+              "program changes go through `heat-tpu audit "
+              "--update-digests` so the jaxpr diff is reviewed")
     return 0 if not violations else 1
 
 
@@ -1675,6 +1822,27 @@ def cmd_info(_args) -> int:
           + " < ".join(sorted(_debug.LOCK_RANKS,
                               key=_debug.LOCK_RANKS.get)) + ")")
 
+    # program auditor (ISSUE 13): the jaxpr-level half — registered
+    # program families, committed digest population, and the declared
+    # vs freshly-enumerated compile budget (enumeration is pure python
+    # over ServeConfig, no tracing)
+    from .analysis.programs import (default_registry_path,
+                                    enumerate_step_keys,
+                                    iter_program_specs)
+    from .analysis.programs import load_registry as _load_digests
+
+    _dreg = _load_digests(default_registry_path())
+    _nfam = len(iter_program_specs())
+    _declared = ((_dreg or {}).get("compile_budget") or {}).get(
+        "max_programs")
+    print(f"program audit: {_nfam} program families (heat-tpu audit: "
+          f"donation, purity, dtype, budget, digests), digest registry "
+          f"{len((_dreg or {}).get('programs', {}))} program(s)"
+          + ("" if _dreg else " — MISSING, run heat-tpu audit "
+             "--update-digests")
+          + f"; compile budget declared={_declared} "
+          f"enumerated={enumerate_step_keys()['total']}")
+
     # persistent compile cache: which programs are already warm (serve
     # buckets, backend advance programs, guard probes all land here) —
     # entry names are XLA key hashes, so report population, not keys
@@ -1711,6 +1879,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "launch": cmd_launch, "plan": cmd_plan, "serve": cmd_serve,
             "bench": cmd_bench, "calibrate": cmd_calibrate,
             "trace": cmd_trace, "usage": cmd_usage, "check": cmd_check,
+            "audit": cmd_audit,
             "perfcheck": cmd_perfcheck}[args.command](args)
 
 

@@ -28,15 +28,6 @@ class HeatModel:
     def is_stable(self, cfg: HeatConfig) -> bool:
         return cfg.sigma <= self.stability_limit() + 1e-12
 
-    def bytes_per_point_per_step(self, itemsize: int) -> int:
-        """Minimum HBM traffic: read T_old + write T (the roofline model in
-        BASELINE.md)."""
-        return 2 * itemsize
-
-    def flops_per_point(self) -> int:
-        """adds + muls of the 2*ndim+1-point update."""
-        return 2 * self.ndim + 2 + 2  # neighbor adds, -2nd*c, r*, +c
-
     def steady_state(self, cfg: HeatConfig, T0=None) -> np.ndarray:
         """t→∞ limit, per BC family.
 

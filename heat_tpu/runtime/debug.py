@@ -140,9 +140,6 @@ class _OrderedLock:
                 break
         self._lock.release()
 
-    def locked(self) -> bool:
-        return self._lock.locked()
-
     __enter__ = acquire
 
     def __exit__(self, *exc) -> None:

@@ -687,3 +687,28 @@ class Observatory:
                 "mem": self.mem.snapshot(),
                 "slo_burn": self.burn.snapshot(now),
                 "compile": compile_log().summary()}
+
+
+# -- static prior (ISSUE 13) -----------------------------------------------
+
+_STATIC_PRIOR_CACHE: Dict[Tuple[str, str], Optional[float]] = {}
+
+
+def static_prior_s_per_lane_step(bucket: str,
+                                 kernel: str = "xla") -> Optional[float]:
+    """The program auditor's measurement-free floor on seconds per lane
+    step for one cost-model bucket label (``"2d/n512/float32/edges"``):
+    jaxpr-level traffic over the machine model's HBM bandwidth. Used by
+    ``heat-tpu perfcheck`` to sanity-band the *learned* cost model —
+    agreement within an order of magnitude catches a units bug in
+    either. Returns None when the label doesn't parse or the auditor is
+    unavailable (broken JAX tree); cached, since the prior is pure
+    arithmetic over static config."""
+    key = (bucket, kernel)
+    if key not in _STATIC_PRIOR_CACHE:
+        try:
+            from ..analysis.programs import lane_static_prior
+            _STATIC_PRIOR_CACHE[key] = lane_static_prior(bucket, kernel)
+        except Exception:
+            _STATIC_PRIOR_CACHE[key] = None
+    return _STATIC_PRIOR_CACHE[key]

@@ -128,6 +128,25 @@ def lane_tier(needed: int, cap: int) -> int:
     return min(cap, t)
 
 
+# The dimensions of the compiled-stepping-program cache key, in order.
+# `heat-tpu audit`'s compile-budget contract reads chunk_cache_key's
+# signature and compares it against the budget declared in
+# analysis/digests/programs.json — adding a recompile dimension here
+# without updating the declared budget fails the audit instead of
+# shipping a production compile storm (the PR-4 one-compile-per-combo
+# guarantee, made mechanical).
+STEP_KEY_DIMS = ("bucket", "lanes", "k", "kernel", "donate")
+
+
+def chunk_cache_key(bucket: BucketKey, lanes: int, k: int, kernel: str,
+                    donate: bool) -> tuple:
+    """The ONE cache key under which a compiled lane stepping program is
+    stored (LaneEngine._ensure). Every distinct value of this tuple is a
+    distinct XLA executable; the audit enumerates this function's image
+    over a ServeConfig to bound total compiles."""
+    return (bucket, lanes, k, kernel, donate)
+
+
 def tail_size(chunk: int) -> Optional[int]:
     """Size of the one precompiled tail program per (bucket, lane-tier):
     a quarter chunk (>= 1). When every live lane's remaining count drops
@@ -412,7 +431,8 @@ class LaneEngine:
         per (bucket, lane-tier, k, kernel, donation mode) across the
         scheduler's shared cache (rollback-mode programs donate nothing
         and are distinct executables from the donating default)."""
-        ckey = (self.key, self.lanes, k, self.kernel, self.donate)
+        ckey = chunk_cache_key(self.key, self.lanes, k, self.kernel,
+                               self.donate)
         if ckey not in self._cache:
             from ..backends.common import aot_compile_chunks
 
@@ -798,3 +818,128 @@ class MegaLaneEngine:
 def wall_clock() -> float:
     """Seam for tests; the scheduler stamps queue/serve waits with this."""
     return time.perf_counter()
+
+
+# --- program-registry seam (ISSUE 13) ----------------------------------------
+# Every program family the lane/mega engines compile, as abstract
+# ProgramSpecs: `heat-tpu audit` traces and lowers them on shape structs
+# (no engine, no device state, no execution) to machine-check donation,
+# purity, dtype discipline, and digest drift. Keep this list in lockstep
+# with what the engines actually build — a family missing here is a
+# family the audit cannot see.
+
+def _lane_structs(key: BucketKey, lanes: int, kernel: str = "xla"):
+    """Abstract (fields, r, n, remaining) argument structs for one lane
+    engine's programs — the exact shapes/dtypes LaneEngine.__init__
+    allocates, including the Pallas kernel's padded slab layout."""
+    import jax
+
+    dt = jnp_dtype(key.dtype)
+    acc = accum_dtype_for(dt)
+    if kernel == "pallas":
+        from ..ops.pallas_stencil import lane_state_shape
+
+        shape = lane_state_shape(key.ndim, key.n, key.dtype)
+    else:
+        shape = key.padded_shape
+    return (jax.ShapeDtypeStruct((lanes,) + shape, dt),
+            jax.ShapeDtypeStruct((lanes,), acc),
+            jax.ShapeDtypeStruct((lanes,), np.int32),
+            jax.ShapeDtypeStruct((lanes,), np.int32))
+
+
+def lane_program_specs():
+    """Every packed-lane program family (stepping XLA/Pallas, rollback,
+    tail, loader) at a representative bucket — small enough to trace in
+    seconds, wide enough that each contract family has a real subject."""
+    from ..analysis.programs import ProgramSpec
+    from ..ops.pallas_stencil import lane_kernel_available
+
+    B, L, K = 64, 4, 8
+
+    def _advance_build(key, kernel, donate, k):
+        def build():
+            adv = make_lane_advance(key, kernel=kernel, donate=donate)
+            return adv, _lane_structs(key, L, kernel) + (k,), (4,)
+        return build
+
+    def _loader_build(key):
+        def build():
+            import jax
+
+            dt = jnp_dtype(key.dtype)
+            acc = accum_dtype_for(dt)
+            load = make_lane_loader(key, donate=True)
+            args = _lane_structs(key, L) + (
+                jax.ShapeDtypeStruct((), np.int32),
+                jax.ShapeDtypeStruct(key.padded_shape, dt),
+                jax.ShapeDtypeStruct((), acc),
+                jax.ShapeDtypeStruct((), np.int32),
+                jax.ShapeDtypeStruct((), np.int32))
+            return load, args, ()
+        return build
+
+    def _spec(dtype, bc, kernel="xla", donate=True, k=K, tag=""):
+        key = BucketKey(2, B, dtype, bc)
+        name = f"lane/{kernel}/2d/n{B}/{dtype}/{bc}{tag}"
+        return ProgramSpec(
+            name=name, build=_advance_build(key, kernel, donate, k),
+            donated=(0,) if donate else (), no_alias=not donate,
+            dtype=dtype, storage_round=(dtype == "bfloat16"), steps=k,
+            lanes=L, kernel=kernel, family="lane",
+            bucket=f"2d/n{B}/{dtype}/{bc}")
+
+    specs = [
+        _spec("float32", "edges"),
+        # rollback mode: the undonated input stack IS the boundary
+        # snapshot (PR 9) — the audit proves it never aliases an output
+        _spec("float32", "edges", donate=False, tag="/rollback"),
+        _spec("float32", "edges", k=tail_size(16), tag="/tail"),
+        _spec("bfloat16", "edges"),
+    ]
+    for dtype in ("float32", "bfloat16"):
+        if lane_kernel_available(2, B, dtype):
+            specs.append(_spec(dtype, "edges", kernel="pallas"))
+    key3 = BucketKey(3, 16, "float32", "ghost")
+    specs.append(ProgramSpec(
+        name="lane/xla/3d/n16/float32/ghost",
+        build=_advance_build(key3, "xla", True, K), donated=(0,),
+        dtype="float32", steps=K, lanes=L, kernel="xla", family="lane",
+        bucket="3d/n16/float32/ghost"))
+    key = BucketKey(2, B, "float32", "edges")
+    specs.append(ProgramSpec(
+        name=f"lane/load/2d/n{B}/float32/edges", build=_loader_build(key),
+        donated=(0,), dtype="float32", steps=0, lanes=L, kernel="xla",
+        family="loader"))
+    return specs
+
+
+def mega_program_specs():
+    """The sharded mega-lane chunk program (ISSUE 10) on a 1x1 mesh —
+    mesh-shape-pinned so the digest is stable on any host; real meshes
+    change shard counts, not the contract set."""
+    from ..analysis.programs import ProgramSpec
+    from ..config import HeatConfig
+
+    def build():
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..backends.sharded import make_mega_machinery
+        from ..parallel.mesh import build_mesh
+
+        cfg = HeatConfig(n=32, ndim=2, dtype="float32", bc="ghost",
+                         ntime=16, backend="sharded", mesh_shape=(1, 1))
+        mesh = build_mesh(cfg.ndim, cfg.mesh_shape)
+        _, advance, _, kf = make_mega_machinery(cfg, mesh)
+        sharding = NamedSharding(mesh, P(*mesh.axis_names))
+        padded = jax.ShapeDtypeStruct(
+            tuple(cfg.n + 2 * kf * int(s) for s in mesh.devices.shape),
+            jnp_dtype(cfg.dtype), sharding=sharding)
+        rem = jax.ShapeDtypeStruct((1,), np.int32)
+        return advance, (padded, rem, 8), (2,)
+
+    return [ProgramSpec(
+        name="mega/sharded/2d/n32/float32/ghost", build=build,
+        donated=(0,), dtype="float32", steps=8, lanes=1,
+        kernel="sharded", family="mega")]

@@ -1870,9 +1870,6 @@ class Engine:
             # the terminal snapshot (their own locks — engine->prof lock
             # order only); an slo_alert payload is emitted OUTSIDE this
             # lock, like the listeners
-            # heat-tpu: allow[lock-discipline] the documented engine->
-            # observatory direction: note_terminal takes only instrument
-            # locks and can never wait on the engine lock it is under
             alert = self.prof.note_terminal(snap, now)
             if self.scfg.emit_records:
                 # heat-tpu: allow[lock-discipline] the engine lock IS the

@@ -565,3 +565,139 @@ def test_info_reports_static_analysis_line(capsys):
     assert "static analysis: 5 rule families" in out
     assert "lock-order watchdog" in out
     assert "schema registry 5 event(s)" in out
+
+
+# --- stale allow markers (ISSUE 13 satellite) -------------------------------
+
+def test_consumed_marker_is_not_stale(tmp_path):
+    root = _tree(tmp_path, {"serve/engine.py": """
+        import numpy as np
+
+        def host_fetch(x):
+            # heat-tpu: allow[hot-path-purity] the one sanctioned seam
+            return np.asarray(x)
+    """})
+    vs, stats = _run(root, rules=["hot-path-purity"], strict_allows=True)
+    assert vs == []
+    assert stats["stale_allows"] == []
+
+
+def test_stale_marker_rule_no_longer_fires(tmp_path):
+    root = _tree(tmp_path, {"serve/engine.py": """
+        def pure_math(x):
+            # heat-tpu: allow[hot-path-purity] fixed long ago
+            return x + 1
+    """})
+    vs, stats = _run(root, rules=["hot-path-purity"])
+    assert vs == []                          # default mode: warn only
+    (s,) = stats["stale_allows"]
+    assert s["rule"] == "hot-path-purity"
+    assert "no longer fires" in s["why"]
+    vs, _ = _run(root, rules=["hot-path-purity"], strict_allows=True)
+    (v,) = vs
+    assert v.rule == "stale-allow" and "no longer fires" in v.message
+
+
+def test_stale_marker_unknown_rule_id(tmp_path):
+    root = _tree(tmp_path, {"serve/engine.py": """
+        def f(x):
+            # heat-tpu: allow[no-such-rule] typo'd family id
+            return x
+    """})
+    _, stats = _run(root, rules=["hot-path-purity"])
+    (s,) = stats["stale_allows"]
+    assert "unknown rule id" in s["why"]
+
+
+def test_unselected_rule_markers_not_judged(tmp_path):
+    root = _tree(tmp_path, {"serve/engine.py": """
+        def pure_math(x):
+            # heat-tpu: allow[hot-path-purity] can't tell without the rule
+            return x + 1
+    """})
+    _, stats = _run(root, rules=["record-schema"], strict_allows=True)
+    assert stats["stale_allows"] == []
+
+
+def test_marker_grammar_in_string_literal_is_inert(tmp_path):
+    root = _tree(tmp_path, {"docs_mod.py": """
+        HINT = "write `# heat-tpu: allow[hot-path-purity] reason` markers"
+    """})
+    _, stats = _run(root, strict_allows=True)
+    assert stats["allow_markers"] == 0
+    assert stats["stale_allows"] == []
+
+
+def test_repo_has_no_stale_allows():
+    vs, stats = run_checks(PKG, strict_allows=True)
+    assert stats["stale_allows"] == []
+    assert [v for v in vs if v.rule == "stale-allow"] == []
+
+
+def test_check_cli_strict_allows(tmp_path, capsys):
+    root = _tree(tmp_path, {"serve/engine.py": """
+        def pure_math(x):
+            # heat-tpu: allow[hot-path-purity] stale
+            return x + 1
+    """})
+    assert main(["check", "--root", str(root),
+                 "--rules", "hot-path-purity"]) == 0
+    assert "warning:" in capsys.readouterr().out
+    assert main(["check", "--root", str(root),
+                 "--rules", "hot-path-purity", "--strict-allows"]) == 1
+    assert "[stale-allow]" in capsys.readouterr().out
+
+
+# --- dead-code report (ISSUE 13 satellite) ----------------------------------
+
+_DEAD_TREE = {"mod.py": """
+    def used():
+        return 1
+
+    def dead_helper():
+        return 2
+
+    def _private_dead():
+        return 3
+
+    VALUE = used()
+""", "hooks.py": """
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.chain()
+
+        def chain(self):
+            pass
+"""}
+
+
+def test_dead_code_report_finds_only_the_dead(tmp_path):
+    from heat_tpu.analysis.deadcode import dead_code_report
+    root = _tree(tmp_path, _DEAD_TREE)
+    rows = dead_code_report(root, extra_sources=[])
+    assert [r["qualname"] for r in rows] == ["dead_helper"]
+    # _private_dead: underscore = intentionally internal, not reported;
+    # do_GET: framework hook on a based class, exempt — and its callee
+    # `chain` is live THROUGH it (hooks propagate reachability)
+
+
+def test_dead_code_external_entry_points_keep_functions_live(tmp_path):
+    from heat_tpu.analysis.deadcode import dead_code_report
+    root = _tree(tmp_path, _DEAD_TREE)
+    driver = tmp_path / "driver.py"
+    driver.write_text("from pkg.mod import dead_helper\ndead_helper()\n")
+    assert dead_code_report(root, extra_sources=[driver]) == []
+
+
+def test_dead_code_cli_informational(tmp_path, capsys):
+    root = _tree(tmp_path, _DEAD_TREE)
+    assert main(["check", "--root", str(root), "--dead-code"]) == 0
+    out = capsys.readouterr().out
+    assert "dead_helper" in out and "informational" in out
+
+
+def test_repo_has_no_dead_code():
+    from heat_tpu.analysis.deadcode import dead_code_report
+    assert dead_code_report(PKG) == []

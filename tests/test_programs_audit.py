@@ -1,0 +1,335 @@
+"""Program auditor (ISSUE 13): jaxpr-level contracts over the
+registered program families.
+
+Per contract family: one seeded-violation fixture proving the checker
+FIRES with the right message (built from throwaway jitted programs, no
+monkeypatching of the engine), plus the dogfood acceptance tests — the
+full-repo audit is clean, the committed digest registry matches a fresh
+trace, ``--update-digests`` is a byte-stable roundtrip, and the ``audit``
+CLI exits 0 on this repo.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from heat_tpu.analysis.programs import (CONTRACTS, FAST_CONTRACTS,
+                                        ProgramSpec, audit,
+                                        check_compile_budget, check_digests,
+                                        check_donation, check_dtype,
+                                        check_purity, default_registry_path,
+                                        donated_arg_indices,
+                                        enumerate_step_keys,
+                                        iter_program_specs, lane_static_prior,
+                                        roofline_lane_step_bytes,
+                                        trace_program)
+from heat_tpu.cli import main
+
+_KEY_DIMS = ("bucket", "lanes", "k", "kernel", "donate")
+
+
+def _spec(name, fn, args, static=(), **kw):
+    """A throwaway family over an ad-hoc jitted callable."""
+    return ProgramSpec(name=name, build=lambda: (fn, args, tuple(static)),
+                       **kw)
+
+
+def _trace(spec):
+    # fixtures must never pollute the process-wide trace cache
+    return trace_program(spec, cache=False)
+
+
+_F32_8 = jax.ShapeDtypeStruct((8,), jnp.float32)
+
+
+# --- contract 1: donation ---------------------------------------------------
+
+def test_donation_missing_alias_fires():
+    spec = _spec("fixture/undonated", jax.jit(lambda x: x + 1.0),
+                 (_F32_8,), donated=(0,))
+    vs = check_donation(spec, _trace(spec))
+    assert len(vs) == 1 and vs[0].rule == "program-donation"
+    assert "declared donated" in vs[0].message
+    assert "silently became a copy" in vs[0].message
+
+
+def test_donation_rollback_must_not_alias():
+    spec = _spec("fixture/rollback-aliased",
+                 jax.jit(lambda x: x + 1.0, donate_argnums=(0,)),
+                 (_F32_8,), no_alias=True)
+    vs = check_donation(spec, _trace(spec))
+    assert len(vs) == 1
+    assert "must NOT alias" in vs[0].message
+
+
+def test_donation_honored_is_clean():
+    spec = _spec("fixture/donated",
+                 jax.jit(lambda x: x + 1.0, donate_argnums=(0,)),
+                 (_F32_8,), donated=(0,))
+    assert check_donation(spec, _trace(spec)) == []
+
+
+def test_donated_arg_indices_parses_main_signature():
+    text = ("func.func public @main(%arg0: tensor<8xf32> "
+            "{tf.aliasing_output = 0 : i32}, %arg1: tensor<8xf32>) "
+            "-> (tensor<8xf32>) {")
+    assert donated_arg_indices(text) == {0}
+    assert donated_arg_indices("no main here") == set()
+
+
+# --- contract 2: purity -----------------------------------------------------
+
+def test_purity_seeded_callback_fires():
+    def impure(x):
+        y = jax.pure_callback(lambda a: a, _F32_8, x)
+        return y + 1.0
+
+    spec = _spec("fixture/impure", jax.jit(impure), (_F32_8,))
+    vs = check_purity(spec, _trace(spec))
+    assert len(vs) == 1 and vs[0].rule == "program-purity"
+    assert "pure_callback" in vs[0].message
+    assert "fences the dispatch pipeline" in vs[0].message
+
+
+def test_purity_cold_program_unrestricted():
+    def impure(x):
+        return jax.pure_callback(lambda a: a, _F32_8, x)
+
+    spec = _spec("fixture/cold-impure", jax.jit(impure), (_F32_8,),
+                 hot=False)
+    assert check_purity(spec, _trace(spec)) == []
+
+
+# --- contract 3: dtype discipline -------------------------------------------
+
+def test_dtype_silent_f64_promotion_fires():
+    def widens(x):
+        return (x.astype(jnp.float64) * 2.0).astype(jnp.float32)
+
+    spec = _spec("fixture/f64-leak", jax.jit(widens), (_F32_8,))
+    vs = check_dtype(spec, _trace(spec))
+    assert len(vs) == 1 and vs[0].rule == "program-dtype"
+    assert "silent f64 promotion" in vs[0].message
+
+
+def test_dtype_bf16_without_storage_round_fires():
+    spec = _spec("fixture/bf16-noround", jax.jit(lambda x: x * x),
+                 (jax.ShapeDtypeStruct((8,), jnp.bfloat16),),
+                 dtype="bfloat16", storage_round=True)
+    vs = check_dtype(spec, _trace(spec))
+    assert len(vs) == 1
+    assert "round through storage" in vs[0].message
+
+
+def test_dtype_clean_f32_passes():
+    spec = _spec("fixture/f32-clean", jax.jit(lambda x: x * x), (_F32_8,))
+    assert check_dtype(spec, _trace(spec)) == []
+
+
+# --- contract 4: compile budget ---------------------------------------------
+
+def test_budget_overflow_fires():
+    reg = {"compile_budget": {"key_dims": list(_KEY_DIMS),
+                              "max_programs": 3}}
+    vs = check_compile_budget(reg, key_dims=_KEY_DIMS, enumerated=10)
+    assert len(vs) == 1 and vs[0].rule == "compile-budget"
+    assert "exceeds the declared budget (3)" in vs[0].message
+
+
+def test_budget_new_key_dimension_fires():
+    reg = {"compile_budget": {"key_dims": list(_KEY_DIMS),
+                              "max_programs": 500}}
+    vs = check_compile_budget(reg, key_dims=_KEY_DIMS + ("fuse",),
+                              enumerated=1)
+    assert len(vs) == 1
+    assert "key dimensions changed" in vs[0].message
+
+
+def test_budget_missing_declaration_fires():
+    vs = check_compile_budget({}, key_dims=_KEY_DIMS, enumerated=1)
+    assert len(vs) == 1
+    assert "no declared compile budget" in vs[0].message
+
+
+def test_enumeration_within_committed_budget():
+    reg = json.loads(default_registry_path().read_text())
+    enum = enumerate_step_keys()
+    assert enum["total"] == enum["step_keys"] + enum["loaders"]
+    assert enum["total"] <= reg["compile_budget"]["max_programs"]
+    assert reg["compile_budget"]["key_dims"] == list(_KEY_DIMS)
+
+
+# --- contract 5: digest drift -----------------------------------------------
+
+def test_digest_drift_reports_op_delta():
+    table = {"fam": {"digest": "b" * 16, "ops": {"add": 2, "mul": 1}}}
+    reg = {"programs": {"fam": {"digest": "a" * 16,
+                                "ops": {"add": 1, "sin": 1}}}}
+    vs = check_digests(table, reg)
+    assert len(vs) == 1 and vs[0].rule == "program-digest"
+    msg = vs[0].message
+    assert "digest drifted" in msg
+    assert "added mul x1" in msg
+    assert "removed sin x1" in msg
+    assert "count add 1->2" in msg
+
+
+def test_digest_same_ops_drift_names_operand_change():
+    table = {"fam": {"digest": "b" * 16, "ops": {"add": 1}}}
+    reg = {"programs": {"fam": {"digest": "a" * 16, "ops": {"add": 1}}}}
+    (v,) = check_digests(table, reg)
+    assert "identical op histogram" in v.message
+
+
+def test_digest_new_and_removed_families_fire():
+    table = {"new": {"digest": "b" * 16, "ops": {}}}
+    reg = {"programs": {"old": {"digest": "a" * 16, "ops": {}}}}
+    msgs = sorted(v.message for v in check_digests(table, reg))
+    assert len(msgs) == 2
+    assert any("new program family 'new'" in m for m in msgs)
+    assert any("no longer registered" in m for m in msgs)
+
+
+def test_digest_registry_missing_fires():
+    (v,) = check_digests({}, None)
+    assert "registry missing" in v.message
+
+
+def test_perturbed_registry_drifts_end_to_end(tmp_path):
+    reg = json.loads(default_registry_path().read_text())
+    name = sorted(reg["programs"])[0]
+    reg["programs"][name]["digest"] = "0" * 16
+    p = tmp_path / "programs.json"
+    p.write_text(json.dumps(reg))
+    vs, report = audit(registry_path=p, contracts=("program-digest",))
+    assert report["digest_gate"] == "checked"
+    assert [v for v in vs if f"drifted for {name!r}" in v.message]
+
+
+# --- the static prior -------------------------------------------------------
+
+def test_roofline_prior_parses_bucket_labels():
+    assert roofline_lane_step_bytes(2, 256, "float32") == 2 * 258**2 * 4
+    prior = lane_static_prior("2d/n256/float32/edges")
+    assert prior and prior > 0
+    assert lane_static_prior("not-a-bucket") is None
+    # bf16 moves half the bytes of f32 at the same geometry
+    assert (lane_static_prior("2d/n256/bfloat16/edges")
+            == pytest.approx(prior / 2))
+
+
+def test_registry_exports_static_cost():
+    reg = json.loads(default_registry_path().read_text())
+    lanes = {k: v for k, v in reg["programs"].items()
+             if v.get("bucket")}
+    assert lanes, "lane families must export their cost-model bucket"
+    for ent in lanes.values():
+        assert ent["roofline_bytes_per_lane_step"] > 0
+
+
+# --- acceptance: the repo audits clean --------------------------------------
+
+def test_full_repo_audit_is_clean():
+    vs, report = audit()
+    assert vs == []
+    assert report["families"] == report["traced"] == len(iter_program_specs())
+    assert report["digest_gate"] == "checked"
+    assert (report["budget"]["declared"]
+            == report["budget"]["enumerated"]["total"])
+    assert set(FAST_CONTRACTS) < set(CONTRACTS)
+
+
+def test_update_digests_roundtrip_is_byte_stable(tmp_path):
+    p = tmp_path / "programs.json"
+    vs, report = audit(registry_path=p, update_digests=True)
+    assert vs == [] and report["digest_gate"] == "updated"
+    first = p.read_text()
+    audit(registry_path=p, update_digests=True)
+    assert p.read_text() == first
+    # and a fresh trace matches what this checkout has committed
+    fresh = json.loads(first)["programs"]
+    committed = json.loads(default_registry_path().read_text())["programs"]
+    assert ({k: v["digest"] for k, v in fresh.items()}
+            == {k: v["digest"] for k, v in committed.items()})
+    vs, report = audit(registry_path=p)
+    assert vs == [] and report["digest_gate"] == "checked"
+
+
+def test_audit_rejects_unknown_contract():
+    with pytest.raises(ValueError, match="unknown contract"):
+        audit(contracts=("no-such-contract",))
+
+
+def test_jax_version_skew_skips_digest_gate(tmp_path):
+    reg = json.loads(default_registry_path().read_text())
+    reg["jax"] = "0.0.0-other"
+    for ent in reg["programs"].values():
+        ent["digest"] = "f" * 16   # would all drift if the gate ran
+    p = tmp_path / "programs.json"
+    p.write_text(json.dumps(reg))
+    vs, report = audit(registry_path=p, contracts=("program-digest",))
+    assert vs == []
+    assert report["digest_gate"].startswith("skipped")
+
+
+# --- the CLI ----------------------------------------------------------------
+
+def test_audit_cli_end_to_end(capsys):
+    assert main(["audit"]) == 0
+    out = capsys.readouterr().out
+    assert "heat-tpu audit: OK" in out
+    assert "digest gate checked" in out
+
+
+def test_audit_cli_fast_and_json(capsys):
+    assert main(["audit", "--fast", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert sorted(rep["contracts"]) == sorted(FAST_CONTRACTS)
+    assert rep["violations"] == 0 and rep["violation_list"] == []
+
+
+def test_audit_cli_usage_errors(capsys):
+    assert main(["audit", "--contracts", "nope"]) == 2
+    assert main(["audit", "--fast", "--contracts", "program-digest"]) == 2
+    capsys.readouterr()
+    assert main(["audit", "--list-contracts"]) == 0
+    out = capsys.readouterr().out
+    for cid in CONTRACTS:
+        assert cid in out
+
+
+def test_audit_cli_fails_on_drifted_registry(tmp_path, capsys):
+    reg = json.loads(default_registry_path().read_text())
+    name = sorted(reg["programs"])[0]
+    reg["programs"][name]["digest"] = "0" * 16
+    p = tmp_path / "programs.json"
+    p.write_text(json.dumps(reg))
+    assert main(["audit", "--registry", str(p),
+                 "--contracts", "program-digest"]) == 1
+    out = capsys.readouterr().out
+    assert "digest drifted" in out
+    assert "heat-tpu audit: FAILED" in out
+
+
+def test_info_reports_program_audit_line(capsys):
+    assert main(["info"]) == 0
+    out = capsys.readouterr().out
+    assert "program audit:" in out
+    assert "compile budget declared=" in out
+
+
+# --- registry seams ---------------------------------------------------------
+
+def test_program_specs_cover_every_family_axis():
+    specs = iter_program_specs()
+    names = [s.name for s in specs]
+    assert len(names) == len(set(names)), "family names must be unique"
+    fams = {s.family for s in specs}
+    assert {"solo", "lane", "loader", "mega"} <= fams
+    assert any(s.no_alias for s in specs), "rollback family registered"
+    assert any(s.storage_round for s in specs), "bf16 family registered"
+    assert any(s.dtype == "float64" for s in specs)
+    assert any(s.kernel == "pallas" for s in specs)
