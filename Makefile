@@ -5,7 +5,7 @@ PY ?= python
 # tier1 needs pipefail (a dash /bin/sh has no `set -o pipefail`)
 SHELL := /bin/bash
 
-.PHONY: test tier1 chaos lint check audit bench bench-all bench-smoke chip-check \
+.PHONY: test tier1 chaos race lint check audit bench bench-all bench-smoke chip-check \
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
@@ -29,6 +29,15 @@ chaos:          # the full-fidelity chaos suite tier-1 deselects (slow
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q -m slow \
 	  -p no:cacheprovider
 
+race:           # the dynamic race sanitizer over the chaos + serving
+                # e2e surface (ISSUE 14): every scheduler/writer/tracer/
+                # gateway wave re-run with HEAT_TPU_RACECHECK=1 armed —
+                # a cross-thread write with an empty candidate lockset
+                # raises RaceError and fails the suite
+	env JAX_PLATFORMS=cpu HEAT_TPU_RACECHECK=1 $(PY) -m pytest \
+	  tests/test_chaos.py tests/test_serve.py tests/test_gateway.py \
+	  -q -p no:cacheprovider
+
 lint:           # ruff when installed; syntax-level fallback otherwise
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
 	  $(PY) -m ruff check heat_tpu tests benchmarks; \
@@ -39,11 +48,12 @@ lint:           # ruff when installed; syntax-level fallback otherwise
 	  $(PY) -m compileall -q heat_tpu tests benchmarks; \
 	fi
 
-check: lint     # the invariant gate (ISSUE 11 + 13): generic lint + the
-                # project-native analyzer (hot-path purity, lock
-                # discipline, traced determinism, Mosaic kernel safety)
-                # + the record-schema drift gate — all in heat-tpu check —
-                # plus the fast tier of the program auditor (digest /
+check: lint     # the invariant gate (ISSUE 11 + 13 + 14): generic lint
+                # + the project-native analyzer (hot-path purity, lock
+                # discipline, traced determinism, Mosaic kernel safety,
+                # race lockset/guard-map) + the record-schema and
+                # guard-map drift gates — all in heat-tpu check — plus
+                # the fast tier of the program auditor (digest /
                 # donation / purity / budget contracts over traced
                 # jaxprs; full audit = `make audit` / extras_r5c)
 	$(PY) -m heat_tpu check

@@ -147,12 +147,19 @@ phase trace_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/trace_over
 # dispatch depths 0 and 2, with the usage ledger reconciling exactly
 # against the per-record stamps. CPU-world: runs with the tunnel down.
 phase prof_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/prof_overhead_lab.py
-# Invariant guard (ISSUE 11): lint + the project-native static-analysis
-# suite (hot-path purity, lock discipline, traced-code determinism,
-# Mosaic kernel safety) + the record-schema drift gate. Pure AST — no
-# device, seconds of wall — so it runs first among the gates and with
-# the tunnel down.
+# Invariant guard (ISSUE 11 + 14): lint + the project-native
+# static-analysis suite (hot-path purity, lock discipline, traced-code
+# determinism, Mosaic kernel safety, race lockset inference) + the
+# record-schema and guard-map drift gates. Pure AST — no device,
+# seconds of wall — so it runs first among the gates and with the
+# tunnel down.
 phase static_check 600 make check
+# Race sanitizer e2e (ISSUE 14): the chaos + serving suites re-run with
+# HEAT_TPU_RACECHECK=1 — the thread-shared engine/writer/tracer/gateway
+# objects get instrumented and any cross-thread write whose Eraser
+# candidate lockset drains to empty raises RaceError. CPU-world: runs
+# with the tunnel down.
+phase race_sanitizer 1800 make race
 # Program auditor, full tier (ISSUE 13): every registered program family
 # traced to jaxpr + AOT-lowered StableHLO on abstract inputs and gated
 # on all five contract families — donation honored in the alias table
@@ -166,7 +173,9 @@ phase program_audit 900 env JAX_PLATFORMS=cpu python -m heat_tpu audit
 # committed baseline within a tolerance band, every committed lab's
 # internal gates re-validated, the online cost model cross-checked
 # against calibration_v5e.json (hard gate on TPU, informational on
-# CPU), and (ISSUE 11) the HEAT_TPU_LOCKCHECK=1 lock-order watchdog's
-# serve-wave overhead verified noise-level with zero inversions.
+# CPU), (ISSUE 11) the HEAT_TPU_LOCKCHECK=1 lock-order watchdog's
+# serve-wave overhead verified noise-level with zero inversions, and
+# (ISSUE 14) the HEAT_TPU_RACECHECK race sanitizer's overhead gated the
+# same way with zero findings.
 phase perfcheck 1800 env JAX_PLATFORMS=cpu python -m heat_tpu perfcheck
 echo "=== extras_r5c done at $(date)"

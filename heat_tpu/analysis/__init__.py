@@ -7,7 +7,7 @@ record schema. Dapper's lesson (PAPERS.md) is that cross-cutting
 guarantees survive only when checked mechanically at every site; this
 package is that check, exposed as ``heat-tpu check`` / ``make check``.
 
-Five rule families (one module each, registered into
+Six rule families (one module each, registered into
 ``core.RULE_FAMILIES``):
 
 ====================  =====================================================
@@ -23,6 +23,13 @@ Five rule families (one module each, registered into
                       ``ops/pallas_stencil.py`` kernel bodies
 ``record-schema``     every ``json_record`` site statically resolved and
                       gated against ``analysis/schemas/records.json``
+``races``             Eraser-style lockset inference over the thread-
+                      shared serving objects: per-field write-guard
+                      intersection gated against
+                      ``analysis/schemas/guards.json``; a field written
+                      from two threads with no common lock fails (static
+                      half; ``HEAT_TPU_RACECHECK=1`` arms the dynamic
+                      sanitizer in ``runtime/debug.py``)
 ====================  =====================================================
 
 Sanctioned exceptions carry ``# heat-tpu: allow[rule-id] reason`` markers
@@ -43,6 +50,7 @@ needs JAX importable but nothing else, so it is NOT imported here: the
 AST tier must keep running in a tree where JAX is broken.
 """
 
-from . import deadcode, determinism, locks, mosaic, purity, schema  # noqa: F401
+from . import (deadcode, determinism, locks, mosaic, purity,  # noqa: F401
+               races, schema)
 from .core import (RULE_DOCS, RULE_FAMILIES, Context, Violation,  # noqa: F401
                    run_checks)

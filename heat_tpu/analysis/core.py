@@ -214,7 +214,8 @@ def run_checks(root, rules: Optional[List[str]] = None,
     they become ``stale-allow`` violations. Markers for known rules that
     were not selected this run are left alone — we cannot tell.
     """
-    from . import determinism, locks, mosaic, purity, schema  # noqa: F401
+    from . import (determinism, locks, mosaic, purity, races,  # noqa: F401
+                   schema)
     # (imports register the families; flake-quiet because the side effect
     # IS the point)
 

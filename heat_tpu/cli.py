@@ -1080,6 +1080,43 @@ def cmd_perfcheck(args) -> int:
               f"(saw {len(stats['violations'])}; edges observed: "
               f"{len(stats['edges'])})")
 
+        # dynamic racecheck overhead (ISSUE 14): same shape as the
+        # lockcheck gate — HEAT_TPU_RACECHECK=1 swaps the thread-shared
+        # objects onto instrumented classes whose __getattribute__/
+        # __setattr__ maintain Eraser candidate locksets, and that must
+        # stay affordable on a serve wave (it rides the chaos suite,
+        # not production). Correctness gate too: the armed waves must
+        # surface zero race findings.
+        _debug.reset_race_stats()
+        walls = {"off": [], "on": []}
+        prev = os.environ.pop("HEAT_TPU_RACECHECK", None)
+        try:
+            for mode in ("off", "on", "off", "on"):
+                if mode == "on":
+                    # "record" arms the same instrumentation as "1" but
+                    # logs findings instead of raising, so a regression
+                    # fails the gate below rather than crashing the wave
+                    os.environ["HEAT_TPU_RACECHECK"] = "record"
+                else:
+                    os.environ.pop("HEAT_TPU_RACECHECK", None)
+                walls[mode].append(_wave())
+        finally:
+            if prev is None:
+                os.environ.pop("HEAT_TPU_RACECHECK", None)
+            else:
+                os.environ["HEAT_TPU_RACECHECK"] = prev
+        ratio = min(walls["on"]) / min(walls["off"])
+        check(_band_ok(ratio, max(args.tolerance, 0.5)),
+              "racecheck overhead",
+              f"serve wave with the race sanitizer armed runs at "
+              f"{ratio:.3f}x the unarmed wall (noise-level band)")
+        rstats = _debug.race_stats()
+        check(not rstats["findings"], "racecheck findings",
+              f"zero race findings under the armed waves "
+              f"(saw {len(rstats['findings'])}; objects instrumented: "
+              f"{rstats['instrumented']})")
+        _debug.reset_race_stats()
+
     # lane-kernel cost rows (ISSUE 9): the committed kernel A/B must be
     # internally consistent — the cost model's kernel-keyed rows imply
     # the same pallas/xla cost ratio the measured drain walls show, and
@@ -1821,6 +1858,21 @@ def cmd_info(_args) -> int:
           f"(HEAT_TPU_LOCKCHECK=1; order "
           + " < ".join(sorted(_debug.LOCK_RANKS,
                               key=_debug.LOCK_RANKS.get)) + ")")
+
+    # race guard (ISSUE 14): the lockset analysis's committed guard map
+    # and whether THIS process's thread-shared objects were built with
+    # the dynamic race sanitizer armed
+    from .analysis.races import load_guard_map
+
+    _gmap = load_guard_map(Path(__file__).resolve().parent / "analysis"
+                           / "schemas" / "guards.json")
+    _nfld = len((_gmap or {}).get("fields", {}))
+    print(f"race guard: guard map {_nfld} field(s)"
+          + ("" if _gmap else " — MISSING, run heat-tpu check "
+             "--update-schemas") +
+          f"; race sanitizer "
+          f"{'ARMED' if _debug.racecheck_enabled() else 'available'} "
+          f"(HEAT_TPU_RACECHECK=1 raises, =record logs + flight-dumps)")
 
     # program auditor (ISSUE 13): the jaxpr-level half — registered
     # program families, committed digest population, and the declared

@@ -25,6 +25,20 @@ some-day deadlock into a deterministic test failure. Off (the default),
 ``make_lock`` returns a plain ``threading.Lock``: zero overhead, zero
 behavior change. The chaos suite and ``heat-tpu perfcheck`` run with the
 watchdog armed and assert zero inversions.
+
+The **race sanitizer** (``HEAT_TPU_RACECHECK=1`` to raise,
+``=record`` to log-and-continue) is the dynamic half of the ``races``
+static rule: :func:`instrument_races` arms Eraser-style per-(object,
+field) candidate-lockset tracking on the thread-shared serving objects
+(Engine, SnapshotWriter, Gateway, Tracer), fed by the watchdog's
+per-thread held stacks — ``make_lock`` hands out ordered locks whenever
+EITHER checker is armed. A write-write race with an empty lockset
+intersection raises :class:`RaceError` (tests) or emits a structured
+``race_detected`` record plus a flight-recorder dump (production);
+:func:`race_stats` is queryable like :func:`lock_order_stats`.
+:func:`install_thread_excepthook` rounds out the thread-debug story:
+an uncaught exception in a background thread becomes a structured
+``thread_crash`` record + flight dump instead of a silent stderr death.
 """
 
 from __future__ import annotations
@@ -156,7 +170,9 @@ def make_lock(name: str):
     if rank_name not in LOCK_RANKS:
         raise ValueError(f"unknown lock rank {rank_name!r} in lock name "
                          f"{name!r}; known: {sorted(LOCK_RANKS)}")
-    if not lockcheck_enabled():
+    # the race sanitizer needs the per-thread held stacks too: candidate
+    # locksets are computed from exactly this bookkeeping
+    if not (lockcheck_enabled() or racecheck_enabled()):
         return threading.Lock()
     return _OrderedLock(name, LOCK_RANKS[rank_name])
 
@@ -178,6 +194,245 @@ def reset_lock_order_stats() -> None:
     with _stats_lock:
         _edges.clear()
         _violations.clear()
+
+
+# --------------------------------------------------------------------------
+# Eraser-style race sanitizer (opt-in: HEAT_TPU_RACECHECK=1 | record)
+# --------------------------------------------------------------------------
+#
+# The dynamic half of the `races` static rule (heat_tpu/analysis/races.py):
+# per-(object, field) candidate locksets, maintained from the lock-order
+# watchdog's per-thread held stacks (Eraser, Savage et al. SOSP '97). A
+# field starts owned by its first-touching thread; when a second thread
+# touches it the candidate lockset is seeded from that thread's held
+# ordered locks and intersected on every later access. A WRITE from a
+# second writing thread with an empty lockset intersection is reported —
+# reads shift ownership state but only write locksets are judged, matching
+# the static guard map's contract (the repo's documented single-writer
+# GIL-publish pattern is sanctioned, write-write races are not).
+#
+# HEAT_TPU_RACECHECK=1      -> raise RaceError at the racing write (tests)
+# HEAT_TPU_RACECHECK=record -> emit a structured `race_detected` record and
+#                              trigger the registered flight-dump hook,
+#                              keep running (production triage)
+
+
+class RaceError(RuntimeError):
+    """A write-write race: a field written by two threads with no lock
+    consistently held across the writes."""
+
+
+_race_lock = threading.Lock()
+_race_findings: List[dict] = []
+_race_instrumented = 0
+_flight_dump_hook: Optional[callable] = None
+_instrumented_classes: dict = {}
+
+
+def racecheck_enabled() -> bool:
+    """Is the dynamic race sanitizer armed? Read at instrument/lock
+    creation time, like :func:`lockcheck_enabled`."""
+    return os.environ.get("HEAT_TPU_RACECHECK", "") in ("1", "record")
+
+
+def _racecheck_raises() -> bool:
+    return os.environ.get("HEAT_TPU_RACECHECK", "") == "1"
+
+
+def set_flight_dump_hook(fn: Optional[callable]) -> None:
+    """Register the flight-recorder dump callable (``Engine`` passes its
+    ``_flight_dump``); called with a reason string when a race or thread
+    crash is recorded in non-raising mode."""
+    global _flight_dump_hook
+    _flight_dump_hook = fn
+
+
+def _fire_flight_dump(reason: str) -> None:
+    hook = _flight_dump_hook
+    if hook is None:
+        return
+    try:
+        hook(reason)
+    except Exception as e:  # noqa: BLE001 — the dump must never compound
+        # the failure it is documenting
+        from .logging import master_print
+        master_print(f"race sanitizer: flight dump failed ({e})")
+
+
+def _race_access(obj, label: str, field: str, write: bool) -> None:
+    if getattr(_tls, "race_busy", False):
+        return
+    _tls.race_busy = True
+    try:
+        me = threading.get_ident()
+        held = frozenset(l.name for l in _held())
+        states = object.__getattribute__(obj, "_race_states")
+        finding = None
+        with _race_lock:
+            st = states.get(field)
+            if st is None:
+                states[field] = {"owner": me, "writers": set(
+                    [me] if write else []), "lockset": None,
+                    "reported": False}
+                return
+            if write:
+                st["writers"].add(me)
+                if len(st["writers"]) >= 2:
+                    st["lockset"] = (held if st["lockset"] is None
+                                     else st["lockset"] & held)
+            elif st["lockset"] is not None and me != st["owner"]:
+                # a reader participating after sharing narrows the set
+                # only if it holds SOME lock (a bare read is the
+                # sanctioned GIL-publish consumer, not a vote)
+                if held:
+                    st["lockset"] = st["lockset"] & held
+            if (write and st["lockset"] is not None
+                    and not st["lockset"] and not st["reported"]):
+                st["reported"] = True
+                finding = {
+                    "object": label, "field": field,
+                    "thread": threading.current_thread().name,
+                    "writers": len(st["writers"]),
+                    "held": sorted(held),
+                }
+                _race_findings.append(finding)
+        if finding is not None:
+            msg = (f"race detected: {label}.{field} written from "
+                   f"{finding['writers']} threads with empty lockset "
+                   f"intersection (this write on "
+                   f"{finding['thread']!r} holds "
+                   f"{finding['held'] or 'no locks'})")
+            if _racecheck_raises():
+                raise RaceError(msg)
+            from .logging import json_record, master_print
+            master_print(f"race sanitizer: {msg}")
+            json_record("race_detected", object=finding["object"],
+                        field=finding["field"], thread=finding["thread"],
+                        writers=finding["writers"],
+                        held=finding["held"])
+            _fire_flight_dump(f"race detected on {label}.{field}")
+    finally:
+        _tls.race_busy = False
+
+
+def _instrumented_class(base: type) -> type:
+    cached = _instrumented_classes.get(base)
+    if cached is not None:
+        return cached
+
+    class _RaceInstrumented(base):  # type: ignore[misc, valid-type]
+        __race_base__ = base
+
+        def __getattribute__(self, name):
+            val = object.__getattribute__(self, name)
+            if name.startswith("_race_") or (name.startswith("__")
+                                             and name.endswith("__")):
+                return val
+            d = object.__getattribute__(self, "__dict__")
+            watch = d.get("_race_watch")
+            if watch is not None and name in watch:
+                _race_access(self, d.get("_race_label", base.__name__),
+                             name, write=False)
+            return val
+
+        def __setattr__(self, name, value):
+            object.__setattr__(self, name, value)
+            d = object.__getattribute__(self, "__dict__")
+            watch = d.get("_race_watch")
+            if watch is not None and name in watch:
+                _race_access(self, d.get("_race_label", base.__name__),
+                             name, write=True)
+
+    _RaceInstrumented.__name__ = base.__name__
+    _RaceInstrumented.__qualname__ = base.__qualname__
+    _instrumented_classes[base] = _RaceInstrumented
+    return _RaceInstrumented
+
+
+def instrument_races(obj, label: Optional[str] = None,
+                     exempt: frozenset = frozenset()):
+    """Arm Eraser-style per-field lockset tracking on ``obj``.
+
+    No-op (and zero cost) unless :func:`racecheck_enabled`. The watched
+    set is the instance's ``__dict__`` at instrument time — call at the
+    END of ``__init__`` — minus ``exempt`` (fields the committed guard
+    map sanctions via allow-markers: instance-confined accounting,
+    lock-free rings), minus the synchronization objects themselves.
+    Returns ``obj``."""
+    global _race_instrumented
+    if not racecheck_enabled():
+        return obj
+    if getattr(type(obj), "__race_base__", None) is not None:
+        return obj  # already instrumented
+    import queue as _queue
+    sync_types = (threading.Event, threading.Condition,
+                  threading.Semaphore, _queue.Queue, _OrderedLock,
+                  type(threading.Lock()), type(threading.RLock()))
+    watch = frozenset(
+        k for k, v in vars(obj).items()
+        if k not in exempt and not k.startswith("_race_")
+        and not isinstance(v, sync_types) and not callable(v))
+    object.__setattr__(obj, "_race_states", {})
+    object.__setattr__(obj, "_race_watch", watch)
+    object.__setattr__(obj, "_race_label", label or type(obj).__name__)
+    obj.__class__ = _instrumented_class(type(obj))
+    with _race_lock:
+        _race_instrumented += 1
+    return obj
+
+
+def race_stats() -> dict:
+    """Sanitizer observations so far, queryable like
+    :func:`lock_order_stats`: instrumented-object count and every
+    recorded finding. The chaos suite asserts ``findings == []`` after a
+    full fault-injected wave under ``HEAT_TPU_RACECHECK=1``."""
+    with _race_lock:
+        return {"instrumented": _race_instrumented,
+                "findings": [dict(f) for f in _race_findings]}
+
+
+def reset_race_stats() -> None:
+    global _race_instrumented
+    with _race_lock:
+        _race_findings.clear()
+        _race_instrumented = 0
+
+
+# --------------------------------------------------------------------------
+# background-thread crash hook
+# --------------------------------------------------------------------------
+
+_excepthook_installed = False
+
+
+def install_thread_excepthook() -> None:
+    """Route uncaught background-thread exceptions (writer, scheduler,
+    gateway handler) into a structured ``thread_crash`` record plus a
+    flight-recorder dump instead of an easy-to-miss stderr traceback.
+    Idempotent; chains to the previously installed hook so default
+    stderr reporting (and pytest's capture) still sees the crash."""
+    global _excepthook_installed
+    if _excepthook_installed:
+        return
+    _excepthook_installed = True
+    prev = threading.excepthook
+
+    def hook(args):
+        try:
+            from .logging import json_record
+            name = args.thread.name if args.thread is not None else "?"
+            daemon = bool(args.thread.daemon) if args.thread is not None \
+                else False
+            json_record("thread_crash", thread=name,
+                        exc_type=getattr(args.exc_type, "__name__",
+                                         str(args.exc_type)),
+                        error=str(args.exc_value), daemon=daemon)
+            _fire_flight_dump(f"uncaught exception in thread {name}: "
+                              f"{getattr(args.exc_type, '__name__', '?')}")
+        finally:
+            prev(args)
+
+    threading.excepthook = hook
 
 
 @contextlib.contextmanager

@@ -118,6 +118,12 @@ class Tracer:
                                             # never grep the filesystem)
         self.dropped_hint = False           # ring wrapped at least once
         self._appended = 0
+        # race sanitizer (no-op unless HEAT_TPU_RACECHECK): the exempt
+        # trio is the allow-marked lock-free ring — _append stays a
+        # zero-instrumentation hot path even when the sanitizer is armed
+        debug.instrument_races(
+            self, label="Tracer",
+            exempt=frozenset({"_buf", "_appended", "dropped_hint"}))
 
     # --- identity ---------------------------------------------------------
     def mint_trace_id(self) -> str:
@@ -202,7 +208,7 @@ class Tracer:
 
     def _append(self, ev: tuple) -> None:
         self._appended += 1
-        if self._appended > self.capacity:
+        if self._appended > self.capacity:  # heat-tpu: allow[races] lock-free ring by design — deque.append is GIL-atomic and _appended/dropped_hint are advisory drop hints where a lost update only blurs the hint, so the span hot path takes no lock
             self.dropped_hint = True
         self._buf.append(ev)
 
@@ -272,18 +278,24 @@ class Tracer:
         flight-recorder exit: watchdog fire, quarantine-after-rollbacks,
         scheduler crash). Bounded per tracer (``MAX_FLIGHT_DUMPS``) and
         never allowed to raise into the failure path it is documenting."""
-        if not self.enabled or self.dumps >= MAX_FLIGHT_DUMPS:
-            return None
-        self.dumps += 1
+        with self._lock:
+            # atomic slot reserve: concurrent failure paths (watchdog on
+            # the scheduler thread, a client shutdown) must not both pass
+            # the bound check and overshoot MAX_FLIGHT_DUMPS
+            if not self.enabled or self.dumps >= MAX_FLIGHT_DUMPS:
+                return None
+            self.dumps += 1
+            seq = self.dumps
         stamp = time.strftime("%Y%m%dT%H%M%S")
-        path = Path(out_dir) / f"flightrec-{stamp}-{self.dumps}.trace.json"
+        path = Path(out_dir) / f"flightrec-{stamp}-{seq}.trace.json"
         try:
             self.export(path)
         except OSError as e:
             master_print(f"flight recorder: dump to {path} failed ({e}) — "
                          f"continuing without it")
             return None
-        self.dump_paths.append(str(path))
+        with self._lock:
+            self.dump_paths.append(str(path))
         master_print(f"flight recorder: {reason} — dumped {len(self._buf)} "
                      f"event(s) to {path}")
         return path

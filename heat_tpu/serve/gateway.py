@@ -448,6 +448,12 @@ class Gateway:
         self._drainer: Optional[threading.Thread] = None
         self._drain_lock = debug.make_lock("gateway:drain")
         self._drained = threading.Event()
+        # race sanitizer (no-op unless HEAT_TPU_RACECHECK): engine and
+        # httpd are object references on every handler's path — their
+        # own fields are watched by their own instrumentation
+        debug.instrument_races(
+            self, label="Gateway",
+            exempt=frozenset({"engine", "httpd"}))
 
     @property
     def address(self) -> str:

@@ -406,7 +406,8 @@ class _GroupRunner:
     boundary (the oldest handle).
     """
 
-    def __init__(self, outer: "Engine", key: BucketKey, q, writer):
+    def __init__(self, outer: "Engine", key: BucketKey, q,
+                 writer: "async_io.SnapshotWriter"):
         self.outer = outer
         self.key = key
         self.q = q
@@ -969,7 +970,8 @@ class MegaLaneRunner:
     resource, a wedged mega fetch (watchdog) fails the whole mega tier's
     in-flight and queued requests — one mesh, one fault domain."""
 
-    def __init__(self, outer: "Engine", slot: int, q, writer):
+    def __init__(self, outer: "Engine", slot: int, q,
+                 writer: "async_io.SnapshotWriter"):
         self.outer = outer
         self.slot = slot
         self.q = q
@@ -1471,6 +1473,13 @@ class Engine:
                                        # is admitted (gates _maybe_poison)
         self._fetch_seq = 0            # boundary-fetch counter (fetch-hang
                                        # @N addressing)
+        # race sanitizer (no-op unless HEAT_TPU_RACECHECK): exempt fields
+        # the committed guard map sanctions as benign — the idempotent
+        # mega-lane memo (allow-marked) and the typed object refs
+        debug_mod.instrument_races(
+            self, label="Engine",
+            exempt=frozenset({"_mega_lanes_resolved", "tracer", "prof",
+                              "scfg"}))
 
     # --- mega-lane placement (ISSUE 10) -----------------------------------
     @property
@@ -1481,6 +1490,7 @@ class Engine:
         Resolved lazily and once — the first overflow admission, summary
         or /metrics render pins it."""
         if self._mega_lanes_resolved is None:
+            # heat-tpu: allow[races] idempotent memo — every thread computes the same deterministic value from immutable config, and the publish is one GIL-atomic store; first-writer-wins needs no lock
             self._mega_lanes_resolved = (
                 self.scfg.mega_lanes if self.scfg.mega_lanes is not None
                 else (1 if mega_device_count() > 1 else 0))
@@ -2059,6 +2069,11 @@ class Engine:
         feeds lanes *while they run* — requests arriving between chunk
         boundaries are admitted at the next one (the Orca iteration-level
         contract, now actually online). Idempotent while running."""
+        # background-thread debug plumbing: uncaught crashes in the
+        # scheduler/writer/handler threads become structured thread_crash
+        # records, and the race sanitizer's record mode can flight-dump
+        debug_mod.install_thread_excepthook()
+        debug_mod.set_flight_dump_hook(self._flight_dump)
         with self._lock:
             if self._thread is not None and self._thread.is_alive():
                 return self
@@ -2166,7 +2181,8 @@ class Engine:
             # a scheduler-loop crash in a daemon thread has nowhere to
             # propagate: record it (gateway /healthz + cmd_serve check it)
             # and fail every in-flight/queued request cleanly
-            self.loop_error = e
+            with self._lock:
+                self.loop_error = e
             master_print(f"serve scheduler loop failed: "
                          f"{type(e).__name__}: {e}")
             self._flight_dump(f"scheduler loop crashed: "
@@ -2208,7 +2224,8 @@ class Engine:
                             "chunks": int(chunks), "bytes_written": 0}
         return rec
 
-    def _writeback_job(self, rec: dict, req: Request, writer,
+    def _writeback_job(self, rec: dict, req: Request,
+                       writer: "async_io.SnapshotWriter",
                        get_field) -> None:
         """Build + submit the writer-thread job for one finished request.
         ``get_field()`` produces the host field — under dispatch-ahead it

@@ -17,6 +17,8 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+import threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -30,3 +32,34 @@ def tmp_cwd(tmp_path, monkeypatch):
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)
+
+
+# Thread names the serving stack spawns; a test that exits while one is
+# still alive forgot shutdown()/drain and would leak its scheduler into
+# every later test (flaky cross-test interference, wedged CI teardown).
+_SERVE_THREAD_PREFIXES = ("heat-tpu-serve-scheduler", "heat-snapshot-writer",
+                          "heat-tpu-gateway")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_serve_threads():
+    """Fail the test that leaked a serving thread, not a random later one.
+
+    Checks by name so unrelated daemon helpers (e.g. the bounded-fetch
+    pool, which is process-lifetime by design) never false-positive.
+    Gives stragglers a short grace join first — a drained scheduler can
+    still be inside its last few bookkeeping lines when wait() returns.
+    """
+    yield
+    leaked = []
+    for t in threading.enumerate():
+        if t is threading.current_thread() or not t.is_alive():
+            continue
+        if t.name.startswith(_SERVE_THREAD_PREFIXES):
+            t.join(timeout=5.0)
+            if t.is_alive():
+                leaked.append(t.name)
+    assert not leaked, (
+        f"test leaked live serving thread(s): {leaked} — call "
+        f"Engine.shutdown() / Gateway.request_drain()+close() before "
+        f"returning")

@@ -7,6 +7,12 @@ the dogfood acceptance test (``heat-tpu check`` exits 0 on this repo),
 the schema-drift gate, allow-marker semantics, the ``check`` CLI, and the
 ``HEAT_TPU_LOCKCHECK=1`` watchdog (order violation raises; a real engine
 wave under the armed watchdog records zero inversions).
+
+ISSUE 14 adds the races family (Eraser-style lockset inference + the
+committed guard map) and the ``HEAT_TPU_RACECHECK`` dynamic sanitizer:
+seeded unguarded cross-thread writes must fail statically AND raise
+dynamically; guarded/allow-marked patterns stay quiet; guard-map drift
+is reviewable, never silent.
 """
 
 import json
@@ -562,9 +568,11 @@ def test_engine_wave_under_lockcheck_zero_inversions(lockcheck):
 def test_info_reports_static_analysis_line(capsys):
     assert main(["info"]) == 0
     out = capsys.readouterr().out
-    assert "static analysis: 5 rule families" in out
+    assert "static analysis: 6 rule families" in out
     assert "lock-order watchdog" in out
-    assert "schema registry 5 event(s)" in out
+    assert "schema registry 7 event(s)" in out
+    assert "race guard: guard map" in out
+    assert "race sanitizer available" in out
 
 
 # --- stale allow markers (ISSUE 13 satellite) -------------------------------
@@ -701,3 +709,329 @@ def test_dead_code_cli_informational(tmp_path, capsys):
 def test_repo_has_no_dead_code():
     from heat_tpu.analysis.deadcode import dead_code_report
     assert dead_code_report(PKG) == []
+
+
+# --- rule family 6: races (lockset / guard map, ISSUE 14) -------------------
+
+_RACY_PUMP = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._worker,
+                                            name="pump-worker")
+            self._thread.start()
+
+        def _worker(self):
+            self.count += 1
+
+        def bump(self):
+            self.count += 1
+"""
+
+_GUARDED_PUMP = _RACY_PUMP.replace(
+    "            self.count += 1",
+    "            with self._lock:\n                self.count += 1")
+
+
+def test_races_seeded_unguarded_write_fires(tmp_path):
+    root = _tree(tmp_path, {"serve/pump.py": _RACY_PUMP})
+    vs, _ = _run(root, rules=["races"], update_schemas=True)
+    msgs = _msgs(vs, "races")
+    assert len(msgs) == 2        # one per bare write site
+    assert all("field Pump.count is written from threads "
+               "[client+pump-worker] with no common lock" in m
+               for m in msgs)
+    payload = json.loads(
+        (root / "analysis/schemas/guards.json").read_text())
+    assert payload["fields"]["Pump.count"] == "UNGUARDED"
+
+
+def test_races_guarded_writes_are_quiet(tmp_path):
+    root = _tree(tmp_path, {"serve/pump.py": _GUARDED_PUMP})
+    vs, _ = _run(root, rules=["races"], update_schemas=True)
+    assert _msgs(vs, "races") == []
+    payload = json.loads(
+        (root / "analysis/schemas/guards.json").read_text())
+    assert payload["fields"]["Pump.count"] == "lock:_lock"
+    # _thread: written by the client only, never elsewhere
+    assert payload["fields"]["Pump._thread"].startswith(
+        ("thread-confined(client", "single-writer(client"))
+    # committed map in place -> a plain rerun is clean
+    vs, _ = _run(root, rules=["races"])
+    assert _msgs(vs, "races") == []
+
+
+def test_races_lock_held_through_private_helper(tmp_path):
+    root = _tree(tmp_path, {"serve/pump.py": """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def start(self):
+                t = threading.Thread(target=self._worker,
+                                     name="pump-worker")
+                t.start()
+
+            def _worker(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self.count += 1
+    """})
+    vs, _ = _run(root, rules=["races"], update_schemas=True)
+    assert _msgs(vs, "races") == []
+    payload = json.loads(
+        (root / "analysis/schemas/guards.json").read_text())
+    assert payload["fields"]["Pump.count"] == "lock:_lock"
+
+
+def test_races_allow_marker_sanctions_bare_writes(tmp_path):
+    marked = _RACY_PUMP.replace(
+        "            self.count += 1",
+        "            self.count += 1  "
+        "# heat-tpu: allow[races] GIL-atomic advisory counter")
+    root = _tree(tmp_path, {"serve/pump.py": marked})
+    vs, _ = _run(root, rules=["races"], update_schemas=True)
+    assert _msgs(vs, "races") == []
+    payload = json.loads(
+        (root / "analysis/schemas/guards.json").read_text())
+    assert payload["fields"]["Pump.count"] == \
+        "allow(GIL-atomic advisory counter)"
+
+
+def test_races_guard_map_missing_and_drift(tmp_path):
+    files = {"serve/pump.py": _GUARDED_PUMP}
+    root = _tree(tmp_path, files)
+    # 1) monitored classes exist but no committed map: the gate demands one
+    vs, _ = _run(root, rules=["races"])
+    assert any("guard map" in m and "missing/unreadable" in m
+               for m in _msgs(vs, "races"))
+    # 2) generate + clean rerun
+    vs, _ = _run(root, rules=["races"], update_schemas=True)
+    assert _msgs(vs, "races") == []
+    # 3) a new shared field appears -> reviewable drift, not silence
+    (root / "serve/pump.py").write_text(textwrap.dedent(
+        _GUARDED_PUMP.replace(
+            "        def _worker(self):",
+            "        def tag(self):\n"
+            "            self.flag = True\n\n"
+            "        def _worker(self):")))
+    vs, _ = _run(root, rules=["races"])
+    assert any("new shared field 'Pump.flag'" in m
+               for m in _msgs(vs, "races"))
+    # 4) a guard change is a concurrency-contract change: the worker
+    # write goes bare and the client writer disappears — count drops
+    # from lock:_lock to thread-confined(pump-worker)
+    (root / "serve/pump.py").write_text(textwrap.dedent(
+        _GUARDED_PUMP.replace(
+            "        def _worker(self):\n"
+            "            with self._lock:\n"
+            "                self.count += 1",
+            "        def _worker(self):\n"
+            "            self.count += 1").replace(
+            "        def bump(self):\n"
+            "            with self._lock:\n"
+            "                self.count += 1",
+            "        def bump(self):\n"
+            "            pass")))
+    vs, _ = _run(root, rules=["races"])
+    drift = [m for m in _msgs(vs, "races") if "changed" in m]
+    assert any("'Pump.count'" in m and "'lock:_lock'" in m
+               and "thread-confined(pump-worker)" in m for m in drift)
+    # 5) field gone entirely -> stale committed entry reported
+    (root / "serve/pump.py").write_text(textwrap.dedent("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+    """))
+    vs, _ = _run(root, rules=["races"])
+    assert any("'Pump.count'" in m and "no longer observed" in m
+               for m in _msgs(vs, "races"))
+
+
+def test_repo_guard_map_committed_and_clean():
+    """Dogfood: the repo's own guard map is committed, loadable, and the
+    races family passes against it (what `make check` enforces)."""
+    from heat_tpu.analysis.races import load_guard_map
+    gmap = load_guard_map(PKG / "analysis" / "schemas" / "guards.json")
+    assert gmap is not None and gmap["version"] == 1
+    assert len(gmap["fields"]) > 100
+    assert "UNGUARDED" not in set(gmap["fields"].values())
+    vs, _ = _run(PKG, rules=["races"])
+    assert _msgs(vs, "races") == []
+
+
+# --- the dynamic race sanitizer (HEAT_TPU_RACECHECK) ------------------------
+
+@pytest.fixture
+def racecheck(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_RACECHECK", "1")
+    debug.reset_race_stats()
+    yield
+    debug.reset_race_stats()
+
+
+class _Box:
+    def __init__(self, exempt=frozenset()):
+        self.lock = debug.make_lock("engine:box")
+        self.counter = 0
+        debug.instrument_races(self, label="Box", exempt=exempt)
+
+
+def test_race_instrument_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("HEAT_TPU_RACECHECK", raising=False)
+    b = _Box()
+    assert type(b) is _Box
+    assert debug.race_stats()["instrumented"] == 0
+
+
+def test_race_bare_write_from_second_thread_raises(racecheck):
+    import threading
+    b = _Box()
+    assert debug.race_stats()["instrumented"] == 1
+    b.counter = 1                      # constructing thread touches first
+    err = []
+
+    def bad():
+        try:
+            b.counter = 2
+        except debug.RaceError as e:
+            err.append(str(e))
+
+    t = threading.Thread(target=bad)
+    t.start()
+    t.join(timeout=10)
+    assert err and "Box.counter" in err[0]
+    assert "empty lockset intersection" in err[0]
+    findings = debug.race_stats()["findings"]
+    assert len(findings) == 1
+    assert findings[0]["field"] == "counter"
+
+
+def test_race_guarded_writes_quiet(racecheck):
+    import threading
+    b = _Box()
+
+    def work():
+        for _ in range(50):
+            with b.lock:
+                b.counter += 1
+
+    ts = [threading.Thread(target=work) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert b.counter == 150
+    assert debug.race_stats()["findings"] == []
+
+
+def test_race_condition_and_try_acquire_compat(racecheck):
+    """The sanitizer must see locks acquired through a Condition wrapper
+    and via non-blocking try-acquire as held — both feed the same
+    per-thread stack the watchdog maintains."""
+    import threading
+    b = _Box()
+    cond = threading.Condition(b.lock)
+
+    def via_cond():
+        for _ in range(20):
+            with cond:
+                b.counter += 1
+
+    def via_try():
+        for _ in range(20):
+            while not b.lock.acquire(blocking=False):
+                pass
+            try:
+                b.counter += 1
+            finally:
+                b.lock.release()
+
+    ts = [threading.Thread(target=via_cond),
+          threading.Thread(target=via_try)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert b.counter == 40
+    assert debug.race_stats()["findings"] == []
+
+
+def test_race_record_mode_logs_instead_of_raising(monkeypatch, capsys):
+    import threading
+    monkeypatch.setenv("HEAT_TPU_RACECHECK", "record")
+    debug.reset_race_stats()
+    dumps = []
+    debug.set_flight_dump_hook(lambda reason: dumps.append(reason))
+    try:
+        b = _Box()
+        b.counter = 1
+        t = threading.Thread(target=lambda: setattr(b, "counter", 2))
+        t.start()
+        t.join(timeout=10)
+        assert len(debug.race_stats()["findings"]) == 1
+        out = capsys.readouterr().out
+        assert '"event": "race_detected"' in out
+        assert '"field": "counter"' in out
+        assert dumps and "race" in dumps[0]
+    finally:
+        debug.set_flight_dump_hook(None)
+        debug.reset_race_stats()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_thread_excepthook_emits_crash_record(capsys):
+    import threading
+    debug.install_thread_excepthook()
+    dumps = []
+    debug.set_flight_dump_hook(lambda reason: dumps.append(reason))
+    try:
+        def boom():
+            raise ValueError("seeded crash")
+
+        t = threading.Thread(target=boom, name="crash-fixture")
+        t.start()
+        t.join(timeout=10)
+        out = capsys.readouterr().out
+        assert '"event": "thread_crash"' in out
+        assert '"thread": "crash-fixture"' in out
+        assert '"exc_type": "ValueError"' in out
+        assert dumps and "crash-fixture" in dumps[0]
+    finally:
+        debug.set_flight_dump_hook(None)
+
+
+def test_engine_wave_under_racecheck_zero_findings(racecheck):
+    """A real serve wave with the sanitizer armed: the engine, writer,
+    and tracer get instrumented and every cross-thread write must hit a
+    consistent candidate lockset — zero findings."""
+    from heat_tpu.config import HeatConfig
+    from heat_tpu.serve import Engine, ServeConfig
+
+    eng = Engine(ServeConfig(lanes=2, chunk=4, buckets=(32,),
+                             emit_records=False, keep_fields=True))
+    for i in range(4):
+        eng.submit(HeatConfig(n=16, ntime=12, dtype="float64"))
+    recs = eng.results()
+    assert [r["status"] for r in recs] == ["ok"] * 4
+    stats = debug.race_stats()
+    assert stats["findings"] == [], stats["findings"]
+    assert stats["instrumented"] >= 2   # engine + snapshot writer

@@ -214,3 +214,48 @@ def test_serve_chaos_under_lockcheck_zero_inversions(tmp_cwd, capsys,
     assert stats["violations"] == [], stats["violations"]
     assert any(e[0] == "engine" and e[1].startswith("observatory")
                for e in stats["edges"])
+
+
+def test_serve_chaos_under_racecheck_zero_findings(tmp_cwd, capsys,
+                                                   monkeypatch):
+    """ISSUE 14: the same fault-injected serve surface — lane-nan
+    quarantine with rollback heal, then a wedged fetch tripping the
+    group watchdog — under the armed race sanitizer
+    (HEAT_TPU_RACECHECK=1). Every cross-thread field write on the
+    instrumented engine, snapshot writer, tracer, and gateway objects
+    must keep a non-empty candidate lockset (or be an exempted,
+    allow-marked pattern): zero findings, and the sanitizer must have
+    actually instrumented the stack (not silently disarmed)."""
+    import json
+
+    from heat_tpu.runtime import debug, faults
+
+    monkeypatch.setenv("HEAT_TPU_RACECHECK", "1")
+    debug.reset_race_stats()
+
+    reqs = tmp_cwd / "reqs.jsonl"
+    lines = [{"id": f"r{i}", "n": (16, 24, 32)[i % 3], "ntime": 40,
+              "dtype": "float64"} for i in range(12)]
+    reqs.write_text("".join(json.dumps(d) + "\n" for d in lines))
+    base = ["serve", "--requests", "reqs.jsonl", "--buckets", "32",
+            "--chunk", "8", "--lanes", "4"]
+
+    # quarantine + rollback heal under the armed sanitizer (a finding
+    # raises RaceError inside the serve loop and fails the run)
+    faults.reset()
+    assert main([*base, "--inject", "lane-nan@16:req=r5",
+                 "--serve-on-nan", "rollback"]) == 0
+    recs = {r["id"]: r for r in
+            (json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{") and '"serve_request"' in l)}
+    assert all(r["status"] == "ok" for r in recs.values())
+
+    # wedged fetch -> watchdog group failure, still race-clean
+    faults.reset()
+    assert main([*base, "--inject", "fetch-hang:ms=2000",
+                 "--fetch-watchdog", "0.4"]) == 1
+    capsys.readouterr()
+
+    stats = debug.race_stats()
+    assert stats["findings"] == [], stats["findings"]
+    assert stats["instrumented"] >= 2
