@@ -9,7 +9,7 @@ SHELL := /bin/bash
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
-        lane-lab mega-lab perfcheck native run viz clean
+        numerics-lab lane-lab mega-lab perfcheck native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -123,6 +123,11 @@ prof-lab:              # observatory-overhead A/B: full cost-model/ledger/
                        # watermark/burn-rate metering vs off (<= 2% gate,
                        # npz bit-identity at depths 0 and 2)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/prof_overhead_lab.py
+
+numerics-lab:          # numerics-observatory A/B: boundary-vector stats
+                       # ingestion vs off (<= 2% gate, npz bit-identity at
+                       # depths 0 and 2, live-gateway probe verification)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/numerics_overhead_lab.py
 
 lane-lab:              # serve lane-kernel A/B: Pallas lane program vs XLA
                        # lane program vs solo Pallas drives (bit-identity

@@ -147,6 +147,14 @@ phase trace_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/trace_over
 # dispatch depths 0 and 2, with the usage ledger reconciling exactly
 # against the per-record stamps. CPU-world: runs with the tunnel down.
 phase prof_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/prof_overhead_lab.py
+# Numerics-observatory A/B (ISSUE 15): the serve_lab wave with per-lane
+# solution-quality stats (residual/min/max/heat riding the boundary
+# vector) ingested vs --numerics off — must stay within 2%, keep npz
+# outputs byte-identical at dispatch depths 0 and 2, verify one live
+# canary probe against the closed-form sine-eigenmode decay, and fire
+# the maximum-principle detector on a seeded perturb fault. CPU-world:
+# runs with the tunnel down.
+phase numerics_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/numerics_overhead_lab.py
 # Invariant guard (ISSUE 11 + 14): lint + the project-native
 # static-analysis suite (hot-path purity, lock discipline, traced-code
 # determinism, Mosaic kernel safety, race lockset inference) + the

@@ -1,0 +1,212 @@
+"""Numerics-observatory overhead A/B: solution-quality telemetry must
+ride for free.
+
+The ISSUE-15 design claim is "always-compute, host-gate": the chunk
+programs ALWAYS fuse the four per-lane stats (residual, min, max, heat)
+into the boundary vector, and ``--numerics`` gates only the host-side
+ingestion — so toggling it changes no device program, no transfer count,
+no output byte. This lab certifies the whole claim on the serve_lab
+population:
+
+- **on within 2% of off** (best-of-N walls, modes round-robined inside
+  each repeat — the trace/prof_overhead_lab protocol);
+- **bit-identity**: result npz files byte-identical with the observatory
+  on vs off at dispatch depths 0 AND 2;
+- **probe verification**: one real canary through a live Gateway
+  (serve/probe.py Prober.run_once — POST /v1/solve, GET ?field=1)
+  matches the closed-form sine-eigenmode decay within tolerance;
+- **detector fires**: a seeded ``perturb`` fault trips exactly one
+  maximum-principle violation (the observatory is measurably awake, not
+  just cheap).
+
+``heat-tpu perfcheck`` gates on the committed artifact's booleans.
+
+    JAX_PLATFORMS=cpu python benchmarks/numerics_overhead_lab.py [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from _util import write_atomic
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from serve_lab import build_requests  # noqa: E402  (benchmarks dir path)
+
+
+def run_mode(reqs, lanes, chunk, depth, numerics, out_dir=None):
+    from heat_tpu.serve import Engine, ServeConfig
+
+    eng = Engine(ServeConfig(lanes=lanes, chunk=chunk, buckets=(32, 48),
+                             dispatch_depth=depth, emit_records=False,
+                             numerics=numerics,
+                             out_dir=str(out_dir) if out_dir else None))
+    t0 = time.perf_counter()
+    ids = [eng.submit(cfg) for cfg in reqs]
+    records = eng.results()
+    wall = time.perf_counter() - t0
+    by_id = {r["id"]: r for r in records}
+    ok = sum(by_id[i]["status"] == "ok" for i in ids)
+    return wall, ok, eng, [by_id[i] for i in ids]
+
+
+def bit_identity(reqs, lanes, chunk, depth, tmp) -> bool:
+    """npz outputs byte-identical with the observatory on vs off."""
+    dirs = {}
+    for numerics in (False, True):
+        d = Path(tmp) / f"d{depth}_{'on' if numerics else 'off'}"
+        _, ok, _, recs = run_mode(reqs, lanes, chunk, depth, numerics,
+                                  out_dir=d)
+        if ok != len(reqs):
+            return False
+        dirs[numerics] = (d, recs)
+    d_off, recs_off = dirs[False]
+    d_on, _ = dirs[True]
+    return all(
+        (d_off / f"{r['id']}.npz").read_bytes()
+        == (d_on / f"{r['id']}.npz").read_bytes()
+        for r in recs_off)
+
+
+def probe_verification() -> dict:
+    """One REAL canary: Gateway on a localhost socket, Prober.run_once
+    through HTTP, verdict against the closed-form decay."""
+    from heat_tpu.serve import Engine, ServeConfig
+    from heat_tpu.serve.gateway import Gateway
+    from heat_tpu.serve.probe import Prober
+
+    eng = Engine(ServeConfig(lanes=2, chunk=16, buckets=(64,),
+                             emit_records=False, keep_fields=True))
+    gw = Gateway(eng, "127.0.0.1", 0, start_engine=True).start()
+    try:
+        verdict = Prober(f"http://{gw.address}",
+                         interval_s=3600.0).run_once()
+    finally:
+        gw.request_drain()
+        gw.wait_drained(120)
+        gw.close()
+    return verdict
+
+
+def detector_fires() -> bool:
+    """A seeded finite perturbation must trip exactly one
+    maximum-principle violation (guard=warn: observed, not guarded)."""
+    from heat_tpu.config import HeatConfig
+    from heat_tpu.runtime import faults
+    from heat_tpu.serve import Engine, ServeConfig
+
+    faults.reset()
+    try:
+        eng = Engine(ServeConfig(lanes=1, chunk=8, buckets=(32,),
+                                 emit_records=False, keep_fields=True,
+                                 inject="perturb@16:eps=100"))
+        eng.submit(HeatConfig(n=24, ntime=64, dtype="float32"))
+        recs = eng.results()
+        snap = eng.numerics.snapshot()
+        return (len(recs) == 1 and recs[0]["status"] == "ok"
+                and snap["violation_total"] == 1)
+    finally:
+        faults.reset()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--lanes", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--bit-requests", type=int, default=12,
+                    help="population for the per-depth npz bit-identity "
+                         "check (writes 4 result sets)")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="runs per mode; best wall is compared")
+    ap.add_argument("--out", default=str(Path(__file__).parent
+                                         / "numerics_overhead_lab.json"))
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    import jax
+
+    reqs = build_requests(args.requests)
+    work = sum(cfg.points * cfg.ntime for cfg in reqs)
+    tmp = Path(tempfile.mkdtemp(prefix="numerics_lab_"))
+
+    # one throwaway warm-up primes the persistent compile cache; modes
+    # round-robin inside each repeat so drift on a shared box hits both
+    run_mode(reqs, args.lanes, args.chunk, args.depth, numerics=False)
+    modes = {}
+    keep = {}
+    for rep in range(args.repeats):
+        for name, numerics in (("off", False), ("on", True)):
+            wall, ok, eng, _ = run_mode(reqs, args.lanes, args.chunk,
+                                        args.depth, numerics)
+            m = modes.setdefault(name, {"walls": [], "ok": ok})
+            m["walls"].append(round(wall, 3))
+            m["ok"] = min(m["ok"], ok)
+            keep[name] = eng
+    for m in modes.values():
+        m["wall_s"] = min(m["walls"])
+        m["points_per_s"] = round(work / m["wall_s"], 1)
+
+    overhead = modes["on"]["wall_s"] / modes["off"]["wall_s"] - 1.0
+    bit0 = bit_identity(build_requests(args.bit_requests), args.lanes,
+                        args.chunk, 0, tmp)
+    bit2 = bit_identity(build_requests(args.bit_requests), args.lanes,
+                        args.chunk, 2, tmp)
+    probe = probe_verification()
+    fires = detector_fires()
+    on_snap = keep["on"].numerics.snapshot()
+
+    rec = {
+        "bench": "numerics_overhead_lab",
+        "platform": jax.default_backend(),
+        "config": {"requests": args.requests, "lanes": args.lanes,
+                   "chunk": args.chunk, "dispatch_depth": args.depth,
+                   "repeats": args.repeats, "buckets": [32, 48],
+                   "dtype": "float64",
+                   "bit_requests": args.bit_requests},
+        "work_cell_steps": work,
+        "off": modes["off"], "on": modes["on"],
+        "on_overhead_frac": round(overhead, 4),
+        "on_within_2pct_of_off": overhead <= 0.02,
+        "bit_identical_depth0": bit0,
+        "bit_identical_depth2": bit2,
+        "probe_verification_ok": bool(probe["ok"]),
+        "probe_error_norm": probe["error_norm"],
+        "probe_latency_s": (None if probe["latency_s"] is None
+                            else round(probe["latency_s"], 3)),
+        "detector_fires_on_seeded_perturb": fires,
+        # the "on" engine's end-of-drain observatory state: all lanes
+        # retired (forget on every terminal path), totals monotone
+        "on_steady_total": on_snap["steady_total"],
+        "on_violation_total": on_snap["violation_total"],
+        "on_lanes_retired": not on_snap["lanes"],
+        "off_observatory_absent": keep["off"].numerics is None,
+    }
+    write_atomic(Path(args.out), rec)
+    print(json.dumps(rec, indent=2))
+    passed = (rec["on_within_2pct_of_off"] and bit0 and bit2
+              and rec["probe_verification_ok"] and fires
+              and rec["on_violation_total"] == 0
+              and rec["on_lanes_retired"]
+              and rec["off_observatory_absent"]
+              and all(m["ok"] == args.requests for m in modes.values()))
+    print(f"numerics_overhead_lab: {'OK' if passed else 'FAILED'} — "
+          f"off {modes['off']['wall_s']:.3f}s vs observatory on "
+          f"{modes['on']['wall_s']:.3f}s ({100 * overhead:+.2f}%; gate "
+          f"<= +2%); bit-identical npz depth0={bit0} depth2={bit2}; "
+          f"probe ok={rec['probe_verification_ok']} "
+          f"(err {probe['error_norm']}); perturb detector fires={fires}")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

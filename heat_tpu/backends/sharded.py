@@ -1164,12 +1164,17 @@ def make_mega_machinery(cfg: HeatConfig, mesh):
     - ``rem`` is an undonated ``(1,)`` int32 countdown — ``rem' =
       max(rem - k, 0)``, the same algebra the lane engine's per-lane
       countdown produces, so the scheduler's host mirror predicts it;
-    - ``boundary`` is the ``(2, 1)`` int32 vector of [remaining;
-      isfinite] the serve scheduler's boundary fetch expects — the
-      finite bit reduced over OWNED cells only (each shard contributes
-      its interior verdict through the same shard_map program; the
-      garbage ghost margins between exchanges never vote), so mega-lane
-      health rides the boundary D2H exactly like a packed lane's.
+    - ``boundary`` is the ``(K_BOUNDARY, 1)`` int32 vector of
+      [remaining; isfinite; bitcast numerics stats] the serve
+      scheduler's boundary fetch expects (serve/engine.BOUNDARY_ROWS) —
+      the finite bit and the stats reduced over OWNED cells only (each
+      shard contributes its interior verdict through the same shard_map
+      program; the garbage ghost margins between exchanges never vote),
+      so mega-lane health AND solution quality ride the boundary D2H
+      exactly like a packed lane's. The chunk's final step runs as its
+      own fused block so the pre-step owned cells are in scope for the
+      residual stat — owned-cell invariance under chunk partitioning
+      (the margin argument above) keeps the field bytes unchanged.
 
     ``seed``/``crop`` are the padded-carry entry/exit programs, returned
     un-jit-called so the serve engine can AOT-compile them once per
@@ -1187,27 +1192,47 @@ def make_mega_machinery(cfg: HeatConfig, mesh):
     seed = jax.jit(smap(lambda local: halo_pad(local, bc_value, kf),
                         out_specs=spec))
 
+    from ..serve.engine import pack_boundary
+
     @functools.partial(jax.jit, static_argnums=2, donate_argnums=0)
     def advance(Tp, rem, k: int):
         def body(padded):
-            n_fused, r_ = divmod(k, kf)
-            if n_fused:
-                padded = jax.lax.fori_loop(
-                    0, n_fused, lambda i, t: padded_multi(t, kf, kf), padded)
-            if r_:
-                padded = padded_multi(padded, kf, r_)
+            if k > 1:
+                n_fused, r_ = divmod(k - 1, kf)
+                if n_fused:
+                    padded = jax.lax.fori_loop(
+                        0, n_fused, lambda i, t: padded_multi(t, kf, kf),
+                        padded)
+                if r_:
+                    padded = padded_multi(padded, kf, r_)
+            prev = padded
+            # the chunk's final step is its own fused block so the
+            # pre-step owned cells feed the residual stat; owned cells
+            # are invariant under chunk partitioning, so the field
+            # bytes match the one-shot chunk body exactly
+            padded = padded_multi(padded, kf, 1)
             ctr = tuple(slice(kf, -kf) for _ in range(nd))
-            # per-shard owned-interior health bit: reading only (never
-            # writing) the stepped state, so bit-identity is untouched —
-            # the PR-5 lane-engine argument, one mesh wide
-            fin = jnp.isfinite(padded[ctr]).all().reshape((1,) * nd)
-            return padded, fin
+            # per-shard owned-interior health bit + numerics stats:
+            # reading only (never writing) the stepped state, so
+            # bit-identity is untouched — the PR-5 lane-engine
+            # argument, one mesh wide
+            own = padded[ctr].astype(jnp.float32)
+            one = (1,) * nd
+            fin = jnp.isfinite(padded[ctr]).all().reshape(one)
+            resid = jnp.abs(own - prev[ctr].astype(jnp.float32)
+                            ).max().reshape(one)
+            return (padded, fin, resid, own.min().reshape(one),
+                    own.max().reshape(one), own.sum().reshape(one))
 
-        Tp, fins = shard_map(body, mesh=mesh, in_specs=(spec,),
-                             out_specs=(spec, spec), check_vma=False)(Tp)
+        Tp, fins, resid, tmin, tmax, heat = shard_map(
+            body, mesh=mesh, in_specs=(spec,), out_specs=(spec,) * 6,
+            check_vma=False)(Tp)
         rem2 = jnp.maximum(rem - k, 0)
         finite = jnp.all(fins).astype(rem2.dtype).reshape((1,))
-        return Tp, rem2, jnp.stack([rem2, finite])
+        # cross-shard merge: max/min/max/sum over the per-shard partials
+        stats = jnp.stack([resid.max(), tmin.min(), tmax.max(),
+                           heat.sum()]).astype(jnp.float32).reshape(4, 1)
+        return Tp, rem2, pack_boundary(rem2, finite, stats)
 
     crop = jax.jit(smap(
         lambda p: p[tuple(slice(kf, -kf) for _ in range(nd))],

@@ -312,6 +312,39 @@ def build_parser() -> argparse.ArgumentParser:
                        help="chunk boundaries between device-memory "
                             "watermark samples (leak sentinel; default "
                             "32, 0 = never sample)")
+    serve.add_argument("--numerics", default="on", metavar="on|off",
+                       help="numerics observatory (runtime/numerics.py): "
+                            "per-lane residual EWMAs, discrete-maximum-"
+                            "principle + heat-jump detectors, steady-"
+                            "state records — fed from the four per-lane "
+                            "stats the chunk programs fuse into the "
+                            "boundary vector (no extra device passes or "
+                            "transfers; overhead gate: benchmarks/"
+                            "numerics_overhead_lab.json). 'off' = A/B "
+                            "baseline (stats still ride the boundary; "
+                            "host ingestion off) (default on)")
+    serve.add_argument("--steady-tol", dest="steady_tol", type=float,
+                       default=1e-12, metavar="TOL",
+                       help="residual-EWMA threshold below which a lane "
+                            "with steps remaining emits one steady_state "
+                            "record (interior max|dT| per mini-step; "
+                            "default 1e-12)")
+    serve.add_argument("--numerics-guard", dest="numerics_guard",
+                       choices=["warn", "quarantine"], default="warn",
+                       help="what a numerics_violation does: 'warn' = "
+                            "structured record + flight dump only; "
+                            "'quarantine' = additionally fail the "
+                            "request and free its lane (the PR-5 "
+                            "nonfinite quarantine path — co-scheduled "
+                            "lanes untouched) (default warn)")
+    serve.add_argument("--probe-interval", dest="probe_interval",
+                       type=float, default=0.0, metavar="S",
+                       help="with --listen: submit a known-answer canary "
+                            "probe (sine-eigenmode request under the "
+                            "reserved '_probe' tenant, verified against "
+                            "its closed-form decay) through the real "
+                            "gateway every S seconds (serve/probe.py; "
+                            "0 = prober off, the default)")
     serve.add_argument("--json", action="store_true",
                        help="also print a machine-readable summary line")
 
@@ -703,6 +736,15 @@ def _serve_report(summary, ok: int, args) -> None:
                      f"{summary['deadline_misses']} deadline miss(es), "
                      f"{summary['shed']} shed, "
                      f"{summary['watchdog_fired']} watchdog timeout(s)")
+    if summary.get("numerics"):
+        probes = ("" if "probe_pass" not in summary else
+                  f"; probes {summary['probe_pass']} pass / "
+                  f"{summary['probe_fail']} fail")
+        master_print(f"numerics: {summary.get('steady_lanes', 0)} steady "
+                     f"lane(s), {summary.get('numerics_violations', 0)} "
+                     f"violation(s) (guard "
+                     f"{summary.get('numerics_guard', 'warn')})"
+                     + probes)
     cm = summary.get("cost_model") or []
     if cm:
         tops = sorted(cm, key=lambda e: -e["wall_s"])[:3]
@@ -775,8 +817,18 @@ def cmd_serve(args) -> int:
                            prof=parse_on_off(args.prof, "--prof"),
                            slo_targets=parse_slo_targets(
                                args.slo_targets or ""),
+                           numerics=parse_on_off(args.numerics,
+                                                 "--numerics"),
+                           steady_tol=args.steady_tol,
+                           numerics_guard=args.numerics_guard,
                            **({"mem_poll_every": args.mem_poll}
                               if args.mem_poll is not None else {}))
+        if args.probe_interval < 0:
+            raise ValueError(f"--probe-interval must be >= 0, got "
+                             f"{args.probe_interval}")
+        if args.probe_interval and args.listen is None:
+            raise ValueError("--probe-interval needs --listen (the "
+                             "prober probes the HTTP gateway)")
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -808,6 +860,17 @@ def cmd_serve(args) -> int:
                  f"POST /v1/solve (NDJSON), GET /v1/requests/<id>, "
                  f"/healthz, /metrics; POST /drainz to drain "
                  f"(policy {scfg.policy})")
+    prober = None
+    if args.probe_interval:
+        from .serve.probe import Prober
+
+        prober = Prober(f"http://{gw.address}",
+                        interval_s=args.probe_interval).start()
+        eng.prober = prober   # /metrics + /statusz read stats() here
+        master_print(f"prober armed: sine-eigenmode canary every "
+                     f"{args.probe_interval:g}s through the real "
+                     f"gateway path (tenant '_probe' — probe_result "
+                     f"records; /metrics heat_tpu_probe_*)")
     try:
         gw.wait_drained()
     except KeyboardInterrupt:
@@ -815,7 +878,17 @@ def cmd_serve(args) -> int:
                      "finish; Ctrl-C again to abandon)")
         gw.request_drain()
         gw.wait_drained()
+    if prober is not None:
+        prober.stop()
+        ps = prober.stats()
+        # fold the probe verdicts into the end-of-serve summary so the
+        # drained report (and --json consumers) carry them
+        probe_counts = {"probe_pass": ps["passes"],
+                        "probe_fail": ps["fails"]}
+    else:
+        probe_counts = {}
     summary = eng.summary()
+    summary.update(probe_counts)
     summary["requests"] += parse_failures
     if parse_failures:
         summary["rejected"] = summary.get("rejected", 0) + parse_failures
@@ -987,7 +1060,12 @@ def cmd_perfcheck(args) -> int:
              (("mega_bit_identical", lambda v: v is True),
               ("zero_overflow_rejections", lambda v: v is True),
               ("packed_within_10pct", lambda v: v is True),
-              ("packed_within_10pct_of_serve_lab", lambda v: v is True)))):
+              ("packed_within_10pct_of_serve_lab", lambda v: v is True))),
+            ("numerics_overhead_lab.json",
+             (("on_within_2pct_of_off", lambda v: v is True),
+              ("bit_identical_depth0", lambda v: v is True),
+              ("bit_identical_depth2", lambda v: v is True),
+              ("probe_verification_ok", lambda v: v is True)))):
         p = bdir / fname
         if not p.exists():
             check(False, fname, "committed artifact missing")
@@ -1380,6 +1458,25 @@ def cmd_trace(args) -> int:
         return 2
     for line in lines:
         print(line)
+    if "flightrec" in path.name:
+        # flight dumps exist because something fired: name the likely
+        # trigger from the notable instants so triage starts with a
+        # cause, not a timeline scroll (priority: a numerics violation
+        # explains any quarantine that followed it)
+        ev_line = next((ln for ln in lines if ln.startswith("events: ")),
+                       "")
+        for marker, label in (
+                ("numerics-violation", "numerics violation — the field "
+                 "is finite but un-physical (numerics_violation records "
+                 "carry the witnesses; TROUBLESHOOTING.md)"),
+                ("watchdog-fired", "boundary-fetch watchdog timeout"),
+                ("quarantine", "lane quarantine (nonfinite / rollback "
+                 "budget exhausted)"),
+                ("rollback", "NaN rollback")):
+            if marker in ev_line:
+                print(f"flight-dump triage: {marker} instant(s) present "
+                      f"— likely trigger: {label}")
+                break
     return 0
 
 
@@ -1793,7 +1890,8 @@ def cmd_info(_args) -> int:
     # hits — shows up in serve output and the gateway log)
     print(f"trace defaults: flight recorder on (ring of "
           f"{trace_mod.DEFAULT_BUFFER} events; dumps flightrec-*.trace.json "
-          f"on watchdog/quarantine-after-rollbacks/scheduler-crash), "
+          f"on watchdog/quarantine-after-rollbacks/numerics-violation/"
+          f"scheduler-crash), "
           f"--trace FILE / HEAT_TPU_TRACE=FILE exports Chrome trace JSON "
           f"(Perfetto), GET /tracez on the gateway, `heat-tpu trace FILE` "
           f"for a text summary; HEAT_TPU_TRACE=off / --trace-buffer 0 "
@@ -1821,6 +1919,25 @@ def cmd_info(_args) -> int:
           f"{_comp['first_s']:.2f}s first-time, {_comp['warm_s']:.2f}s "
           f"warm) — structured per-compile events ride trace spans and "
           f"/metrics; per-program keys in GET /statusz")
+
+    # numerics observatory + canary prober (ISSUE 15): the solution-
+    # quality defaults — the dynamic half (steady/violation records,
+    # probe verdicts) prints per serve run and on /metrics, /statusz
+    from .runtime.numerics import ENVELOPE_TOL as _env_tol
+
+    print(f"numerics observatory: on by default (--numerics off = A/B "
+          f"baseline) — per-lane residual/min/max/heat stats ride the "
+          f"boundary vector (no extra device passes or transfers), "
+          f"steady-tol {_sd.steady_tol:g} (--steady-tol), guard "
+          f"{_sd.numerics_guard} (--numerics-guard warn|quarantine), "
+          f"max-principle tol f32 {_env_tol['float32']:g} / bf16 "
+          f"{_env_tol['bfloat16']:g} of envelope scale; overhead gate "
+          f"benchmarks/numerics_overhead_lab.json")
+    print(f"prober: off by default (--probe-interval S, needs --listen) "
+          f"— sine-eigenmode known-answer canary through the real "
+          f"gateway under tenant '_probe', verified against the closed-"
+          f"form lambda**s decay (grid.sine_decay_factor); "
+          f"probe_result/probe_failed records, /metrics heat_tpu_probe_*")
 
     # online gateway defaults (`heat-tpu serve --listen HOST:PORT`): the
     # admission policy and SLO-class table requests are validated against

@@ -24,7 +24,7 @@ from typing import Optional, Tuple
 _DTYPES = ("float64", "float32", "bfloat16")
 _BACKENDS = ("serial", "xla", "pallas", "sharded")
 _BCS = ("edges", "ghost", "periodic")
-_ICS = ("hat", "hat_half", "hat_small", "uniform", "zero")
+_ICS = ("hat", "hat_half", "hat_small", "uniform", "zero", "sine")
 _COMMS = ("direct", "staged")
 _ASYNC_IO = ("on", "off", "auto")
 _ON_NAN = ("abort", "rollback")

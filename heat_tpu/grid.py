@@ -12,6 +12,15 @@ reference variant silently ships a *different* hat initial condition
 - ``uniform``   : T=2 everywhere — pairs with the "ghost" BC for the MPI
                   variants' uniform-hot/cold-walls setup (fortran/mpi+cuda/heat.F90:243-251)
 - ``zero``      : T=0 (testing)
+- ``sine``      : product of per-axis ``sin(pi * i / (n-1))`` — the
+                  fundamental discrete eigenmode of the FTCS operator
+                  under frozen-edge BCs (edge samples pinned to exactly
+                  0). Under ``bc="edges"`` every step multiplies the
+                  whole field by the closed-form factor
+                  ``lambda = 1 - 4*ndim*r*sin^2(pi/(2*(n-1)))``, so step
+                  s equals ``lambda**s * T0`` analytically — the
+                  known-answer canary the serve prober submits
+                  (serve/probe.py, ISSUE 15)
 
 Two construction paths, bit-identical by design: ``initial_condition`` is
 pure numpy on host (mirroring the reference's host-side IC plus one H2D
@@ -23,6 +32,7 @@ host->device transfer exists at benchmark scale.
 
 from __future__ import annotations
 
+import math
 from typing import Tuple
 
 import numpy as np
@@ -57,6 +67,58 @@ _HAT_BOXES = {
 }
 
 
+def _sine_axis(n: int, dt) -> np.ndarray:
+    """Per-axis fundamental-mode samples ``sin(pi * i/(n-1))`` with the
+    two edge samples pinned to EXACTLY zero (float sin(pi) is ~1e-16, not
+    0; pinning makes the frozen-edge eigenmode argument exact, not just
+    near-exact). Built on host for both construction paths so the device
+    field is bit-identical to the host one — libm and XLA sin need not
+    agree to the last ulp, so the sine itself must only be computed
+    once."""
+    ax = np.sin(np.pi * np.arange(n, dtype=dt) / dt(n - 1)).astype(dt)
+    ax[0] = 0.0
+    ax[-1] = 0.0
+    return ax
+
+
+def _sine_field_np(cfg: HeatConfig, dt) -> np.ndarray:
+    ax = _sine_axis(cfg.n, dt)
+    out = None
+    for d in range(cfg.ndim):
+        sh = [1] * cfg.ndim
+        sh[d] = cfg.n
+        a = ax.reshape(sh)
+        out = a if out is None else out * a
+    return np.ascontiguousarray(np.broadcast_to(out, cfg.shape))
+
+
+def sine_decay_factor(cfg: HeatConfig) -> float:
+    """Closed-form per-step decay of the ``sine`` eigenmode under
+    ``bc="edges"``: each FTCS update multiplies the mode by
+    ``1 - 4*ndim*r*sin^2(pi/(2*(n-1)))`` (the discrete Laplacian's
+    fundamental eigenvalue, LeVeque's classic analysis — PAPERS.md), so
+    ``T_s = lambda**s * T0`` exactly in exact arithmetic. The serve
+    prober verifies returned fields against this (serve/probe.py)."""
+    lam = math.sin(math.pi / (2.0 * (cfg.n - 1))) ** 2
+    return 1.0 - 4.0 * cfg.ndim * float(cfg.r) * lam
+
+
+def ic_envelope(cfg: HeatConfig) -> Tuple[float, float]:
+    """Analytic ``[min, max]`` of the initial field INCLUDING the
+    boundary ring — the discrete-maximum-principle envelope the numerics
+    observatory arms its detector with (runtime/numerics.py). Analytic
+    (not a scan of T0) so mega-lane admission — which never materializes
+    a host field — costs nothing. ``ghost`` BCs clamp the ring at
+    ``bc_value``, which therefore joins the envelope."""
+    lo, hi = {
+        "uniform": (2.0, 2.0), "zero": (0.0, 0.0), "sine": (0.0, 1.0),
+    }.get(cfg.ic, (1.0, 2.0))   # the hat presets: 1 background, 2 hot
+    if cfg.bc == "ghost":
+        lo = min(lo, cfg.bc_value)
+        hi = max(hi, cfg.bc_value)
+    return float(lo), float(hi)
+
+
 def initial_condition(cfg: HeatConfig) -> np.ndarray:
     """Build the full initial field (including boundary/ghost-adjacent cells).
 
@@ -72,6 +134,8 @@ def initial_condition(cfg: HeatConfig) -> np.ndarray:
         return np.full(shape, 2.0, dtype=dt)
     if cfg.ic == "zero":
         return np.zeros(shape, dtype=dt)
+    if cfg.ic == "sine":
+        return _sine_field_np(cfg, dt)
     box = _HAT_BOXES[cfg.ic]
     ax = coords_1d(cfg.n, cfg.dom_len, dt)
     field = np.ones(shape, dtype=dt)
@@ -120,13 +184,27 @@ def initial_condition_device(cfg: HeatConfig, sharding=None):
 
     dt = jnp_dtype(cfg.dtype)
     shape = cfg.shape
-    bounds = None if cfg.ic in ("uniform", "zero") else _hat_index_bounds(cfg)
+    bounds = (None if cfg.ic in ("uniform", "zero", "sine")
+              else _hat_index_bounds(cfg))
+    # sine: the host-built axis (O(n), not O(n^d)) is the shared sine
+    # computation — libm vs XLA sin need not agree bitwise, so only the
+    # outer product runs on device; bfloat16 products accumulate in f32
+    # and cast once, matching the host-field-then-cast path exactly
+    sine_ax = _sine_axis(cfg.n, np_dtype(cfg.dtype)) if cfg.ic == "sine" else None
 
     def build():
         if cfg.ic == "uniform":
             return jnp.full(shape, 2.0, dtype=dt)
         if cfg.ic == "zero":
             return jnp.zeros(shape, dtype=dt)
+        if cfg.ic == "sine":
+            out = None
+            for d in range(cfg.ndim):
+                sh = [1] * cfg.ndim
+                sh[d] = cfg.n
+                a = jnp.asarray(sine_ax).reshape(sh)
+                out = a if out is None else out * a
+            return jnp.broadcast_to(out, shape).astype(dt)
         hot = None
         for d, (lo_i, hi_i) in enumerate(bounds):
             io = jax.lax.broadcasted_iota(jnp.int32, shape, d)

@@ -889,9 +889,12 @@ def ftcs_multistep_ghost_pallas(T: jax.Array, r: float, bc_value, ksteps: int) -
 # 3x3-banded plans, and ONE kernel fuses (a) the per-lane interior mask
 # (cells outside [lo, n-1-lo] of the per-lane request side n, SMEM-
 # resident like bounds_ref), (b) the per-lane countdown gating (a lane
-# whose remaining count ran out keeps its field, step-granular), and
-# (c) the per-lane isfinite health reduction — so lane health costs zero
-# extra passes over the stack instead of a separate post-chunk sweep.
+# whose remaining count ran out keeps its field, step-granular),
+# (c) the per-lane isfinite health reduction, and (d) the per-lane
+# numerics stats (ISSUE 15: final-mini-step residual, request-region
+# min/max, total heat — SMEM-accumulated next to the finite bit) — so
+# lane health AND solution-quality telemetry cost zero extra passes
+# over the stack instead of separate post-chunk sweeps.
 #
 # Bit-identity with the XLA lane program is a hard contract (the XLA path
 # stays the serving oracle): every mini-step replicates the exact
@@ -1007,6 +1010,43 @@ def _lane_finite_accumulate(fin_ref, lane, first_any, out_tile,
     fin_ref[0, lane] = jnp.minimum(fin_ref[0, lane], ok)
 
 
+def _lane_stats_accumulate(stats_ref, lane, first_any, prev_tile,
+                           out_tile, region, lanes: int):
+    """Fuse the per-lane numerics stats (ISSUE 15) into the stencil pass,
+    exactly the shape of ``_lane_finite_accumulate``: each grid instance
+    reduces its output tile (float32, the bf16 accumulation discipline)
+    under the REQUEST-REGION mask — buffer coords in ``[1, n_lane]``,
+    the field including its Dirichlet ring, a different mask from the
+    update's ``live`` — and merges four scalars into its lane's column
+    of the ONE (4, L) float32 SMEM block: row 0 max|out - prev| over
+    the pass's final mini-step (max-merge), row 1 region min
+    (min-merge), row 2 region max (max-merge), row 3 region sum
+    (add-merge). The first grid instance initializes every slot to the
+    merge identities. Cells outside the region contribute the
+    identities via select, so alignment padding and the margin never
+    leak into a lane's stats."""
+    f32 = out_tile.astype(jnp.float32)
+    delta = jnp.abs(f32 - prev_tile.astype(jnp.float32))
+    inf = jnp.float32(float("inf"))
+    resid = jnp.where(region, delta, jnp.float32(0)).max()
+    tmin = jnp.where(region, f32, inf).min()
+    tmax = jnp.where(region, f32, -inf).max()
+    heat = jnp.where(region, f32, jnp.float32(0)).sum()
+
+    @pl.when(first_any)
+    def _():
+        for idx in range(lanes):  # static unroll: 4L scalar SMEM stores
+            stats_ref[0, idx] = jnp.float32(0)
+            stats_ref[1, idx] = inf
+            stats_ref[2, idx] = -inf
+            stats_ref[3, idx] = jnp.float32(0)
+
+    stats_ref[0, lane] = jnp.maximum(stats_ref[0, lane], resid)
+    stats_ref[1, lane] = jnp.minimum(stats_ref[1, lane], tmin)
+    stats_ref[2, lane] = jnp.maximum(stats_ref[2, lane], tmax)
+    stats_ref[3, lane] = stats_ref[3, lane] + heat
+
+
 def _make_lane_kernel_2d(bc_lo: int, tile: int, kpad: int, n_pad: int,
                          ksteps: int, offset: int, lanes: int):
     """Multi-lane thin-band body: one (lane, row-tile) program instance.
@@ -1015,7 +1055,7 @@ def _make_lane_kernel_2d(bc_lo: int, tile: int, kpad: int, n_pad: int,
     rows = tile + 2 * kpad
 
     def kernel(r_ref, n_ref, rem_ref, prev_ref, cur_ref, next_ref,
-               out_ref, fin_ref):
+               out_ref, fin_ref, stats_ref):
         lane = pl.program_id(0)
         i = pl.program_id(1)
         store_dt = out_ref.dtype
@@ -1036,7 +1076,14 @@ def _make_lane_kernel_2d(bc_lo: int, tile: int, kpad: int, n_pad: int,
         hi = n_l + 1 - bc_lo
         live = ((grow > bc_lo) & (grow < hi)
                 & (gcol > bc_lo) & (gcol < hi))
+        prevb = band
         for s in range(ksteps):  # static unroll
+            if s == ksteps - 1:
+                # pre-final-step band: the residual stat's reference.
+                # Its out-tile rows are wrap-corruption-free too —
+                # corruption travels one cell per mini-step and
+                # ksteps - 1 < kpad (same invariant as `out`).
+                prevb = band
             # XLA-lane-program order: +1 neighbors in axis order, then -1
             # neighbors, then the center term (laplacian_interior)
             p0 = pltpu.roll(band, rows - 1, 0)
@@ -1049,8 +1096,18 @@ def _make_lane_kernel_2d(bc_lo: int, tile: int, kpad: int, n_pad: int,
             band = jnp.where(keep, upd, band)
         out = band[kpad: kpad + tile].astype(store_dt)
         out_ref[:] = out.reshape(1, tile, n_pad)
-        _lane_finite_accumulate(
-            fin_ref, lane, jnp.logical_and(lane == 0, i == 0), out, lanes)
+        first_any = jnp.logical_and(lane == 0, i == 0)
+        _lane_finite_accumulate(fin_ref, lane, first_any, out, lanes)
+        # request-region mask in OUT-TILE coords ([1, n_l] per axis —
+        # the Dirichlet ring included; distinct from `live`)
+        oshape = (tile, n_pad)
+        orow = i * tile + jax.lax.broadcasted_iota(jnp.int32, oshape, 0)
+        ocol = jax.lax.broadcasted_iota(jnp.int32, oshape, 1)
+        region = ((orow >= 1) & (orow <= n_l)
+                  & (ocol >= 1) & (ocol <= n_l))
+        _lane_stats_accumulate(stats_ref, lane, first_any,
+                               prevb[kpad: kpad + tile], out, region,
+                               lanes)
 
     return kernel
 
@@ -1074,10 +1131,11 @@ def _lane_pallas_2d(fields: jax.Array, r, n, rem, bc_lo: int, ksteps: int,
     main = lambda imap: pl.BlockSpec((1, tile, n_pad), imap,
                                      memory_space=pltpu.VMEM)
     band = tile + 2 * kpad
-    out, fin = pl.pallas_call(
+    out, fin, stats = pl.pallas_call(
         _make_lane_kernel_2d(bc_lo, tile, kpad, n_pad, ksteps, offset, L),
         out_shape=(jax.ShapeDtypeStruct(fields.shape, fields.dtype),
-                   jax.ShapeDtypeStruct((1, L), jnp.int32)),
+                   jax.ShapeDtypeStruct((1, L), jnp.int32),
+                   jax.ShapeDtypeStruct((4, L), jnp.float32)),
         grid=grid,
         in_specs=[
             smem, smem, smem,
@@ -1088,6 +1146,8 @@ def _lane_pallas_2d(fields: jax.Array, r, n, rem, bc_lo: int, ksteps: int,
         ],
         out_specs=(main(lambda l, i: (l, i, 0)),
                    pl.BlockSpec((1, L), lambda l, i: (0, 0),
+                                memory_space=pltpu.SMEM),
+                   pl.BlockSpec((4, L), lambda l, i: (0, 0),
                                 memory_space=pltpu.SMEM)),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_chip().vmem_limit_bytes,
@@ -1103,7 +1163,7 @@ def _lane_pallas_2d(fields: jax.Array, r, n, rem, bc_lo: int, ksteps: int,
       jnp.asarray(n, jnp.int32).reshape(1, L),
       jnp.asarray(rem, jnp.int32).reshape(1, L),
       fields, fields, fields)
-    return out, fin[0]
+    return out, fin[0], stats
 
 
 def _lane_grid_specs_3x3(R: int, M: int, ki: int, kj: int, nblocks,
@@ -1153,7 +1213,7 @@ def _make_lane_kernel_3d(bc_lo: int, R: int, M: int, kp: int, km: int,
     mids = M + 2 * km
 
     def kernel(r_ref, n_ref, rem_ref, *refs):
-        out_ref, fin_ref = refs[-2], refs[-1]
+        out_ref, fin_ref, stats_ref = refs[-3], refs[-2], refs[-1]
         lane = pl.program_id(0)
         i = pl.program_id(1)
         j = pl.program_id(2)
@@ -1176,7 +1236,12 @@ def _make_lane_kernel_3d(bc_lo: int, R: int, M: int, kp: int, km: int,
         gcol = jax.lax.broadcasted_iota(jnp.int32, bshape, 2)
         live = ((grow > bc_lo) & (grow < hi) & (gmid > bc_lo) & (gmid < hi)
                 & (gcol > bc_lo) & (gcol < hi))
+        prevb = band
         for s in range(ksteps):  # static unroll, constant shapes
+            if s == ksteps - 1:
+                # pre-final-step band for the residual stat (wrap-safe
+                # on the out tile: ksteps - 1 < kp <= km)
+                prevb = band
             # XLA-lane-program order: +axis0 +axis1 +axis2, then -axis0
             # -axis1 -axis2, then the center term (laplacian_interior)
             p0 = pltpu.roll(band, rows - 1, 0)
@@ -1195,6 +1260,18 @@ def _make_lane_kernel_3d(bc_lo: int, R: int, M: int, kp: int, km: int,
         first_any = jnp.logical_and(lane == 0,
                                     jnp.logical_and(i == 0, j == 0))
         _lane_finite_accumulate(fin_ref, lane, first_any, out, lanes)
+        # request-region mask in OUT-TILE coords (Dirichlet ring in,
+        # padding/margin out — distinct from `live`)
+        oshape = (R, M, n_pad)
+        orow = i * R + jax.lax.broadcasted_iota(jnp.int32, oshape, 0)
+        omid = j * M + jax.lax.broadcasted_iota(jnp.int32, oshape, 1)
+        ocol = jax.lax.broadcasted_iota(jnp.int32, oshape, 2)
+        region = ((orow >= 1) & (orow <= n_l) & (omid >= 1) & (omid <= n_l)
+                  & (ocol >= 1) & (ocol <= n_l))
+        prev_out = jax.lax.slice(prevb, (kp, km, 0),
+                                 (kp + R, km + M, n_pad))
+        _lane_stats_accumulate(stats_ref, lane, first_any, prev_out, out,
+                               region, lanes)
 
     return kernel
 
@@ -1213,15 +1290,18 @@ def _lane_pallas_3d(fields: jax.Array, r, n, rem, bc_lo: int, ksteps: int,
     in_specs, out_spec = _lane_grid_specs_3x3(
         R, M, kp, km, (m_pad // kp, mid_pad // km), n_pad)
     band = (R + 2 * kp) * (M + 2 * km)
-    out, fin = pl.pallas_call(
+    out, fin, stats = pl.pallas_call(
         _make_lane_kernel_3d(bc_lo, R, M, kp, km, n_pad, ksteps, offset,
                              L),
         out_shape=(jax.ShapeDtypeStruct(fields.shape, fields.dtype),
-                   jax.ShapeDtypeStruct((1, L), jnp.int32)),
+                   jax.ShapeDtypeStruct((1, L), jnp.int32),
+                   jax.ShapeDtypeStruct((4, L), jnp.float32)),
         grid=grid,
         in_specs=[smem, smem, smem] + in_specs,
         out_specs=(out_spec,
                    pl.BlockSpec((1, L), lambda l, i, j: (0, 0),
+                                memory_space=pltpu.SMEM),
+                   pl.BlockSpec((4, L), lambda l, i, j: (0, 0),
                                 memory_space=pltpu.SMEM)),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=_chip().vmem_limit_bytes,
@@ -1237,22 +1317,31 @@ def _lane_pallas_3d(fields: jax.Array, r, n, rem, bc_lo: int, ksteps: int,
       jnp.asarray(n, jnp.int32).reshape(1, L),
       jnp.asarray(rem, jnp.int32).reshape(1, L),
       *([fields] * 9))
-    return out, fin[0]
+    return out, fin[0], stats
 
 
 def lane_multistep(fields: jax.Array, r, n, rem, ksteps: int, bc_lo: int,
                    bucket_n: int):
     """``ksteps`` masked, countdown-gated FTCS steps over a stacked lane
-    array via the multi-lane Pallas kernels, health reduction fused in.
+    array via the multi-lane Pallas kernels, health reduction and
+    numerics stats fused in.
 
     ``fields`` is (L,) + ``lane_state_shape(...)`` (the engine keeps its
     stack in the padded layout); ``r``/``n``/``rem`` are the per-lane
     scalar vectors of the serving engine's chunk program. Returns
-    ``(fields, finite)`` — ``finite`` a per-lane bool, False iff that
-    lane's post-chunk slab holds a non-finite value. Gate callers on
-    ``lane_kernel_available``; chunks deeper than the per-pass fusion cap
-    run as multiple passes with the countdown gate offset so a lane still
-    stops at exactly its own step count."""
+    ``(fields, finite, stats)`` — ``finite`` a per-lane bool, False iff
+    that lane's post-chunk slab holds a non-finite value; ``stats`` a
+    (4, L) float32 of per-lane (resid, tmin, tmax, heat) over the
+    request region (serve/engine.BOUNDARY_ROWS rows 2-5). Multi-pass
+    chunks AND the finite bits across passes and keep the LAST pass's
+    stats — the pass holding the chunk's final mini-step, whose
+    residual/min/max/heat are the chunk-boundary values by definition.
+    Stats are tolerance-compatible, not bit-equal, with the XLA lane
+    program's (grid-tiled reduction order differs); the field bytes and
+    rows 0-1 stay bit-exact. Gate callers on ``lane_kernel_available``;
+    chunks deeper than the per-pass fusion cap run as multiple passes
+    with the countdown gate offset so a lane still stops at exactly its
+    own step count."""
     assert ksteps >= 1, ksteps
     nd = fields.ndim - 1
     dtype_str = str(fields.dtype)
@@ -1265,15 +1354,15 @@ def lane_multistep(fields: jax.Array, r, n, rem, ksteps: int, bc_lo: int,
     assert plan is not None, (
         f"no lane kernel plan for {nd}d bucket {bucket_n} {dtype_str} "
         f"(gate on lane_kernel_available before calling)")
-    fin = None
+    fin = stats = None
     done = 0
     while done < ksteps:
         kpass = min(kp, ksteps - done)
-        fields, f = step(fields, r, n, rem, bc_lo=bc_lo, ksteps=kpass,
-                         offset=done, plan=plan)
+        fields, f, stats = step(fields, r, n, rem, bc_lo=bc_lo,
+                                ksteps=kpass, offset=done, plan=plan)
         fin = f if fin is None else jnp.minimum(fin, f)
         done += kpass
-    return fields, fin.astype(bool)
+    return fields, fin.astype(bool), stats
 
 
 # the plan caches embed the chip's rates/caps in their values; a chip-model

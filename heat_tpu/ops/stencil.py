@@ -80,6 +80,21 @@ def ftcs_step_edges(T: jax.Array, r) -> jax.Array:
 
     Interior cells get T + r*lap; the outermost ring is returned unchanged
     (the serial loop bounds 2..n-1, fortran/serial/heat.f90:64-68).
+
+    Two analytic properties of this update back the numerics observatory
+    (ISSUE 15) and must survive any refactor here:
+
+    - **discrete maximum principle** — with ``r <= 1/(2*ndim)`` (the CFL
+      bound ``config.HeatConfig`` enforces via sigma) the update is a
+      convex combination ``(1-2*ndim*r)*T + r*sum(neighbors)``, so no
+      cell can ever escape ``[min(T0, bc), max(T0, bc)]``. The per-lane
+      min/max witnesses the chunk programs fuse into the boundary vector
+      (serve/engine rows 2-5) are checked against exactly this envelope.
+    - **sine eigenmode decay** — the ``sine`` IC preset (grid.py) is an
+      eigenvector of this operator: each step multiplies it by
+      ``1 - 4*ndim*r*sin^2(pi/(2*(n-1)))`` (``grid.sine_decay_factor``),
+      the closed form the serve canary prober verifies end to end
+      (serve/probe.py).
     """
     acc_dt = accum_dtype_for(T.dtype)
     ctr = tuple(slice(1, -1) for _ in range(T.ndim))

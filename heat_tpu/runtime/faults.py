@@ -37,6 +37,17 @@ serve/scheduler.py — ignored by the solo drive loop):
                                before transferring: a wedged-device
                                analog for the boundary fetch watchdog
                                (fire-once).
+- ``perturb@N[:req=ID][:eps=E]`` — add a bounded (finite!) perturbation
+                               ``eps`` (default 1e3) to one cell of a
+                               serving lane's field once that lane's
+                               request has completed >= N steps
+                               (fire-once per request; same ``req=``
+                               targeting as lane-nan). The soft-error
+                               analog the numerics observatory exists
+                               for: the field stays finite, so the
+                               isfinite bit never drops, but the
+                               maximum-principle witnesses escape their
+                               envelope. Pairs with ``--numerics-guard``.
 
 Specs come from ``--inject`` (``HeatConfig.inject``) or the
 ``HEAT_TPU_FAULTS`` env var (so ``heat-tpu launch`` workers inherit one
@@ -75,7 +86,7 @@ RESTART_ENV_VAR = "HEAT_TPU_RESTART"
 CRASH_RC = 43
 
 _KINDS = ("crash", "nan", "ckpt-corrupt", "ckpt-truncate",
-          "sink-error", "sink-slow", "lane-nan", "fetch-hang")
+          "sink-error", "sink-slow", "lane-nan", "fetch-hang", "perturb")
 
 
 @dataclasses.dataclass
@@ -86,7 +97,10 @@ class Fault:
     times: int = 1              # sink-error: how many writes fail
     ms: float = 0.0             # sink-slow / fetch-hang: delay
     restart: int = 0            # incarnation filter (-1 = every incarnation)
-    req: Optional[str] = None   # lane-nan: target request id (None = all)
+    req: Optional[str] = None   # lane-nan/perturb: target request id
+                                # (None = all)
+    eps: float = 1e3            # perturb: added to one cell (finite, big
+                                # enough to escape any envelope tolerance)
     fired: bool = False
 
 
@@ -137,16 +151,18 @@ def parse_spec(spec: str) -> List[Fault]:
                 raise ValueError(f"bad step {step_s!r} in fault {entry!r}")
         for kv in filter(None, tail.split(":")):
             key, eq, val = kv.partition("=")
-            if not eq or key not in ("proc", "times", "ms", "restart", "req"):
+            if not eq or key not in ("proc", "times", "ms", "restart",
+                                     "req", "eps"):
                 raise ValueError(
                     f"bad fault param {kv!r} in {entry!r}; keys are "
-                    f"proc=, times=, ms=, restart=, req=")
+                    f"proc=, times=, ms=, restart=, req=, eps=")
             try:
                 setattr(f, key, val if key == "req"
-                        else float(val) if key == "ms" else int(val))
+                        else float(val) if key in ("ms", "eps")
+                        else int(val))
             except ValueError:
                 raise ValueError(f"bad value {val!r} for {key} in {entry!r}")
-        if f.kind in ("crash", "nan", "lane-nan") and f.step is None:
+        if f.kind in ("crash", "nan", "lane-nan", "perturb") and f.step is None:
             raise ValueError(f"fault {entry!r} needs a step: '{f.kind}@N'")
         faults.append(f)
     return faults
@@ -201,6 +217,15 @@ class FaultPlan:
         requests sharing one spec must not share a fired flag) — this
         only answers 'which steps apply to this request'."""
         return sorted(f.step for f in self._live("lane-nan")
+                      if f.req is None or f.req == req_id)
+
+    def perturb_events(self, req_id: str) -> List[tuple]:
+        """``(step, eps)`` thresholds at which ``req_id``'s serving lane
+        must be perturbed (finite bounded bump — the numerics-observatory
+        test fault). Same per-request firing contract as lane_nan_steps:
+        the scheduler owns the fire-once state, this only answers 'which
+        events apply to this request'."""
+        return sorted((f.step, f.eps) for f in self._live("perturb")
                       if f.req is None or f.req == req_id)
 
     def maybe_fetch_hang(self, fetch_index: int) -> None:

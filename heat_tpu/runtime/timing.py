@@ -154,6 +154,13 @@ class Timing:
     # device-memory watermark the boundary-cadence sampler saw — the
     # number a capacity plan (and the leak sentinel) keys on.
     mem_peak_bytes: int | None = None
+    # Numerics-observatory accounting (runtime/numerics.py; None when the
+    # observatory is off). steady_lanes: requests whose residual EWMA
+    # converged below --steady-tol with steps still remaining (fire-once
+    # per request). numerics_violations: maximum-principle escapes +
+    # heat-content jumps detected (one verdict per request).
+    steady_lanes: int | None = None
+    numerics_violations: int | None = None
 
     @property
     def per_step_s(self) -> float:
@@ -192,4 +199,8 @@ class Timing:
         if self.mem_peak_bytes is not None:
             lines.append(f"observatory: mem peak "
                          f"{self.mem_peak_bytes / 2**20:.1f} MiB")
+        if self.steady_lanes is not None:
+            lines.append(
+                f"numerics: {self.steady_lanes} steady lane(s), "
+                f"{self.numerics_violations or 0} violation(s)")
         return lines

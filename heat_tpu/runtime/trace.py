@@ -33,7 +33,11 @@ Event model (Chrome trace-event format, the subset Perfetto renders):
 
 - ``X`` complete spans (ts + dur) on a (pid, tid) *track* — lane
   occupancy, chunk in flight, boundary fetch, writer jobs, HTTP handling;
-- ``i`` instants — enqueue, rollback, quarantine, watchdog, growth;
+- ``i`` instants — enqueue, rollback, quarantine, watchdog, growth,
+  numerics verdicts (steady-state, numerics-violation);
+- ``C`` counter samples — the numerics observatory's per-lane residual
+  and total-heat series, one sample per chunk boundary, rendered by
+  Perfetto as stacked counter tracks;
 - ``b``/``e`` async spans (id-paired, overlap-safe) — per-request queue
   wait, which can overlap arbitrarily on one tenant track;
 - ``s``/``t``/``f`` flow events (id = the request's trace id) stitching
@@ -87,7 +91,7 @@ def process_uptime_s() -> float:
 #   ts/dur   seconds on the time.perf_counter clock (the scheduler's
 #            wall_clock seam uses the same clock, so queue-wait spans can
 #            reuse submit timestamps verbatim); dur None except for "X"
-#   ph       Chrome phase: X i b e s t f
+#   ph       Chrome phase: X i b e s t f C
 #   xid      trace/flow/async id (string) or None
 #   args     small dict or None — the caller must not mutate it afterwards
 
@@ -180,6 +184,18 @@ class Tracer:
             return
         self._append((time.perf_counter() if ts is None else ts, None, "i",
                       name, cat, track[0], track[1], trace_id, args))
+
+    def counter(self, name: str, track: Tuple[int, int], values: dict,
+                cat: str = "numerics", ts: Optional[float] = None) -> None:
+        """One sample of a named counter track (phase "C"): ``values``
+        maps series name -> number and must not be mutated by the caller
+        afterwards (same no-copy contract as every ``args`` here). The
+        numerics observatory emits one of these per lane per chunk
+        boundary — residual + total heat riding the boundary vector."""
+        if not self.enabled:
+            return
+        self._append((time.perf_counter() if ts is None else ts, None, "C",
+                      name, cat, track[0], track[1], None, values))
 
     def flow(self, phase: str, track: Tuple[int, int], flow_id: str,
              name: str = "request", ts: Optional[float] = None) -> None:
@@ -433,10 +449,29 @@ def summarize(chrome: dict, top: int = 5) -> List[str]:
             lines.append(f"{label}: {tot / 1e6:.3f}s over {n} span(s) "
                          f"({100.0 * tot / wall:.1f}% of trace wall)")
 
+    # counter tracks ("C" samples — the numerics observatory's per-lane
+    # residual/heat series): min/max/last per series, so a text triage
+    # shows whether a residual was still falling when the trace ended
+    counters: Dict[Tuple[str, str], List[float]] = collections.defaultdict(list)
+    for e in data:
+        if e.get("ph") != "C":
+            continue
+        for series, v in (e.get("args") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[(e.get("name", "?"), series)].append(float(v))
+    if counters:
+        lines.append("counter tracks:")
+        for (name, series), vals in sorted(counters.items()):
+            lines.append(
+                f"  {name}/{series}: {len(vals)} sample(s), "
+                f"min {min(vals):.3g}, max {max(vals):.3g}, "
+                f"last {vals[-1]:.3g}")
+
     notable = collections.Counter(
         e["name"] for e in data if e.get("ph") == "i"
         and e.get("name") in ("watchdog-fired", "rollback", "quarantine",
-                              "deadline-shed", "lane-tier-grow"))
+                              "deadline-shed", "lane-tier-grow",
+                              "numerics-violation", "steady-state"))
     if notable:
         lines.append("events: " + ", ".join(
             f"{n} {k}" for k, n in sorted(notable.items())))

@@ -59,11 +59,27 @@ the allocation that matters — ping-pongs through donation.
 
 Per-lane fault domains (the ISSUE-5 rework): the chunk program
 additionally reduces each lane's post-chunk field to a per-lane
-``isfinite`` bit and returns a ``(2, L)`` int32 *boundary vector* —
-row 0 the remaining-step counts, row 1 the finite bits — so the health
-verdict rides the boundary fetch the scheduler already pays for, with
-no extra D2H and no change to what the lanes compute (the reduction
-reads the fields; it never writes them, so bit-identity is untouched).
+``isfinite`` bit and returns an int32 *boundary vector* — row 0 the
+remaining-step counts, row 1 the finite bits — so the health verdict
+rides the boundary fetch the scheduler already pays for, with no extra
+D2H and no change to what the lanes compute (the reduction reads the
+fields; it never writes them, so bit-identity is untouched).
+
+Numerics telemetry on the boundary (the ISSUE-15 rework): the boundary
+vector is ``(K_BOUNDARY, L)`` = ``(6, L)`` int32 — rows 0–1 the
+remaining/finite pair above, unchanged, and rows 2–5 four per-lane
+float32 solution-quality statistics BITCAST into the int32 carrier
+(``pack_boundary``/``unpack_boundary``): the interior ``max|ΔT|`` over
+the chunk's final mini-step (steady-state residual), the
+request-region min and max (the discrete-maximum-principle witnesses),
+and the total heat content ``ΣT``. Both chunk bodies compute them
+fused into the reductions they already run (the XLA body peels the
+final ``fori_loop`` step to hold the pre-step stack; the Pallas kernel
+accumulates them in the SMEM pass next to the isfinite bit), so
+solution-quality telemetry costs zero extra sweeps, zero extra
+transfers, and zero change to the field bytes. The stats rows are
+always computed (no recompile dimension); ``ServeConfig.numerics``
+gates only host-side ingestion (runtime/numerics.py).
 ``fetch_remaining`` optionally wraps the transfer in a watchdog
 (``runtime/async_io.bounded_call``): a wedged device fetch becomes a
 clean ``BoundedFetchTimeout`` the scheduler turns into per-request
@@ -113,6 +129,69 @@ def host_fetch(x) -> np.ndarray:
     (ISSUE 4 regression contract) and to count fetches per boundary."""
     # heat-tpu: allow[hot-path-purity] THE sanctioned D2H seam itself
     return np.asarray(x)
+
+
+# The per-lane boundary vector's row layout (ISSUE 15). Rows 0-1 are
+# plain int32 (the original remaining/finite pair — every consumer's
+# ``rem, finite = b[0], b[1]`` reads them unchanged); rows 2-5 are
+# float32 statistics bitcast into the int32 carrier so ONE array — one
+# dispatch output, one D2H — carries progress, health, and solution
+# quality per lane per chunk.
+BOUNDARY_ROWS = ("remaining", "finite", "resid", "tmin", "tmax", "heat")
+K_BOUNDARY = len(BOUNDARY_ROWS)
+
+
+def pack_boundary(remaining, finite, stats):
+    """Device-side boundary assembly: stack the int32 remaining/finite
+    rows over the ``(4, L)`` float32 stats block bitcast to int32 (a
+    free reinterpret — no rounding, NaN/Inf payloads survive exactly).
+    The inverse is ``unpack_boundary`` on the fetched host array."""
+    import jax
+    import jax.numpy as jnp
+
+    bits = jax.lax.bitcast_convert_type(stats.astype(jnp.float32),
+                                        jnp.int32)
+    head = jnp.stack([remaining, finite.astype(remaining.dtype)])
+    return jnp.concatenate([head, bits], axis=0)
+
+
+def unpack_boundary(b: np.ndarray) -> np.ndarray:
+    """Host-side view of a fetched ``(K_BOUNDARY, L)`` boundary vector's
+    stats block: rows 2-5 reinterpreted as float32 — ``(4, L)`` ordered
+    (resid, tmin, tmax, heat) per BOUNDARY_ROWS. A bit-level view, not
+    a conversion; the int32 head rows are read directly as ``b[0]``,
+    ``b[1]`` by every consumer."""
+    return np.ascontiguousarray(b[2:K_BOUNDARY]).view(np.float32)
+
+
+def _lane_stats(prev, fields, n, ndim: int):
+    """Per-lane float32 solution-quality stats over the request region.
+
+    The region mask covers buffer coordinates ``[1, n_lane]`` along every
+    axis — the full request field INCLUDING its Dirichlet ring (the
+    maximum principle bounds interior values by ``[min(IC, bc),
+    max(IC, bc)]``, so the witnesses must see the boundary cells), and
+    never the padding corner or the margin. Reductions run in float32
+    (the bf16 accumulation discipline of ``accum_dtype_for``); they read
+    the stacks and write nothing, so field bytes are untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    lanes = fields.shape[0]
+    f32 = fields.astype(jnp.float32)
+    mask = None
+    for d in range(ndim):
+        io = jax.lax.broadcasted_iota(jnp.int32, fields.shape, d + 1)
+        nl = n.reshape((lanes,) + (1,) * ndim)
+        m = (io >= 1) & (io <= nl)
+        mask = m if mask is None else mask & m
+    axes = tuple(range(1, ndim + 1))
+    delta = jnp.abs(f32 - prev.astype(jnp.float32))
+    resid = jnp.max(jnp.where(mask, delta, jnp.float32(0)), axis=axes)
+    tmin = jnp.min(jnp.where(mask, f32, jnp.float32(jnp.inf)), axis=axes)
+    tmax = jnp.max(jnp.where(mask, f32, jnp.float32(-jnp.inf)), axis=axes)
+    heat = jnp.sum(jnp.where(mask, f32, jnp.float32(0)), axis=axes)
+    return jnp.stack([resid, tmin, tmax, heat])
 
 
 def lane_tier(needed: int, cap: int) -> int:
@@ -221,10 +300,11 @@ def make_lane_advance(key: BucketKey, kernel: str = "xla",
                       donate: bool = True):
     """The jitted chunk program for one bucket: ``advance(fields, r, n,
     remaining, k)`` runs ``k`` masked steps over every lane and returns
-    the new state plus the ``(2, L)`` boundary vector — per-lane
-    remaining steps stacked with per-lane ``isfinite`` bits, the one
-    array a chunk boundary needs to fetch to judge both progress AND
-    health of every lane.
+    the new state plus the ``(K_BOUNDARY, L)`` boundary vector —
+    per-lane remaining steps, ``isfinite`` bits, and the four bitcast
+    numerics stats rows (``BOUNDARY_ROWS``), the one array a chunk
+    boundary needs to fetch to judge progress, health, AND solution
+    quality of every lane.
 
     ``kernel`` picks the stepping body: ``"xla"`` — the vmapped masked
     stencil under ``lax.fori_loop`` (the serving ORACLE: every other
@@ -261,14 +341,14 @@ def make_lane_advance(key: BucketKey, kernel: str = "xla",
         @functools.partial(jax.jit, static_argnums=(4,),
                            donate_argnums=donate_argnums)
         def advance(fields, r, n, remaining, k: int):
-            # mask + countdown gate + health reduction all live INSIDE
-            # the kernel passes; remaining's update is the same O(L)
-            # algebra the fori_loop body produces step by step
-            fields, finite = lane_multistep(fields, r, n, remaining, k,
-                                            bc_lo=lo, bucket_n=bucket_n)
+            # mask + countdown gate + health reduction + numerics stats
+            # all live INSIDE the kernel passes; remaining's update is
+            # the same O(L) algebra the fori_loop body produces step by
+            # step
+            fields, finite, stats = lane_multistep(
+                fields, r, n, remaining, k, bc_lo=lo, bucket_n=bucket_n)
             remaining = jnp.maximum(remaining - k, 0)
-            boundary = jnp.stack([remaining,
-                                  finite.astype(remaining.dtype)])
+            boundary = pack_boundary(remaining, finite, stats)
             return fields, r, n, remaining, boundary
 
         return advance
@@ -286,12 +366,19 @@ def make_lane_advance(key: BucketKey, kernel: str = "xla",
             f = jnp.where(act.reshape(act.shape + (1,) * ndim), stepped, f)
             return f, rem - act.astype(rem.dtype)
 
-        fields, remaining = jax.lax.fori_loop(0, k, body, (fields, remaining))
+        # the final mini-step is peeled out of the loop so the pre-step
+        # stack stays in scope for the residual stat — the SAME body,
+        # the same per-step elementwise IEEE arithmetic, so the field
+        # bytes are untouched (k == 1: the loop is a no-op)
+        prev, remaining = jax.lax.fori_loop(0, k - 1, body,
+                                            (fields, remaining))
+        fields, remaining = body(k - 1, (prev, remaining))
         # per-lane health: one bit per lane, reduced on device — padding
         # cells hold bc_value (finite) and masking confines a NaN to its
         # own lane, so a zero bit is that lane's fault and only its own
         finite = jnp.isfinite(fields).reshape(fields.shape[0], -1).all(axis=1)
-        boundary = jnp.stack([remaining, finite.astype(remaining.dtype)])
+        stats = _lane_stats(prev, fields, n, ndim)
+        boundary = pack_boundary(remaining, finite, stats)
         return fields, r, n, remaining, boundary
 
     return advance
@@ -503,10 +590,11 @@ class LaneEngine:
     # --- stepping ---------------------------------------------------------
     def dispatch_chunk(self, k: Optional[int] = None):
         """Enqueue one k-step program (default: the steady chunk) over
-        every lane and return a DEVICE handle to the post-chunk ``(2, L)``
-        boundary vector (remaining steps + per-lane finite bits) — no
-        host round trip, no fence. The handle stays valid under later
-        dispatches because it is never donated."""
+        every lane and return a DEVICE handle to the post-chunk
+        ``(K_BOUNDARY, L)`` boundary vector (remaining steps, per-lane
+        finite bits, bitcast numerics stats) — no host round trip, no
+        fence. The handle stays valid under later dispatches because it
+        is never donated."""
         fn = self._ensure(self.chunk if k is None else k)
         out = fn(*self._state)
         self._state = out[:4]
@@ -514,8 +602,9 @@ class LaneEngine:
 
     def fetch_remaining(self, handle, timeout_s: Optional[float] = None,
                         plan=None, fetch_index: int = 0) -> np.ndarray:
-        """The boundary D2H: fetch a ``(2, L)`` boundary handle to host
-        (row 0 remaining steps, row 1 finite bits). With dispatch depth
+        """The boundary D2H: fetch a ``(K_BOUNDARY, L)`` boundary handle
+        to host (row 0 remaining steps, row 1 finite bits, rows 2-5 the
+        bitcast numerics stats — ``unpack_boundary``). With dispatch depth
         > 1 the scheduler calls this on a chunk dispatched one or more
         chunks ago, so the transfer (and the bookkeeping it gates) hides
         under the chunks queued behind it.
@@ -555,6 +644,20 @@ class LaneEngine:
         f, r, nn, rem = self._state
         self._state = (f.at[idx].set(jnp.nan), r, nn, rem)
 
+    def perturb_lane(self, lane: int, n: int, eps: float) -> None:
+        """Chaos-only (``perturb`` injection, ISSUE 15): add a bounded
+        bump ``eps`` to the center cell of ``lane``'s request region —
+        finite, so the isfinite bit stays green, but (for any eps above
+        the detector tolerance) outside the maximum-principle envelope:
+        the numerics observatory's quarry rather than the nonfinite
+        path's. Same eager-scatter shape as ``poison_lane``; never
+        reached without an active fault plan."""
+        import jax.numpy as jnp
+
+        idx = (lane,) + tuple(1 + n // 2 for _ in range(self.key.ndim))
+        f, r, nn, rem = self._state
+        self._state = (f.at[idx].add(jnp.asarray(eps, f.dtype)), r, nn, rem)
+
     def snapshot_stack(self):
         """The post-chunk lane stack as a restorable boundary snapshot
         (``--serve-on-nan rollback`` bookkeeping): a lane judged finite
@@ -593,8 +696,8 @@ def fetch_boundary(handle, timeout_s: Optional[float] = None, plan=None,
                    fetch_index: int = 0) -> np.ndarray:
     """The ONE watchdogged boundary-D2H path, shared by the packed lane
     engine (``LaneEngine.fetch_remaining``) and the sharded mega-lane
-    (``MegaLaneEngine``): fetch a ``(2, L)`` boundary handle to host,
-    optionally under the ``bounded_call`` watchdog, with the
+    (``MegaLaneEngine``): fetch a ``(K_BOUNDARY, L)`` boundary handle to
+    host, optionally under the ``bounded_call`` watchdog, with the
     ``fetch-hang`` fault injection firing INSIDE the watchdogged region
     either way (runtime/faults.py)."""
     def fetch():
@@ -618,9 +721,10 @@ class MegaLaneEngine:
     the ``backends/sharded.py`` padded-carry chunked advance for that one
     request, wrapped in the exact dispatch contract ``LaneEngine``
     exposes for packed lanes: ``dispatch_chunk(k)`` enqueues one k-step
-    program and returns a DEVICE handle to a ``(2, 1)`` boundary vector
-    (remaining steps + an owned-cells ``isfinite`` bit) with no host
-    round trip; the scheduler's ``fetch_boundary`` is the only D2H; the
+    program and returns a DEVICE handle to a ``(K_BOUNDARY, 1)``
+    boundary vector (remaining steps, an owned-cells ``isfinite`` bit,
+    and the bitcast numerics stats reduced over the owned interior) with
+    no host round trip; the scheduler's ``fetch_boundary`` is the only D2H; the
     carried padded state is donated through each chunk like the solo
     drive's double buffer. One mega-lane is therefore just a bucket
     group of lane-count one whose "bucket" is the mesh.
@@ -759,8 +863,8 @@ class MegaLaneEngine:
 
     def dispatch_chunk(self, k: int):
         """Enqueue one k-step mesh program and return the DEVICE handle
-        to its ``(2, 1)`` boundary vector — no fence, no host round
-        trip (the mega mirror of ``LaneEngine.dispatch_chunk``)."""
+        to its ``(K_BOUNDARY, 1)`` boundary vector — no fence, no host
+        round trip (the mega mirror of ``LaneEngine.dispatch_chunk``)."""
         fn = self._ensure(k)
         self._state, self._rem, boundary = fn(self._state, self._rem)
         return boundary
@@ -813,6 +917,25 @@ class MegaLaneEngine:
             idx.append(shard * (local + 2 * kf) + kf + off)
         poisoned = self._state.at[tuple(idx)].set(jnp.nan)
         self._state = jax.device_put(poisoned, self._state.sharding)
+
+    def perturb_center(self, eps: float) -> None:
+        """Chaos-only (``perturb`` injection on a mega request, ISSUE 15):
+        add a bounded bump to the center owned cell — finite (the isfinite
+        bit stays green) but outside the maximum-principle envelope for
+        any eps above the detector tolerance. Same placement re-pin as
+        ``poison_center``."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg, kf = self.cfg, self.kf
+        idx = []
+        for s in self.mesh.devices.shape:
+            local = cfg.n // int(s)
+            shard, off = divmod(cfg.n // 2, local)
+            idx.append(shard * (local + 2 * kf) + kf + off)
+        bumped = self._state.at[tuple(idx)].add(
+            jnp.asarray(eps, self._state.dtype))
+        self._state = jax.device_put(bumped, self._state.sharding)
 
 
 def wall_clock() -> float:

@@ -13,7 +13,9 @@ system owes its operators:
   level admission is only *online* because of this — a request arriving
   mid-chunk is admitted at the next boundary). ``?wait=0`` returns 202
   with the accepted ids immediately; poll instead.
-- ``GET /v1/requests/<id>`` — one record snapshot (404 unknown id).
+- ``GET /v1/requests/<id>`` — one record snapshot (404 unknown id);
+  ``?field=1`` inlines the final field as JSON lists — the read the
+  canary prober (serve/probe.py) verifies solutions through.
 - ``GET /healthz`` — 200 while admitting, 503 once draining (the flip a
   load balancer keys on), plus a scheduler-crash indicator.
 - ``POST /drainz`` — graceful drain: stops admission (healthz flips
@@ -192,9 +194,47 @@ def render_metrics(engine: Engine) -> str:
            [([], s["boundary_wait_s"])])
     metric("heat_tpu_flightrec_dumps_total", "counter",
            "Flight-recorder dumps written (watchdog fire / quarantine-"
-           "after-rollbacks / scheduler crash); paths in the structured "
-           "flightrec records and on /statusz.",
+           "after-rollbacks / numerics violation / scheduler crash); "
+           "paths in the structured flightrec records and on /statusz.",
            [([], engine.tracer.dumps)])
+
+    # --- numerics observatory (runtime/numerics.py, ISSUE 15) -------------
+    metric("heat_tpu_numerics_enabled", "gauge",
+           "1 while the numerics observatory ingests boundary stats "
+           "(--numerics); the guard label names the violation routing.",
+           [([("guard", s.get("numerics_guard", "warn"))],
+             int(bool(s.get("numerics"))))])
+    metric("heat_tpu_numerics_steady_total", "counter",
+           "Requests whose residual EWMA converged below --steady-tol "
+           "with steps still remaining (fire-once per request).",
+           [([], s.get("steady_lanes", 0))])
+    metric("heat_tpu_numerics_violations_total", "counter",
+           "Maximum-principle escapes + heat-content jumps detected "
+           "(one verdict per request; structured numerics_violation "
+           "records carry the witnesses).",
+           [([], s.get("numerics_violations", 0))])
+
+    # --- canary prober (serve/probe.py) -----------------------------------
+    pr = engine.prober.stats() if engine.prober is not None else None
+    metric("heat_tpu_probe_runs_total", "counter",
+           "Known-answer canary probes completed, by verdict (the sine-"
+           "eigenmode request verified against its closed-form decay).",
+           [([("result", "pass")], (pr or {}).get("passes", 0)),
+            ([("result", "fail")], (pr or {}).get("fails", 0))])
+    metric("heat_tpu_probe_consecutive_failures", "gauge",
+           "Current run of back-to-back probe failures (a probe_failed "
+           "record fires once the alert threshold is crossed).",
+           [([], (pr or {}).get("consecutive_failures", 0))])
+    metric("heat_tpu_probe_last_error_norm", "gauge",
+           "Max-norm error of the last probe's returned field vs the "
+           "analytic lambda**s decay (NaN until a probe completes).",
+           [([], pr["last_error_norm"])]
+           if pr and pr.get("last_error_norm") is not None else [([], 0)])
+    metric("heat_tpu_probe_last_latency_seconds", "gauge",
+           "End-to-end wall seconds of the last probe through the real "
+           "gateway path.",
+           [([], round(pr["last_latency_s"], 6))]
+           if pr and pr.get("last_latency_s") is not None else [([], 0)])
 
     # --- performance & cost observatory (runtime/prof.py) ----------------
     cm = s.get("cost_model") or []
@@ -356,6 +396,34 @@ def render_statusz(engine: Engine) -> str:
         f"faults: {s['lanes_quarantined']} quarantined, "
         f"{s['rollbacks']} rollback(s), {s['deadline_misses']} deadline "
         f"miss(es), {s['shed']} shed, {s['watchdog_fired']} watchdog")
+    if s.get("numerics"):
+        lines.append(
+            f"numerics: guard {s.get('numerics_guard', 'warn')}, "
+            f"{s.get('steady_lanes', 0)} steady lane(s), "
+            f"{s.get('numerics_violations', 0)} violation(s)")
+        ns = engine.numerics.snapshot() if engine.numerics else None
+        for rid, ln in sorted((ns or {}).get("lanes", {}).items()):
+            if ln["resid_ewma"] is None:
+                continue
+            lines.append(
+                f"  {rid}: resid ewma {ln['resid_ewma']:.3e}, heat "
+                f"{ln['heat']:.6g}, range [{ln['tmin']:.4g}, "
+                f"{ln['tmax']:.4g}] in [{ln['lo']:g}, {ln['hi']:g}]"
+                f"{' STEADY' if ln['steady'] else ''}"
+                f"{' VIOLATED' if ln['violated'] else ''}")
+    else:
+        lines.append("numerics: observatory OFF (--numerics off)")
+    pr = engine.prober.stats() if engine.prober is not None else None
+    if pr is None:
+        lines.append("prober: not armed (--probe-interval 0)")
+    else:
+        en = pr.get("last_error_norm")
+        lines.append(
+            f"prober: every {pr['interval_s']:g}s, {pr['passes']} pass / "
+            f"{pr['fails']} fail ({pr['consecutive_failures']} "
+            f"consecutive), last error norm "
+            f"{'n/a' if en is None else format(en, '.3e')}, last latency "
+            f"{pr.get('last_latency_s') or 0:.3f}s")
     cm = s.get("cost_model") or []
     lines.append("")
     lines.append(f"cost model ({len(cm)} key(s), s/lane-step EWMA; "
@@ -563,7 +631,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     # --- routes -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-        path = urlsplit(self.path).path
+        parts = urlsplit(self.path)
+        path = parts.path
         eng = self.gw.engine
         if path == "/healthz":
             if eng.loop_error is not None:
@@ -598,7 +667,20 @@ class _Handler(BaseHTTPRequestHandler):
             if rec is None:
                 self._json(404, {"error": f"unknown request id {rid!r}"})
             else:
-                self._json(200, self._sanitize(rec),
+                body = self._sanitize(rec)
+                if parse_qs(parts.query).get("field", ["0"])[0] in ("1",
+                                                                    "true"):
+                    # ?field=1: inline the final field as nested JSON
+                    # lists (f64 — bfloat16 is not JSON-spellable). The
+                    # canary prober verifies returned solutions through
+                    # this, the same front door every client uses.
+                    T = eng.field_of(rid)
+                    if T is not None:
+                        import numpy as np
+
+                        body["T"] = np.asarray(
+                            T, dtype=np.float64).tolist()
+                self._json(200, body,
                            headers=[("X-Trace-Id", rec["trace_id"])]
                            if rec.get("trace_id") else ())
         else:

@@ -120,15 +120,16 @@ import numpy as np
 
 from ..config import (DEFAULT_SLO_CLASS, DEFAULT_TENANT, LANE_KERNELS,
                       SLO_TARGETS, HeatConfig, validate_slo_fields)
-from ..grid import initial_condition
+from ..grid import ic_envelope, initial_condition
 from ..runtime import async_io, faults
 from ..runtime import debug as debug_mod
+from ..runtime import numerics as numerics_mod
 from ..runtime import prof as prof_mod
 from ..runtime import trace as trace_mod
 from ..runtime.logging import json_record, master_print
 from . import policy as policy_mod
 from .engine import (BucketKey, LaneEngine, MegaLaneEngine, lane_tier,
-                     resolve_lane_kernel, wall_clock)
+                     resolve_lane_kernel, unpack_boundary, wall_clock)
 from .engine import fetch_boundary as engine_fetch_boundary
 
 # Statuses a record can never leave: what poll()/wait() callers and the
@@ -258,6 +259,28 @@ class ServeConfig:
                               # never an error; the XLA program stays the
                               # bit-exactness oracle (engine.py
                               # resolve_lane_kernel)
+    numerics: bool = True     # the numerics observatory (runtime/
+                              # numerics.py, ISSUE 15): per-lane solution-
+                              # quality detectors fed from the stats rows
+                              # the chunk programs ALWAYS fuse into the
+                              # boundary vector. off = host-side ingestion
+                              # disabled only — the device programs are
+                              # identical either way, so results stay
+                              # byte-identical on vs off (the A/B of
+                              # benchmarks/numerics_overhead_lab.py)
+    steady_tol: float = 1e-12  # steady-state detector (--steady-tol): a
+                              # lane whose final-mini-step residual EWMA
+                              # sits below this while steps remain emits
+                              # ONE steady_state record per request
+                              # (observability-only; the ROADMAP's
+                              # early-exit item will act on it)
+    numerics_guard: str = "warn"  # violation routing (--numerics-guard):
+                              # "warn" = structured numerics_violation
+                              # record + flight dump only; "quarantine" =
+                              # additionally take the PR-5 quarantine
+                              # exit — the request fails nonfinite, the
+                              # lane frees, co-scheduled lanes continue
+                              # byte-identically
 
     def __post_init__(self):
         if self.lanes < 1:
@@ -322,6 +345,12 @@ class ServeConfig:
             raise ValueError(f"mega_lanes must be >= 0 (None = auto: 1 on "
                              f"a multi-device mesh, 0 single-device), got "
                              f"{self.mega_lanes}")
+        if not self.steady_tol > 0:
+            raise ValueError(f"steady_tol must be > 0, got "
+                             f"{self.steady_tol}")
+        if self.numerics_guard not in ("warn", "quarantine"):
+            raise ValueError(f"numerics_guard must be 'warn' or "
+                             f"'quarantine', got {self.numerics_guard!r}")
         if self.inject:
             # fail at construction, not at a boundary mid-drain (same
             # parse-time contract as HeatConfig.inject)
@@ -443,6 +472,10 @@ class _GroupRunner:
         # pending lane-nan poison thresholds, rollback retries left, and
         # the last verified-finite boundary (stack snapshot, steps left)
         self.nan_pending: List[List[int]] = [[] for _ in range(self.lanes)]
+        # pending (step, eps) perturb events — the numerics observatory's
+        # chaos channel (finite bump, so the isfinite bit never drops)
+        self.perturb_pending: List[List[tuple]] = [
+            [] for _ in range(self.lanes)]
         self.rb_left = [0] * self.lanes
         self.last_good: List[Optional[tuple]] = [None] * self.lanes
         self.seq = 0                        # next dispatch's sequence id
@@ -530,10 +563,16 @@ class _GroupRunner:
                 self.lane_chunks[lane] = 0   # usage meter restarts with
                                              # the new occupant
                 self.nan_pending[lane] = outer._lane_nan_steps(req)
-                if self.nan_pending[lane]:
+                self.perturb_pending[lane] = outer._lane_perturb_events(req)
+                if self.nan_pending[lane] or self.perturb_pending[lane]:
                     outer._has_lane_faults = True  # gates _maybe_poison
                 self.rb_left[lane] = _MAX_LANE_ROLLBACKS
                 self.last_good[lane] = None
+                if outer.numerics is not None:
+                    # arm the detectors: the analytic IC/BC envelope (zero
+                    # device work, zero host scans — grid.ic_envelope)
+                    lo, hi = ic_envelope(req.cfg)
+                    outer.numerics.admit(req.id, lo, hi, req.cfg.dtype)
 
     def _live_remaining(self) -> List[int]:
         return [int(self.dev_rem[i]) for i, o in enumerate(self.occupant)
@@ -547,12 +586,17 @@ class _GroupRunner:
         called with an active fault plan — the no-fault hot path never
         touches this."""
         for lane, req in enumerate(self.occupant):
-            if req is None or not self.nan_pending[lane]:
+            if req is None or not (self.nan_pending[lane]
+                                   or self.perturb_pending[lane]):
                 continue
             done = req.cfg.ntime - int(self.dev_rem[lane])
             while self.nan_pending[lane] and done >= self.nan_pending[lane][0]:
                 self.nan_pending[lane].pop(0)   # fire-once per request
                 self.eng.poison_lane(lane, req.cfg.n)
+            while (self.perturb_pending[lane]
+                   and done >= self.perturb_pending[lane][0][0]):
+                _, eps = self.perturb_pending[lane].pop(0)  # fire-once
+                self.eng.perturb_lane(lane, req.cfg.n, eps)
 
     def dispatch_fill(self) -> None:
         """Queue chunk programs until ``dispatch_depth`` are in flight or
@@ -759,6 +803,63 @@ class _GroupRunner:
             self.nan_pending[lane] = []
             self.last_good[lane] = None
 
+    def _ingest_numerics(self, seq: int, b: np.ndarray) -> None:
+        """Feed one fetched boundary's fused stats rows (rows 2-5 of the
+        widened vector — engine.unpack_boundary) to the numerics
+        observatory and apply its verdicts. Runs BEFORE ``_judge_lanes``
+        under the same epoch guard, so a quarantine verdict frees the
+        lane before the health/completion pass sees it — and a stale
+        chunk can never judge a swapped-in occupant's physics."""
+        outer = self.outer
+        stats = unpack_boundary(b)
+        rem = b[0]
+        tr = self.tracer
+        for lane in range(self.lanes):
+            req = self.occupant[lane]
+            if req is None or seq < self.epoch[lane]:
+                continue
+            resid = float(stats[0, lane])
+            heat = float(stats[3, lane])
+            if tr.enabled:
+                # Perfetto counter track: the lane's residual/heat as
+                # 'C' series — the convergence sparkline on the timeline
+                tr.counter(f"numerics lane {lane}", self.group_track,
+                           {"resid": resid, "heat": heat})
+            events = outer.numerics.observe(
+                req.id, resid, float(stats[1, lane]),
+                float(stats[2, lane]), heat, int(rem[lane]))
+            for ev in events:
+                outer._note_numerics_event(self, lane, req,
+                                           int(rem[lane]), ev)
+
+    def _quarantine_numerics(self, lane: int, req: Request, rem_at: int,
+                             why: str) -> None:
+        """``--numerics-guard quarantine``: a violated lane takes the
+        PR-5 quarantine exit — structured nonfinite failure, lane freed,
+        co-scheduled lanes byte-identical to a clean run (the masking
+        contract confines the damage to the lane's own buffer)."""
+        outer = self.outer
+        done = req.cfg.ntime - rem_at
+        if self.tracer.enabled:
+            self.tracer.instant("quarantine", self.lane_tracks[lane],
+                                trace_id=req.trace_id,
+                                args={"id": req.id, "at_step": done,
+                                      "why": why})
+        self._trace_occupancy(lane, req, "nonfinite")
+        outer._fail_request(
+            req, "nonfinite",
+            f"numerics: {why} violation at ~step {done} of "
+            f"{req.cfg.ntime} (lane {lane}) — the field is finite but "
+            f"un-physical; check r against the CFL bound "
+            f"sigma <= 1/(2*ndim), dtype drift, or an injected perturb "
+            f"fault (TROUBLESHOOTING.md)", lane=lane,
+            steps_done=done, chunks=int(self.lane_chunks[lane]))
+        outer.lanes_quarantined += 1
+        self.occupant[lane] = None
+        self.nan_pending[lane] = []
+        self.perturb_pending[lane] = []
+        self.last_good[lane] = None
+
     def process_boundary(self) -> None:
         """Take one chunk boundary: fetch the OLDEST in-flight boundary
         vector (the newer chunks keep computing behind the transfer),
@@ -800,6 +901,8 @@ class _GroupRunner:
                     f"device remaining {rem.tolist()} != host-predicted "
                     f"{predicted.tolist()} at chunk {seq} — the lane "
                     f"masking contract broke; results cannot be trusted")
+            if outer.numerics is not None:
+                self._ingest_numerics(seq, b)
             self._judge_lanes(seq, rem, finite, snap, sync=False)
         else:
             # nothing in flight and nothing left to step: occupants whose
@@ -844,7 +947,7 @@ class _GroupRunner:
                                     outer.scfg.lanes)), outer.scfg.lanes)
         old_eng, old_occ = self.eng, self.occupant
         old_rem, old_nan, old_rb = self.dev_rem, self.nan_pending, self.rb_left
-        old_chunks = self.lane_chunks
+        old_chunks, old_pert = self.lane_chunks, self.perturb_pending
         if self.tracer.enabled:
             self.tracer.instant("lane-tier-grow", self.group_track,
                                 args={"from": self.lanes, "to": want})
@@ -863,6 +966,7 @@ class _GroupRunner:
         self.dev_rem = np.zeros(want, dtype=np.int64)
         self.lane_chunks = np.zeros(want, dtype=np.int64)
         self.nan_pending = [[] for _ in range(want)]
+        self.perturb_pending = [[] for _ in range(want)]
         self.rb_left = [0] * want
         self.last_good = [None] * want
         self.lane_tracks = [self.tracer.track(self.track_name, f"lane {i}")
@@ -877,6 +981,7 @@ class _GroupRunner:
             self.dev_rem[lane] = old_rem[lane]
             self.lane_chunks[lane] = old_chunks[lane]
             self.nan_pending[lane] = old_nan[lane]
+            self.perturb_pending[lane] = old_pert[lane]
             self.rb_left[lane] = old_rb[lane]
             # the old tier's stack snapshots have the old lane count: drop
             # them; a post-growth rollback re-steps from the IC instead
@@ -927,6 +1032,8 @@ class _GroupRunner:
             np.maximum(self.dev_rem - self.chunk, 0, out=self.dev_rem)
             if self.rollback:
                 snap = self.eng.snapshot_stack()
+            if outer.numerics is not None:
+                self._ingest_numerics(self.seq, b)
         else:
             rem = self.dev_rem
         self._judge_lanes(self.seq, rem, finite, snap, sync=True)
@@ -990,6 +1097,7 @@ class MegaLaneRunner:
         self.dev_rem = np.zeros(1, dtype=np.int64)
         self.lane_chunks = np.zeros(1, dtype=np.int64)
         self.nan_pending: List[List[int]] = [[]]
+        self.perturb_pending: List[List[tuple]] = [[]]
         self.rb_left = [0]
         self.last_good: List[Optional[tuple]] = [None]
         self.seq = 0
@@ -1065,10 +1173,14 @@ class MegaLaneRunner:
             self.dev_rem[0] = req.cfg.ntime
             self.lane_chunks[0] = 0
             self.nan_pending[0] = outer._lane_nan_steps(req)
-            if self.nan_pending[0]:
+            self.perturb_pending[0] = outer._lane_perturb_events(req)
+            if self.nan_pending[0] or self.perturb_pending[0]:
                 outer._has_lane_faults = True
             self.rb_left[0] = _MAX_LANE_ROLLBACKS
             self.last_good[0] = None
+            if outer.numerics is not None:
+                lo, hi = ic_envelope(req.cfg)
+                outer.numerics.admit(req.id, lo, hi, req.cfg.dtype)
 
     def maybe_grow(self) -> None:
         """Interface parity with ``_GroupRunner``: nothing to grow."""
@@ -1080,12 +1192,17 @@ class MegaLaneRunner:
     # --- dispatch side ----------------------------------------------------
     def _maybe_poison(self) -> None:
         req = self.occupant[0]
-        if req is None or not self.nan_pending[0]:
+        if req is None or not (self.nan_pending[0]
+                               or self.perturb_pending[0]):
             return
         done = req.cfg.ntime - int(self.dev_rem[0])
         while self.nan_pending[0] and done >= self.nan_pending[0][0]:
             self.nan_pending[0].pop(0)
             self.eng.poison_center()
+        while (self.perturb_pending[0]
+               and done >= self.perturb_pending[0][0][0]):
+            _, eps = self.perturb_pending[0].pop(0)
+            self.eng.perturb_center(eps)
 
     def dispatch_fill(self) -> None:
         """Queue mesh chunk programs until ``dispatch_depth`` are in
@@ -1189,6 +1306,7 @@ class MegaLaneRunner:
         self.eng = None
         self.dev_rem[0] = 0
         self.nan_pending[0] = []
+        self.perturb_pending[0] = []
         self.last_good[0] = None
         self.epoch[0] = self.seq
 
@@ -1249,6 +1367,49 @@ class MegaLaneRunner:
                                    f"(mega request {req.id})")
             self._release()
 
+    def _ingest_numerics(self, seq: int, b: np.ndarray) -> None:
+        """The mega mirror of ``_GroupRunner._ingest_numerics``: one
+        lane, mesh-wide stats (the sharded advance's cross-shard
+        min/max/sum merge — serve/engine.py mega boundary contract)."""
+        outer = self.outer
+        req = self.occupant[0]
+        if req is None or seq < self.epoch[0]:
+            return
+        stats = unpack_boundary(b)
+        resid, heat = float(stats[0, 0]), float(stats[3, 0])
+        if self.tracer.enabled:
+            self.tracer.counter("numerics mega", self.group_track,
+                                {"resid": resid, "heat": heat})
+        events = outer.numerics.observe(
+            req.id, resid, float(stats[1, 0]), float(stats[2, 0]),
+            heat, int(b[0][0]))
+        for ev in events:
+            outer._note_numerics_event(self, 0, req, int(b[0][0]), ev)
+
+    def _quarantine_numerics(self, lane: int, req: Request, rem_at: int,
+                             why: str) -> None:
+        """``--numerics-guard quarantine`` for the mega tier: fail the
+        occupant nonfinite and free the slot (packed groups untouched —
+        the mesh is this request's whole fault domain)."""
+        outer = self.outer
+        done = req.cfg.ntime - rem_at
+        if self.tracer.enabled:
+            self.tracer.instant("quarantine", self.lane_tracks[0],
+                                trace_id=req.trace_id,
+                                args={"id": req.id, "at_step": done,
+                                      "why": why})
+        self._trace_occupancy(0, req, "nonfinite")
+        outer._fail_request(
+            req, "nonfinite",
+            f"numerics: {why} violation at ~step {done} of "
+            f"{req.cfg.ntime} (mega lane) — the field is finite but "
+            f"un-physical; check r against the CFL bound "
+            f"sigma <= 1/(2*ndim), dtype drift, or an injected perturb "
+            f"fault (TROUBLESHOOTING.md)", lane=0,
+            steps_done=done, chunks=int(self.lane_chunks[0]))
+        outer.lanes_quarantined += 1
+        self._release()
+
     def _retire(self, req: Request, sync: bool) -> None:
         """Completion: crop the padded state to the owned field (a device
         program, enqueued) and hand the D2H + npz publish to the writer
@@ -1306,6 +1467,8 @@ class MegaLaneRunner:
                     f"host-predicted {predicted.tolist()} at chunk {seq} "
                     f"— the mega countdown contract broke; results "
                     f"cannot be trusted")
+            if outer.numerics is not None:
+                self._ingest_numerics(seq, b)
             self._judge(seq, rem, finite, snap, sync=False)
         else:
             self._judge(self.seq, self.dev_rem, None, None, sync=False)
@@ -1350,6 +1513,8 @@ class MegaLaneRunner:
             self.dev_rem[0] = int(self.dev_rem[0]) - k
             if self.rollback:
                 snap = self.eng.snapshot_state()
+            if outer.numerics is not None:
+                self._ingest_numerics(self.seq, b)
         self._judge(self.seq, rem_vec, finite, snap, sync=True)
         self.seq += 1
         self._fill()
@@ -1399,6 +1564,13 @@ class Engine:
             slo_fast_window_s=scfg.slo_fast_window_s,
             slo_slow_window_s=scfg.slo_slow_window_s,
             slo_burn_threshold=scfg.slo_burn_threshold)
+        # numerics observatory (runtime/numerics.py, ISSUE 15): solution-
+        # quality detectors fed from the stats rows of every fetched
+        # boundary. Same lock contract as prof: its lock is its own and
+        # only ever taken AFTER (or without) the engine lock, so gateway
+        # scrape threads reading snapshot() cannot deadlock the hot path.
+        self.numerics = (numerics_mod.NumericsObservatory(
+            steady_tol=scfg.steady_tol) if scfg.numerics else None)
         self._queues: Dict[BucketKey, object] = {}  # policy queues
         # second placement tier (ISSUE 10): the engine-wide mega-lane
         # admission queue (same policy object as the bucket queues) plus
@@ -1473,13 +1645,17 @@ class Engine:
                                        # is admitted (gates _maybe_poison)
         self._fetch_seq = 0            # boundary-fetch counter (fetch-hang
                                        # @N addressing)
+        # the gateway's canary prober (serve/probe.py), attached by
+        # cmd_serve before any thread starts; None when not armed —
+        # /metrics and /statusz read its stats() through this reference
+        self.prober = None
         # race sanitizer (no-op unless HEAT_TPU_RACECHECK): exempt fields
         # the committed guard map sanctions as benign — the idempotent
         # mega-lane memo (allow-marked) and the typed object refs
         debug_mod.instrument_races(
             self, label="Engine",
             exempt=frozenset({"_mega_lanes_resolved", "tracer", "prof",
-                              "scfg"}))
+                              "numerics", "scfg", "prober"}))
 
     # --- mega-lane placement (ISSUE 10) -----------------------------------
     @property
@@ -1697,6 +1873,67 @@ class Engine:
             steps.update(p.lane_nan_steps(req.id))
         return sorted(steps)
 
+    def _lane_perturb_events(self, req: Request) -> List[tuple]:
+        """Perturb ``(step, eps)`` events for one admitted request — the
+        ``_lane_nan_steps`` contract for the numerics-observatory chaos
+        channel (same identity-dedupe of a shared plan object)."""
+        plans = {id(p): p for p in (faults.plan_for(req.cfg), self._plan)
+                 if p is not None}
+        events: set = set()
+        for p in plans.values():
+            events.update(p.perturb_events(req.id))
+        return sorted(events)
+
+    def _note_numerics_event(self, runner, lane: int, req: Request,
+                             rem_at: int, ev: dict) -> None:
+        """One numerics-observatory verdict (runtime/numerics.py event
+        dict) becomes policy here: structured record, trace instant,
+        flight dump, and — for violations under ``--numerics-guard
+        quarantine`` — the runner's quarantine exit. Called from the
+        scheduler thread off the boundary fetch, never while holding the
+        engine lock (only the quarantine branch takes it, inside
+        ``_fail_request``)."""
+        done = req.cfg.ntime - rem_at
+        if ev["kind"] == "steady":
+            json_record("steady_state", id=req.id, lane=lane,
+                        steps_done=done, remaining=rem_at,
+                        resid=ev["resid"], resid_ewma=ev["resid_ewma"],
+                        steady_tol=ev["steady_tol"],
+                        trace_id=req.trace_id)
+            if self.tracer.enabled:
+                self.tracer.instant("steady-state",
+                                    runner.lane_tracks[lane],
+                                    trace_id=req.trace_id,
+                                    args={"id": req.id, "at_step": done})
+            return
+        why = ev["why"]
+        master_print(
+            f"serve numerics: request {req.id} (lane {lane}) violated "
+            f"the {why} detector at ~step {done} of {req.cfg.ntime} "
+            f"(guard: {self.scfg.numerics_guard}) — see "
+            f"TROUBLESHOOTING.md")
+        json_record("numerics_violation", id=req.id, lane=lane, why=why,
+                    steps_done=done, guard=self.scfg.numerics_guard,
+                    tmin=ev.get("tmin"), tmax=ev.get("tmax"),
+                    lo=ev.get("lo"), hi=ev.get("hi"), tol=ev.get("tol"),
+                    heat=ev.get("heat"), heat_prev=ev.get("heat_prev"),
+                    dheat=ev.get("dheat"),
+                    dheat_ewma=ev.get("dheat_ewma"),
+                    trace_id=req.trace_id)
+        if self.tracer.enabled:
+            self.tracer.instant("numerics-violation",
+                                runner.lane_tracks[lane],
+                                trace_id=req.trace_id,
+                                args={"id": req.id, "why": why,
+                                      "at_step": done})
+        # flight-recorder trigger: an un-physical field is exactly the
+        # postmortem case — the ring holds the lane's whole chunk/residual
+        # history up to the escape
+        self._flight_dump(f"numerics violation ({why}) on request "
+                          f"{req.id}")
+        if self.scfg.numerics_guard == "quarantine":
+            runner._quarantine_numerics(lane, req, rem_at, why)
+
     def _reject(self, rec: dict, reason: str,
                 hint: Optional[str] = None) -> None:
         with self._lock:
@@ -1734,6 +1971,8 @@ class Engine:
             rec["usage"] = {"lane_s": rec["solve_s"] or 0.0,
                             "steps": int(steps_done), "chunks": int(chunks),
                             "bytes_written": 0}
+        if self.numerics is not None:
+            self.numerics.forget(req.id)   # terminal: drop detector state
         self._emit(rec)
 
     def _note_lane_fallback(self, key: BucketKey, lanes: int,
@@ -1917,6 +2156,24 @@ class Engine:
             rec = self._by_id.get(request_id)
             return None if rec is None else self._public(rec)
 
+    def field_of(self, request_id: str) -> Optional[np.ndarray]:
+        """The final field of a terminal ``ok`` request, or ``None`` —
+        from the in-memory record (``keep_fields`` / no out_dir) or the
+        published ``.npz``. The gateway's ``GET /v1/requests/<id>?field=1``
+        uses this so the canary prober (serve/probe.py) can verify the
+        returned solution through the same front door clients use; the
+        npz load runs outside the engine lock."""
+        with self._lock:
+            rec = self._by_id.get(request_id)
+            T = rec.get("T") if rec is not None else None
+            path = rec.get("path") if rec is not None else None
+        if T is not None:
+            return np.asarray(T)
+        if path is not None:
+            with np.load(path) as z:
+                return np.asarray(z["T"])
+        return None
+
     def wait(self, request_id: str, timeout: Optional[float] = None
              ) -> Optional[dict]:
         """Block until a request's record is terminal; returns the record
@@ -2036,6 +2293,7 @@ class Engine:
 
     def _stamp_timing(self, Timing, wall: float) -> None:
         mem = self.prof.mem.snapshot() if self.scfg.prof else {}
+        num = self.numerics
         self.timing = Timing(total_s=wall, solve_s=wall,
                              compile_s=self.compile_s,
                              dispatch_depth=self.scfg.dispatch_depth,
@@ -2045,7 +2303,12 @@ class Engine:
                              rollbacks=self.rollbacks,
                              deadline_misses=self.deadline_misses,
                              shed=self.shed,
-                             mem_peak_bytes=mem.get("peak_bytes"))
+                             mem_peak_bytes=mem.get("peak_bytes"),
+                             steady_lanes=(num.steady_total
+                                           if num is not None else None),
+                             numerics_violations=(
+                                 num.violation_total
+                                 if num is not None else None))
 
     def results(self) -> List[dict]:
         """``run`` + records (the common library call)."""
@@ -2222,6 +2485,8 @@ class Engine:
             rec["usage"] = {"lane_s": rec["solve_s"],
                             "steps": int(req.cfg.ntime),
                             "chunks": int(chunks), "bytes_written": 0}
+        if self.numerics is not None:
+            self.numerics.forget(req.id)   # terminal: drop detector state
         return rec
 
     def _writeback_job(self, rec: dict, req: Request,
@@ -2306,9 +2571,16 @@ class Engine:
             queued = (sum(len(q) for q in self._queues.values())
                       + (len(self._mega_queue) if self._mega_queue else 0))
         # observatory snapshots AFTER the engine lock is released
-        # (engine -> prof lock order; see Engine.__init__)
+        # (engine -> prof/numerics lock order; see Engine.__init__)
         obs = self.prof.summary(wall_clock())
+        ns = (self.numerics.snapshot()
+              if self.numerics is not None else None)
         return {"requests": n, **dict(by_status),
+                "numerics": self.scfg.numerics,
+                "numerics_guard": self.scfg.numerics_guard,
+                "steady_lanes": ns["steady_total"] if ns else 0,
+                "numerics_violations": (ns["violation_total"]
+                                        if ns else 0),
                 "prof": self.scfg.prof,
                 "cost_model": obs["cost_model"],
                 "mem": obs["mem"],

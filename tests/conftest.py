@@ -38,7 +38,7 @@ def _seed():
 # still alive forgot shutdown()/drain and would leak its scheduler into
 # every later test (flaky cross-test interference, wedged CI teardown).
 _SERVE_THREAD_PREFIXES = ("heat-tpu-serve-scheduler", "heat-snapshot-writer",
-                          "heat-tpu-gateway")
+                          "heat-tpu-gateway", "heat-tpu-prober")
 
 
 @pytest.fixture(autouse=True)

@@ -101,6 +101,38 @@ def test_info(capsys):
     # the trace defaults line (ISSUE 7): flight recorder + export knobs
     assert "trace defaults: flight recorder on" in out
     assert "--trace FILE" in out and "HEAT_TPU_TRACE" in out
+    # the numerics observatory + prober lines (ISSUE 15): solution-quality
+    # telemetry defaults and the canary knobs must be discoverable here
+    assert "numerics observatory: on by default" in out
+    assert "steady-tol" in out and "guard warn" in out
+    assert "prober: off by default (--probe-interval" in out
+    assert "sine-eigenmode" in out
+
+
+def test_serve_cli_numerics_flags(tmp_cwd, capsys):
+    """--numerics gates the observatory (and its summary line);
+    --probe-interval validates against missing --listen at parse time."""
+    (tmp_cwd / "reqs.jsonl").write_text(
+        '{"id": "a", "n": 12, "ntime": 16, "dtype": "float32",'
+        ' "ic": "uniform"}\n')
+    base = ["serve", "--requests", "reqs.jsonl", "--buckets", "12",
+            "--chunk", "4"]
+    assert main(base) == 0
+    out = capsys.readouterr().out
+    # uniform under frozen edges converges instantly: one steady lane
+    assert "numerics: 1 steady lane(s), 0 violation(s) (guard warn)" in out
+    assert '"event": "steady_state"' in out
+
+    assert main(base + ["--numerics", "off"]) == 0
+    out = capsys.readouterr().out
+    assert "steady lane(s)" not in out and "steady_state" not in out
+
+    with pytest.raises(SystemExit):   # argparse rejects a bad guard
+        main(base + ["--numerics-guard", "page-someone"])
+    assert main(base + ["--probe-interval", "5"]) == 2
+    assert "--probe-interval needs --listen" in capsys.readouterr().err
+    assert main(base + ["--probe-interval", "-1", "--listen",
+                        "127.0.0.1:0"]) == 2
 
 
 def test_bad_mesh_arg():

@@ -82,6 +82,34 @@ def test_lane_nan_steps_filter_by_request_id():
     assert plan.lane_nan_steps("b") == [5, 9]
 
 
+def test_parse_spec_perturb_grammar():
+    """The numerics-observatory fault (ISSUE 15): perturb needs a step,
+    takes optional req= targeting and an eps= magnitude (default 1e3 —
+    finite, far past any envelope tolerance)."""
+    fs = faults.parse_spec("perturb@16:req=a:eps=2.5,perturb@8")
+    assert [f.kind for f in fs] == ["perturb", "perturb"]
+    assert fs[0].step == 16 and fs[0].req == "a" and fs[0].eps == 2.5
+    assert fs[1].step == 8 and fs[1].req is None and fs[1].eps == 1e3
+    with pytest.raises(ValueError, match="needs a step"):
+        faults.parse_spec("perturb:eps=5")
+    with pytest.raises(ValueError, match="bad fault param"):
+        faults.parse_spec("perturb@4:zorp=1")
+    with pytest.raises(ValueError):
+        faults.parse_spec("perturb@4:eps=abc")
+
+
+def test_perturb_events_filter_by_request_id():
+    """Same per-request contract as lane_nan_steps: req=-targeted events
+    apply only to that id, untargeted to every request, and asking never
+    consumes firing state (that lives in the scheduler)."""
+    plan = faults.FaultPlan("perturb@8,perturb@16:req=b:eps=2.5")
+    assert plan.perturb_events("a") == [(8, 1e3)]
+    assert plan.perturb_events("b") == [(8, 1e3), (16, 2.5)]
+    # asking twice must not consume anything
+    assert plan.perturb_events("b") == [(8, 1e3), (16, 2.5)]
+    assert faults.plan_for(HeatConfig(inject="perturb@4")) is not None
+
+
 def test_fetch_hang_fires_once_at_threshold():
     plan = faults.FaultPlan("fetch-hang@2:ms=1")
     plan.maybe_fetch_hang(0)

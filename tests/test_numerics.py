@@ -1,0 +1,433 @@
+"""Numerics observatory (ISSUE 15): per-lane solution-quality telemetry
+riding the widened boundary vector.
+
+Contracts under test:
+
+- the boundary vector is ``(K_BOUNDARY, L)`` int32 with rows 2-5 a
+  bitcast float32 stats block — pack/unpack round-trip exactly,
+  including non-finite payloads;
+- always-compute, host-gate: ``--numerics off`` changes ZERO output
+  bytes (in-memory and npz, XLA and Pallas, depths 0 and 2, f32/bf16,
+  2D/3D) and adds ZERO device->host fetches — the stats ride a fetch
+  that already happens;
+- the observatory math (runtime/numerics.py): envelope tolerance,
+  fire-once steady/violation latches, heat-jump arming, non-finite
+  ingestion discipline;
+- the e2e detector story: a seeded ``perturb`` fault fires ONE
+  ``numerics_violation`` record + flight dump under guard=warn, and
+  under guard=quarantine frees the lane with co-scheduled lanes
+  byte-identical to a clean run;
+- the analytic backbone: the sine IC decays by exactly
+  ``sine_decay_factor(cfg)**s`` under frozen-edge FTCS (the prober's
+  closed form).
+"""
+
+import json
+import math
+import pathlib
+
+import numpy as np
+import pytest
+
+from heat_tpu.config import HeatConfig
+from heat_tpu.grid import ic_envelope, initial_condition, sine_decay_factor
+from heat_tpu.runtime import faults
+from heat_tpu.runtime import numerics as numerics_mod
+from heat_tpu.serve import Engine, ServeConfig
+from heat_tpu.serve import engine as engine_mod
+from heat_tpu.serve.engine import BOUNDARY_ROWS, K_BOUNDARY, unpack_boundary
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def quiet(**kw) -> ServeConfig:
+    kw.setdefault("emit_records", False)
+    kw.setdefault("keep_fields", True)
+    return ServeConfig(**kw)
+
+
+def drain(reqs, **kw):
+    """Drain ``reqs`` through one engine; records in submit order."""
+    eng = Engine(quiet(**kw))
+    ids = [eng.submit(cfg) for cfg in reqs]
+    by_id = {r["id"]: r for r in eng.results()}
+    return eng, [by_id[i] for i in ids]
+
+
+def records_of(capsys, event):
+    out = capsys.readouterr().out
+    return [json.loads(line) for line in out.splitlines()
+            if line.startswith("{")
+            and json.loads(line).get("event") == event]
+
+
+# --- the widened boundary vector ---------------------------------------------
+
+
+def test_boundary_layout_is_the_widened_contract():
+    assert BOUNDARY_ROWS == ("remaining", "finite", "resid", "tmin",
+                             "tmax", "heat")
+    assert K_BOUNDARY == 6
+
+
+def test_pack_unpack_boundary_roundtrip_bitexact():
+    """Rows 0-1 stay plain int32; the f32 stats block survives the int32
+    bitcast bit-for-bit — including NaN/Inf payloads, which a value cast
+    would mangle."""
+    import jax.numpy as jnp
+
+    from heat_tpu.serve.engine import pack_boundary
+
+    rem = jnp.asarray([7, 0, 3], jnp.int32)
+    fin = jnp.asarray([1, 1, 0], jnp.int32)
+    stats = np.asarray(
+        [[1e-3, 0.0, np.nan],
+         [-1.5, 2.0, -np.inf],
+         [2.5, 2.0, np.inf],
+         [123.25, 0.0, 7.5]], dtype=np.float32)
+    b = np.asarray(pack_boundary(rem, fin, jnp.asarray(stats)))
+    assert b.shape == (K_BOUNDARY, 3) and b.dtype == np.int32
+    np.testing.assert_array_equal(b[0], [7, 0, 3])
+    np.testing.assert_array_equal(b[1], [1, 1, 0])
+    back = unpack_boundary(b)
+    assert back.dtype == np.float32 and back.shape == (4, 3)
+    assert back.tobytes() == stats.tobytes()   # NaN payloads included
+
+
+# --- always-compute, host-gate: on vs off is byte-identical ------------------
+#
+# Not a full cross-product (each Pallas cell compiles interpret-mode
+# programs); the cells collectively cover {xla, pallas} x {f32, bf16}
+# x {2D, 3D} with dispatch depths {0, 2} distributed across them.
+
+MATRIX = [
+    # (kernel, ndim, dtype, depth)
+    ("xla", 2, "float32", 0),
+    ("xla", 3, "float32", 2),
+    ("xla", 2, "bfloat16", 2),
+    ("xla", 3, "bfloat16", 0),
+    ("pallas", 2, "float32", 2),
+    ("pallas", 3, "float32", 0),
+    ("pallas", 2, "bfloat16", 0),
+    ("pallas", 3, "bfloat16", 2),
+]
+
+
+def matrix_requests(ndim, dtype):
+    small = 6 if ndim == 3 else 8
+    big = 8 if ndim == 3 else 12
+    return [
+        HeatConfig(n=big, ntime=13, ndim=ndim, dtype=dtype, bc="ghost",
+                   ic="hat"),
+        HeatConfig(n=small, ntime=21, ndim=ndim, dtype=dtype, bc="edges",
+                   ic="uniform", nu=0.1),
+        HeatConfig(n=big - 2, ntime=9, ndim=ndim, dtype=dtype, bc="edges",
+                   ic="sine"),
+    ]
+
+
+@pytest.mark.parametrize("kernel,ndim,dtype,depth", MATRIX)
+def test_numerics_on_vs_off_byte_identical(kernel, ndim, dtype, depth):
+    """The tentpole's acceptance spelling: the observatory is pure
+    observation — toggling it changes no output byte on either chunk
+    body (the stats rows are always computed; only host INGESTION is
+    gated)."""
+    reqs = matrix_requests(ndim, dtype)
+    kw = dict(lanes=2, chunk=4, buckets=(8 if ndim == 3 else 12,),
+              dispatch_depth=depth, lane_kernel=kernel)
+    eng_on, recs_on = drain(reqs, numerics=True, **kw)
+    eng_off, recs_off = drain(reqs, numerics=False, **kw)
+    assert eng_on.numerics is not None and eng_off.numerics is None
+    assert all(r["status"] == "ok" for r in recs_on)
+    for a, b in zip(recs_on, recs_off):
+        assert a["status"] == b["status"]
+        assert a["T"].dtype == b["T"].dtype
+        assert a["T"].tobytes() == b["T"].tobytes(), a["id"]
+
+
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_numerics_npz_outputs_byte_identical_across_depths(tmp_path, kernel):
+    """Published npz artifacts from --numerics on and off are
+    byte-identical at dispatch depths 0 AND 2 (the acceptance cells)."""
+    reqs = matrix_requests(2, "float32")
+    for depth in (0, 2):
+        outs = {}
+        for mode in (True, False):
+            d = tmp_path / f"{kernel}-{depth}-{'on' if mode else 'off'}"
+            drain(reqs, numerics=mode, lanes=2, chunk=4, buckets=(12,),
+                  dispatch_depth=depth, lane_kernel=kernel,
+                  keep_fields=False, out_dir=str(d))
+            outs[mode] = d
+        files = sorted(p.name for p in outs[True].glob("*.npz"))
+        assert files   # the runs actually published
+        for name in files:
+            assert ((outs[True] / name).read_bytes()
+                    == (outs[False] / name).read_bytes()), (name, depth)
+
+
+def test_numerics_adds_no_boundary_fetches(monkeypatch):
+    """Zero extra transfers: the stats ride the ONE boundary fetch that
+    already happens, so the host_fetch count is identical on vs off."""
+    real = engine_mod.host_fetch
+    counts = {"n": 0}
+
+    def spy(x):
+        counts["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(engine_mod, "host_fetch", spy)
+    reqs = matrix_requests(2, "float32")
+    kw = dict(lanes=2, chunk=4, buckets=(12,), dispatch_depth=2)
+    per_mode = {}
+    for mode in (True, False):
+        counts["n"] = 0
+        _, recs = drain(reqs, numerics=mode, **kw)
+        assert all(r["status"] == "ok" for r in recs)
+        per_mode[mode] = counts["n"]
+    assert per_mode[True] == per_mode[False] > 0
+
+
+# --- observatory math (runtime/numerics.py unit tests) -----------------------
+
+
+def test_envelope_tolerance_is_dtype_and_scale_aware():
+    obs = numerics_mod.NumericsObservatory(steady_tol=1e-12)
+    obs.admit("r", lo=0.0, hi=2.0, dtype="bfloat16")
+    # bf16 allowance: 5e-2 * scale 2 = 0.1 — a storage-rounding excursion
+    # inside it is NOT a violation
+    assert obs.observe("r", resid=0.1, tmin=0.0, tmax=2.05, heat=10.0,
+                       remaining=5) == []
+    (ev,) = obs.observe("r", resid=0.1, tmin=0.0, tmax=2.3, heat=10.0,
+                        remaining=4)
+    assert ev["kind"] == "violation" and ev["why"] == "max-principle"
+    assert ev["tmax"] == 2.3 and ev["hi"] == 2.0
+
+
+def test_violation_latches_once_per_request():
+    obs = numerics_mod.NumericsObservatory(steady_tol=1e-12)
+    obs.admit("r", lo=1.0, hi=2.0, dtype="float32")
+    assert len(obs.observe("r", resid=0.1, tmin=0.5, tmax=2.0, heat=1.0,
+                           remaining=9)) == 1
+    # still out of envelope on the next boundary: latched, no re-fire
+    assert obs.observe("r", resid=0.1, tmin=0.4, tmax=2.0, heat=1.0,
+                       remaining=8) == []
+    assert obs.violation_total == 1
+    assert obs.snapshot()["lanes"]["r"]["violated"] is True
+
+
+def test_heat_jump_armed_after_two_boundaries():
+    obs = numerics_mod.NumericsObservatory(steady_tol=1e-30)
+    obs.admit("r", lo=-1e9, hi=1e9, dtype="float32")  # envelope never fires
+
+    def feed(heat):
+        return obs.observe("r", resid=1.0, tmin=0.0, tmax=1.0, heat=heat,
+                           remaining=99)
+
+    assert feed(100.0) == []        # first boundary: no delta yet
+    assert feed(99.5) == []         # second: delta known, EWMA unarmed
+    assert feed(99.0) == []         # smooth decay: no alarm
+    (ev,) = feed(60.0)              # |Δ| = 39 >> 50 * max(ewma ~0.5, floor)
+    assert ev["kind"] == "violation" and ev["why"] == "heat-jump"
+    assert ev["heat"] == 60.0 and ev["heat_prev"] == 99.0
+
+
+def test_nonfinite_stats_and_unknown_lanes_are_ignored():
+    obs = numerics_mod.NumericsObservatory(steady_tol=1e-12)
+    assert obs.observe("ghost-of-a-request", 0.0, 0.0, 1.0, 1.0, 5) == []
+    obs.admit("r", lo=0.0, hi=1.0, dtype="float32")
+    # a NaN stat (the lane is headed to the nonfinite path anyway) must
+    # not poison the EWMAs or trip a detector
+    assert obs.observe("r", resid=float("nan"), tmin=0.0, tmax=99.0,
+                       heat=1.0, remaining=5) == []
+    snap = obs.snapshot()["lanes"]["r"]
+    assert snap["boundaries"] == 0 and snap["resid_ewma"] is None
+
+
+def test_steady_fires_once_and_only_with_steps_remaining():
+    obs = numerics_mod.NumericsObservatory(steady_tol=1e-6)
+    obs.admit("r", lo=0.0, hi=1.0, dtype="float32")
+    (ev,) = obs.observe("r", resid=0.0, tmin=0.0, tmax=1.0, heat=1.0,
+                        remaining=5)
+    assert ev["kind"] == "steady" and ev["steady_tol"] == 1e-6
+    # fire-once: still converged on later boundaries, no re-fire
+    assert obs.observe("r", 0.0, 0.0, 1.0, 1.0, 4) == []
+    assert obs.steady_total == 1
+    # a lane converging exactly at its LAST boundary isn't "burning chip"
+    obs.admit("s", lo=0.0, hi=1.0, dtype="float32")
+    assert obs.observe("s", 0.0, 0.0, 1.0, 1.0, 0) == []
+
+
+def test_forget_drops_detector_state():
+    obs = numerics_mod.NumericsObservatory(steady_tol=1e-12)
+    obs.admit("r", lo=0.0, hi=1.0, dtype="float32")
+    obs.forget("r")
+    assert obs.snapshot()["lanes"] == {}
+    obs.forget("r")   # idempotent on any terminal path
+
+
+def test_serve_config_validates_numerics_knobs():
+    with pytest.raises(ValueError, match="steady_tol"):
+        ServeConfig(steady_tol=0.0)
+    with pytest.raises(ValueError, match="numerics_guard"):
+        ServeConfig(numerics_guard="page-someone")
+    assert ServeConfig(numerics_guard="quarantine").numerics_guard == \
+        "quarantine"
+
+
+# --- e2e detectors through the serving stack ---------------------------------
+
+
+def test_steady_state_record_fires_once_per_request(capsys):
+    """A uniform field under frozen edges is ALREADY converged: resid is
+    exactly 0 from the first chunk, so each request earns exactly one
+    steady_state record despite many more boundaries."""
+    reqs = [HeatConfig(n=12, ntime=40, dtype="float32", bc="edges",
+                       ic="uniform"),
+            HeatConfig(n=12, ntime=32, dtype="float32", bc="edges",
+                       ic="uniform", nu=0.1)]
+    eng, recs = drain(reqs, lanes=2, chunk=4, buckets=(12,))
+    assert all(r["status"] == "ok" for r in recs)
+    rows = records_of(capsys, "steady_state")
+    assert sorted(r["id"] for r in rows) == sorted(r["id"] for r in recs)
+    for row in rows:
+        assert row["resid"] == 0.0 and row["remaining"] > 0
+        assert row["steady_tol"] == 1e-12 and "trace_id" in row
+    assert eng.summary()["steady_lanes"] == 2
+
+
+PERTURB_REQS = [
+    HeatConfig(n=12, ntime=24, dtype="float32", bc="ghost"),
+    HeatConfig(n=12, ntime=24, dtype="float32", bc="edges",
+               ic="hat_small"),
+]
+
+
+def run_perturbed(guard, flight_dir=None):
+    eng = Engine(quiet(lanes=2, chunk=4, buckets=(12,),
+                       inject="perturb@6:req=bad:eps=100",
+                       numerics_guard=guard,
+                       flight_dir=flight_dir))
+    for rid, cfg in zip(("bad", "clean"), PERTURB_REQS):
+        eng.submit(cfg, request_id=rid)
+    by_id = {r["id"]: r for r in eng.results()}
+    return eng, by_id
+
+
+def test_perturb_fires_numerics_violation_with_flight_dump(tmp_path,
+                                                           capsys):
+    """guard=warn: the finite perturbation escapes the maximum-principle
+    envelope -> ONE numerics_violation record with concrete witnesses +
+    a flight-recorder dump; the request still completes (warn observes,
+    never guards)."""
+    faults.reset()
+    eng, by_id = run_perturbed("warn", flight_dir=str(tmp_path))
+    assert by_id["bad"]["status"] == "ok"     # warn never fails a lane
+    assert by_id["clean"]["status"] == "ok"
+    out = capsys.readouterr().out
+    rows = [json.loads(line) for line in out.splitlines()
+            if line.startswith("{")]
+    viol = [r for r in rows if r.get("event") == "numerics_violation"]
+    assert len(viol) == 1                     # fire-once latch
+    v = viol[0]
+    assert v["id"] == "bad" and v["why"] == "max-principle"
+    assert v["guard"] == "warn" and v["tmax"] > v["hi"] + v["tol"]
+    assert v["trace_id"]
+    dumps = [r for r in rows if r.get("event") == "flightrec"]
+    assert len(dumps) == 1 and "numerics violation" in dumps[0]["reason"]
+    dump_path = pathlib.Path(dumps[0]["path"])
+    assert dump_path.parent == tmp_path and dump_path.exists()
+    assert eng.summary()["numerics_violations"] == 1
+    assert eng.lanes_quarantined == 0
+
+
+def test_perturb_quarantine_frees_lane_coscheduled_unharmed(capsys):
+    """guard=quarantine: the violated lane takes the PR-5 quarantine
+    exit (structured nonfinite failure naming the numerics verdict);
+    the co-scheduled lane's bytes match a clean run exactly."""
+    faults.reset()
+    eng, by_id = run_perturbed("quarantine")
+    assert by_id["bad"]["status"] == "nonfinite"
+    assert "numerics" in (by_id["bad"]["error"] or "")
+    assert by_id["clean"]["status"] == "ok"
+    assert eng.lanes_quarantined == 1
+    assert eng.summary()["numerics_violations"] == 1
+    (v,) = records_of(capsys, "numerics_violation")
+    assert v["guard"] == "quarantine"
+    faults.reset()
+    _, clean = drain([PERTURB_REQS[1]], lanes=2, chunk=4, buckets=(12,))
+    assert by_id["clean"]["T"].tobytes() == clean[0]["T"].tobytes()
+
+
+def test_statusz_and_metrics_surface_numerics(capsys):
+    from heat_tpu.serve.gateway import render_metrics, render_statusz
+
+    eng, _ = drain([HeatConfig(n=12, ntime=8, dtype="float32")],
+                   lanes=1, chunk=4, buckets=(12,))
+    text = render_metrics(eng)
+    assert 'heat_tpu_numerics_enabled{guard="warn"} 1' in text
+    assert "heat_tpu_numerics_steady_total" in text
+    assert "heat_tpu_numerics_violations_total" in text
+    assert "numerics: guard warn" in render_statusz(eng)
+    eng_off, _ = drain([HeatConfig(n=12, ntime=8, dtype="float32")],
+                       lanes=1, chunk=4, buckets=(12,), numerics=False)
+    assert 'heat_tpu_numerics_enabled{guard="warn"} 0' in \
+        render_metrics(eng_off)
+    assert "observatory OFF" in render_statusz(eng_off)
+
+
+# --- the analytic backbone ---------------------------------------------------
+
+
+@pytest.mark.parametrize("ndim,n,ntime", [(2, 16, 25), (3, 8, 12)])
+def test_sine_eigenmode_decays_by_closed_form(ndim, n, ntime):
+    """The prober's entire premise: under frozen-edge FTCS the sine IC
+    is an exact eigenmode — step s equals lambda**s * T0 to f64
+    rounding."""
+    import jax.numpy as jnp
+
+    from heat_tpu.ops.stencil import ftcs_step_edges, run_steps
+
+    cfg = HeatConfig(n=n, ntime=ntime, ndim=ndim, dtype="float64",
+                     ic="sine", bc="edges")
+    T0 = initial_condition(cfg)
+    lam = sine_decay_factor(cfg)
+    assert 0.0 < lam < 1.0
+    T = np.asarray(run_steps(jnp.asarray(T0), ntime,
+                             lambda t: ftcs_step_edges(t, cfg.r)))
+    np.testing.assert_allclose(T, lam ** ntime * T0, rtol=0.0, atol=1e-12)
+
+
+def test_sine_decay_factor_closed_form_value():
+    cfg = HeatConfig(n=64, ndim=2, dtype="float32", ic="sine", bc="edges")
+    lam = 1.0 - 4.0 * 2 * float(cfg.r) * math.sin(
+        math.pi / (2.0 * 63)) ** 2
+    assert sine_decay_factor(cfg) == pytest.approx(lam, rel=0, abs=0)
+
+
+def test_ic_envelope_covers_presets_and_ghost_ring():
+    assert ic_envelope(HeatConfig(ic="uniform", bc="edges")) == (2.0, 2.0)
+    assert ic_envelope(HeatConfig(ic="zero", bc="edges")) == (0.0, 0.0)
+    assert ic_envelope(HeatConfig(ic="sine", bc="edges")) == (0.0, 1.0)
+    assert ic_envelope(HeatConfig(ic="hat", bc="edges")) == (1.0, 2.0)
+    # ghost BCs clamp the ring at bc_value: it joins the envelope
+    assert ic_envelope(HeatConfig(ic="hat", bc="ghost",
+                                  bc_value=2.5)) == (1.0, 2.5)
+    assert ic_envelope(HeatConfig(ic="hat", bc="ghost",
+                                  bc_value=0.5)) == (0.5, 2.0)
+
+
+def test_envelope_bounds_every_ic_preset_field():
+    """ic_envelope is analytic; it must actually bound the constructed
+    field (the detector would false-positive otherwise)."""
+    for ic in ("uniform", "zero", "sine", "hat", "hat_small", "hat_half"):
+        for bc in ("edges", "ghost"):
+            cfg = HeatConfig(n=12, ndim=2, dtype="float64", ic=ic, bc=bc,
+                             bc_value=1.5)
+            lo, hi = ic_envelope(cfg)
+            T0 = initial_condition(cfg)
+            assert lo <= float(T0.min()) and float(T0.max()) <= hi, (ic, bc)

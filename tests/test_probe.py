@@ -1,0 +1,221 @@
+"""Black-box canary prober (serve/probe.py, ISSUE 15).
+
+The prober is only trustworthy if it exercises the REAL serving path, so
+these tests run it against a live Gateway on a localhost socket: POST
+/v1/solve -> lanes -> writer -> GET /v1/requests/<id>?field=1, verified
+against the closed-form sine-eigenmode decay. The failure story matters
+as much as the pass story: a wrong-physics answer (not a transport
+error) must fail the probe with a concrete error norm, and exactly one
+``probe_failed`` record fires at the consecutive-miss threshold.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from heat_tpu.config import HeatConfig
+from heat_tpu.grid import initial_condition, sine_decay_factor
+from heat_tpu.runtime import faults
+from heat_tpu.serve import Engine, ServeConfig
+from heat_tpu.serve.gateway import Gateway, render_metrics, render_statusz
+from heat_tpu.serve.probe import (DEFAULT_PROBE_REQUEST, PROBE_TENANT,
+                                  PROBE_TOL, Prober, expected_probe_field,
+                                  probe_urls)
+
+TIMEOUT = 60
+
+# A faster canary than the production default (same physics, fewer
+# cells/steps): tier-1 runs dozens of probes.
+SMALL_PROBE = {"n": 32, "ndim": 2, "ntime": 60, "dtype": "float32",
+               "ic": "sine", "bc": "edges"}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def make_gateway(tmp_path=None, **scfg_kw):
+    scfg_kw.setdefault("emit_records", False)
+    scfg_kw.setdefault("lanes", 2)
+    scfg_kw.setdefault("chunk", 8)
+    scfg_kw.setdefault("buckets", (32,))
+    if tmp_path is None:
+        scfg_kw.setdefault("keep_fields", True)
+    else:
+        scfg_kw.setdefault("out_dir", str(tmp_path / "results"))
+    eng = Engine(ServeConfig(**scfg_kw))
+    gw = Gateway(eng, "127.0.0.1", 0, start_engine=True).start()
+    return gw, eng
+
+
+def records_of(capsys, event):
+    out = capsys.readouterr().out
+    return [json.loads(line) for line in out.splitlines()
+            if line.startswith("{")
+            and json.loads(line).get("event") == event]
+
+
+def drain_close(gw):
+    gw.request_drain()
+    assert gw.wait_drained(TIMEOUT)
+    gw.close()
+
+
+# --- the pass story ----------------------------------------------------------
+
+
+def test_probe_verifies_closed_form_through_real_gateway(capsys):
+    """Acceptance e2e: one probe through the live HTTP path comes back
+    with a max-norm error orders below tolerance, lands in the usage
+    ledger under the reserved tenant, and emits a probe_result record
+    carrying the verdict and the request's trace id."""
+    gw, eng = make_gateway()
+    try:
+        prober = Prober(f"http://{gw.address}", interval_s=3600.0,
+                        request=SMALL_PROBE)
+        verdict = prober.run_once()
+        assert verdict["ok"] is True and verdict["status"] == "ok"
+        assert verdict["error_norm"] < PROBE_TOL["float32"] / 100
+        assert verdict["trace_id"]
+        # the probe is attributable: reserved tenant on the real record
+        rec = eng.poll("_probe-0001")
+        assert rec is not None and rec["tenant"] == PROBE_TENANT
+        st = prober.stats()
+        assert st["passes"] == 1 and st["fails"] == 0
+        assert st["consecutive_failures"] == 0
+        assert st["last_error_norm"] == verdict["error_norm"]
+        # export surfaces: attach the prober the way cmd_serve does
+        eng.prober = prober
+        text = render_metrics(eng)
+        assert 'heat_tpu_probe_runs_total{result="pass"} 1' in text
+        assert 'heat_tpu_probe_runs_total{result="fail"} 0' in text
+        assert "heat_tpu_probe_consecutive_failures 0" in text
+        assert "heat_tpu_probe_last_error_norm" in text
+        assert "prober: every 3600s, 1 pass / 0 fail" in \
+            render_statusz(eng)
+        (row,) = records_of(capsys, "probe_result")
+        assert row["ok"] is True and row["trace_id"] == verdict["trace_id"]
+        assert row["consecutive_failures"] == 0
+    finally:
+        drain_close(gw)
+
+
+def test_field_endpoint_serves_solution_on_demand(tmp_path):
+    """``?field=1`` returns the solved field (f64 nested lists) on BOTH
+    retention paths — in-memory keep_fields and npz out_dir — while the
+    plain record endpoint stays payload-free."""
+    import urllib.request
+
+    for with_dir in (False, True):
+        gw, eng = make_gateway(tmp_path / "d" if with_dir else None)
+        try:
+            body = (json.dumps({"id": "x", "n": 16, "ntime": 8,
+                                "dtype": "float64"}) + "\n").encode()
+            req = urllib.request.Request(
+                f"http://{gw.address}/v1/solve", data=body, method="POST")
+            with urllib.request.urlopen(req, timeout=TIMEOUT) as resp:
+                (rec,) = [json.loads(l) for l in
+                          resp.read().decode().splitlines() if l.strip()]
+            assert rec["status"] == "ok" and "T" not in rec
+            with urllib.request.urlopen(
+                    f"http://{gw.address}/v1/requests/x",
+                    timeout=TIMEOUT) as resp:
+                assert "T" not in json.loads(resp.read().decode())
+            with urllib.request.urlopen(
+                    f"http://{gw.address}/v1/requests/x?field=1",
+                    timeout=TIMEOUT) as resp:
+                got = np.asarray(json.loads(resp.read().decode())["T"])
+            from heat_tpu.backends import solve
+            expect = solve(HeatConfig(n=16, ntime=8, dtype="float64")).T
+            np.testing.assert_array_equal(got, np.asarray(expect))
+        finally:
+            drain_close(gw)
+
+
+def test_expected_probe_field_is_the_closed_form():
+    cfg_req = dict(DEFAULT_PROBE_REQUEST)
+    field = expected_probe_field(cfg_req)
+    cfg = HeatConfig(n=64, ndim=2, ntime=200, dtype="float32", ic="sine",
+                     bc="edges")
+    lam = sine_decay_factor(cfg)
+    np.testing.assert_array_equal(
+        field, lam ** 200 * initial_condition(cfg).astype(np.float64))
+    assert probe_urls("http://h:1/") == [
+        "http://h:1/v1/solve", "http://h:1/v1/requests/<id>?field=1"]
+
+
+# --- the failure story -------------------------------------------------------
+
+
+def test_wrong_physics_fails_probe_and_probe_failed_fires_once(capsys):
+    """A served answer that disagrees with the closed form (here: a hat
+    IC solved correctly but verified against the sine eigenmode — the
+    same signature a wrong-stencil regression leaves) fails probes;
+    probe_failed fires exactly ONCE at the fail_after threshold and the
+    run resets on the next pass."""
+    gw, eng = make_gateway()
+    try:
+        prober = Prober(f"http://{gw.address}", interval_s=3600.0,
+                        request=dict(SMALL_PROBE, ic="hat"), fail_after=2)
+        for _ in range(3):
+            verdict = prober.run_once()
+            assert verdict["ok"] is False
+            assert verdict["error_norm"] > PROBE_TOL["float32"]
+            assert "exceeds tol" in verdict["error"]
+        st = prober.stats()
+        assert st["fails"] == 3 and st["consecutive_failures"] == 3
+        rows = records_of(capsys, "probe_failed")
+        assert len(rows) == 1     # fired at consecutive == 2, not again
+        assert rows[0]["consecutive"] == 2 and rows[0]["threshold"] == 2
+        # a pass resets the consecutive counter (a NEW run of failures
+        # would page again)
+        prober.request = dict(SMALL_PROBE)
+        assert prober.run_once()["ok"] is True
+        st = prober.stats()
+        assert st["consecutive_failures"] == 0
+        assert st["passes"] == 1 and st["fails"] == 3
+    finally:
+        drain_close(gw)
+
+
+def test_transport_refusal_counts_as_probe_failure(capsys):
+    """A request the engine cannot serve (periodic BC has no padded-lane
+    form) is a failed probe carrying the rejection status — black-box
+    probing covers 'cannot get through' as well as 'wrong answer'."""
+    gw, eng = make_gateway()
+    try:
+        prober = Prober(f"http://{gw.address}", interval_s=3600.0,
+                        request=dict(SMALL_PROBE, bc="periodic"),
+                        fail_after=1)
+        verdict = prober.run_once()
+        assert verdict["ok"] is False and verdict["status"] == "rejected"
+        assert verdict["error_norm"] is None
+        assert "periodic" in verdict["error"]
+        rows = records_of(capsys, "probe_failed")
+        assert len(rows) == 1 and rows[0]["consecutive"] == 1
+    finally:
+        drain_close(gw)
+
+
+def test_prober_thread_lifecycle():
+    """start() spawns the named daemon thread (the conftest leak guard
+    watches for it); stop() joins it promptly even mid-interval."""
+    import threading
+
+    gw, eng = make_gateway()
+    try:
+        prober = Prober(f"http://{gw.address}", interval_s=3600.0,
+                        request=SMALL_PROBE).start()
+        names = [t.name for t in threading.enumerate()]
+        assert "heat-tpu-prober" in names
+        prober.stop()
+        assert not any(t.name == "heat-tpu-prober" and t.is_alive()
+                       for t in threading.enumerate())
+        # no probe ran (the first tick is one full interval out)
+        assert prober.stats()["passes"] == prober.stats()["fails"] == 0
+    finally:
+        drain_close(gw)

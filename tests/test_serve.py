@@ -650,9 +650,11 @@ def test_rollback_deterministic_blowup_quarantines_after_budget(tmp_path):
 
 
 def test_boundary_vector_carries_per_lane_finite_bits():
-    """Engine-level unit: the chunk program's (2, L) boundary vector
-    flags exactly the poisoned lane — no extra D2H beyond the boundary
-    fetch the scheduler already pays."""
+    """Engine-level unit: the chunk program's (K_BOUNDARY, L) boundary
+    vector flags exactly the poisoned lane — no extra D2H beyond the
+    boundary fetch the scheduler already pays. Rows 2+ carry the
+    bitcast numerics stats (ISSUE 15); rows 0-1 stay the int32
+    remaining/finite contract this test pins."""
     key = BucketKey(2, 16, "float64", "edges")
     eng = LaneEngine(key, 2, 4)
     from heat_tpu.grid import initial_condition
@@ -662,7 +664,7 @@ def test_boundary_vector_carries_per_lane_finite_bits():
         eng.load_lane(lane, initial_condition(cfg), cfg.r, 8, cfg.bc_value)
     eng.poison_lane(0, cfg.n)
     b = eng.step_chunk()
-    assert b.shape == (2, 2)
+    assert b.shape == (engine_mod.K_BOUNDARY, 2)
     assert list(b[0]) == [4, 4]        # remaining: both stepped the chunk
     assert list(b[1]) == [0, 1]        # finite bits: only lane 0 flagged
 

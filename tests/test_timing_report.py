@@ -92,6 +92,26 @@ def test_report_lines_serve_faults_only_when_fault_domains_ran():
     assert "0 quarantined" in line and "0 rollback(s)" in line
 
 
+def test_report_lines_numerics_only_when_observatory_ran():
+    """The numerics line rides the report only when the observatory
+    ingested boundaries (None suppresses; 0 is data — a clean run with
+    the observatory on still reports its zeros)."""
+    solo = Timing(total_s=1.0, solve_s=0.5, steps=4, points=16)
+    assert not any(l.startswith("numerics:") for l in solo.report_lines())
+
+    served = Timing(total_s=1.0, solve_s=1.0, dispatch_depth=2,
+                    steady_lanes=3, numerics_violations=1)
+    (line,) = [l for l in served.report_lines()
+               if l.startswith("numerics:")]
+    assert "3 steady lane(s)" in line and "1 violation(s)" in line
+
+    clean = Timing(total_s=1.0, solve_s=1.0, dispatch_depth=2,
+                   steady_lanes=0, numerics_violations=None)
+    (line,) = [l for l in clean.report_lines()
+               if l.startswith("numerics:")]
+    assert "0 steady lane(s)" in line and "0 violation(s)" in line
+
+
 def test_compile_line_present_only_when_compiled():
     with_c = Timing(total_s=1.0, compile_s=0.3, solve_s=0.5, steps=1, points=1)
     without = Timing(total_s=1.0, compile_s=0.0, solve_s=0.5, steps=1, points=1)

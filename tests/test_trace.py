@@ -103,6 +103,65 @@ def test_serve_config_validates_trace_knobs():
         ServeConfig(trace="t.json", trace_buffer=0)
 
 
+def test_counter_samples_render_in_export_and_summary():
+    """The numerics observatory's 'C' counter samples (ISSUE 15): args
+    flow to the Chrome export as counter tracks, and summarize() renders
+    min/max/last per series. A disabled tracer records nothing."""
+    t = trace_mod.Tracer(capacity=16)
+    tr = t.track("lanes", "g0")
+    t.counter("numerics lane 0", tr, {"resid": 1.0, "heat": 5.0})
+    t.counter("numerics lane 0", tr, {"resid": 0.25, "heat": 4.0})
+    chrome = t.to_chrome()
+    cs = [e for e in chrome["traceEvents"] if e["ph"] == "C"]
+    assert len(cs) == 2
+    assert all(e["name"] == "numerics lane 0" for e in cs)
+    assert cs[0]["args"] == {"resid": 1.0, "heat": 5.0}
+    text = "\n".join(trace_mod.summarize(chrome))
+    assert "counter tracks:" in text
+    assert ("numerics lane 0/resid: 2 sample(s), min 0.25, max 1, "
+            "last 0.25") in text
+    assert "numerics lane 0/heat: 2 sample(s)" in text
+
+    off = trace_mod.Tracer(capacity=0)
+    off.counter("x", off.track("p", "t"), {"v": 1.0})
+    assert len(off) == 0
+
+
+def test_serve_trace_carries_numerics_counter_tracks(tmp_path):
+    """A real drain with the observatory on exports per-lane residual/
+    heat counter samples on the group's track."""
+    path = tmp_path / "num.trace.json"
+    drain(tmp_path, "ctr", trace=str(path))
+    evs = json.loads(path.read_text())["traceEvents"]
+    cs = [e for e in evs if e["ph"] == "C"]
+    assert cs and any(e["name"].startswith("numerics lane") for e in cs)
+    series = set()
+    for e in cs:
+        series |= set(e["args"])
+    assert {"resid", "heat"} <= series
+    text = "\n".join(trace_mod.summarize_file(path))
+    assert "counter tracks:" in text and "numerics lane" in text
+
+
+def test_trace_cli_triage_names_numerics_violation_dump(tmp_cwd, capsys):
+    """`heat-tpu trace <flightrec-*.json>` prints a one-line triage verdict
+    naming the likely trigger — a numerics violation here."""
+    from heat_tpu.cli import main
+
+    eng = Engine(quiet(lanes=1, chunk=4, buckets=(12,),
+                       inject="perturb@6:eps=100",
+                       flight_dir=str(tmp_cwd)))
+    rid = eng.submit(HeatConfig(n=12, ntime=24, dtype="float32"))
+    recs = {r["id"]: r for r in eng.results()}
+    assert recs[rid]["status"] == "ok"        # guard=warn observes only
+    (dump,) = sorted(tmp_cwd.glob("flightrec-*.trace.json"))
+    capsys.readouterr()
+    assert main(["trace", dump.name]) == 0
+    out = capsys.readouterr().out
+    assert "numerics-violation" in out
+    assert "flight-dump triage" in out and "likely trigger" in out
+
+
 # --- export schema (the Perfetto-loadability contract) ------------------------
 
 
@@ -240,9 +299,16 @@ def test_flight_dump_on_quarantine_after_rollback_budget(tmp_path):
     recs = {r["id"]: r for r in eng.results()}
     assert recs[boom]["status"] == "nonfinite"
     assert "deterministic blow-up" in recs[boom]["error"]
+    # two dumps: the numerics observatory flags the envelope escape while
+    # the field is still finite (ISSUE 15's early warning), THEN the
+    # nonfinite path exhausts its rollback budget
     dumps = sorted(tmp_path.glob("flightrec-*.trace.json"))
-    assert len(dumps) == 1
-    evs = json.loads(dumps[0].read_text())["traceEvents"]
+    assert len(dumps) == 2
+    first = [e["name"] for e in
+             json.loads(dumps[0].read_text())["traceEvents"]
+             if e["ph"] == "i"]
+    assert "numerics-violation" in first
+    evs = json.loads(dumps[1].read_text())["traceEvents"]
     names = [e["name"] for e in evs if e["ph"] == "i"]
     assert names.count("rollback") == 2 and "quarantine" in names
 
