@@ -9,7 +9,7 @@ SHELL := /bin/bash
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
-        numerics-lab lane-lab mega-lab perfcheck native run viz clean
+        numerics-lab steady-lab lane-lab mega-lab perfcheck native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -128,6 +128,11 @@ numerics-lab:          # numerics-observatory A/B: boundary-vector stats
                        # ingestion vs off (<= 2% gate, npz bit-identity at
                        # depths 0 and 2, live-gateway probe verification)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/numerics_overhead_lab.py
+
+steady-lab:            # semantic-scheduling A/B: until=steady early exit
+                       # vs fixed-step (>= 1.5x effective throughput gate;
+                       # steady + co-lane bit-identity, zero added D2H)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_steady_lab.py
 
 lane-lab:              # serve lane-kernel A/B: Pallas lane program vs XLA
                        # lane program vs solo Pallas drives (bit-identity

@@ -155,6 +155,12 @@ phase prof_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/prof_overhe
 # the maximum-principle detector on a seeded perturb fault. CPU-world:
 # runs with the tunnel down.
 phase numerics_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/numerics_overhead_lab.py
+# Semantic scheduling A/B (ISSUE 16): 64-request diffusive population
+# run until=steady vs fixed-step — >= 1.5x effective aggregate
+# throughput, steady records bit-identical to the truncated fixed-step
+# run, co-lanes byte-identical, zero added D2H (host_fetch-spy-gated).
+# CPU-world: runs with the tunnel down.
+phase serve_steady_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_steady_lab.py
 # Invariant guard (ISSUE 11 + 14): lint + the project-native
 # static-analysis suite (hot-path purity, lock discipline, traced-code
 # determinism, Mosaic kernel safety, race lockset inference) + the

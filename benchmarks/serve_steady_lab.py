@@ -1,0 +1,238 @@
+"""Semantic-scheduling A/B: ``until=steady`` early exit vs fixed-step.
+
+The claim (ISSUE 16): a diffusive request population — sine eigenmode
+ICs whose residual decays as ``lambda**s`` — asked to run "until steady"
+retires lanes at the first chunk boundary whose residual EWMA passes
+tolerance, and the freed lanes backfill immediately. Billing the
+*requested* work (what the tenant asked for) against the drain's wall
+clock, the steady run must deliver >= 1.5x the effective aggregate
+throughput of the same population run to completion.
+
+Three correctness locks ride the perf number (a perf artifact must
+never certify a wrong-answer engine):
+
+- ``steady_bit_identical`` — a sample of steady records is re-solved
+  solo with ``ntime=steps_done``; the early-exit field must be
+  bit-identical to the truncated fixed-step run (the exit is a
+  *scheduling* decision, never a numerical one).
+- ``colane_bit_identical`` — fixed-step co-requests drained alongside
+  the steady population must produce byte-identical fields to the
+  all-fixed-step run: semantic scheduling cannot perturb lanes that
+  never opted in.
+- ``zero_added_transfers`` — ``engine.host_fetch`` is the ONE D2H seam;
+  a spy counts calls in both runs. The steady decision rides the
+  boundary vector the engine already fetches, so the steady run must
+  perform NO MORE fetches than the fixed-step run (fewer, in fact:
+  retired lanes stop producing boundaries).
+
+    JAX_PLATFORMS=cpu python benchmarks/serve_steady_lab.py [--requests 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+from _util import write_atomic
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# tolerance per grid side, chosen (see runtime/convergence.py closed
+# form) so the residual EWMA crosses well inside ntime=512: n=24 fires
+# near step ~185, n=32 near step ~105 — both leave >60% of the requested
+# steps on the table, which is where the throughput multiplier comes from
+STEADY_TOL = {24: 2e-3, 32: 2e-3}
+NTIME = 512
+
+
+def build_population(count: int):
+    """``count`` diffusive requests: sine eigenmode IC (the one IC with
+    a closed-form decay rate — grid.sine_decay_factor), two grid sides
+    so both bucket/lane-tier combos stay exercised, all asking for
+    NTIME=400 steps they will not need. Step count is a chunk multiple
+    (chunk 16) so the fixed-step baseline never compiles a tail."""
+    from heat_tpu.config import HeatConfig
+
+    sides = (24, 32)
+    return [HeatConfig(n=sides[i % 2], ntime=NTIME, dtype="float64",
+                       bc="edges", ic="sine") for i in range(count)]
+
+
+def build_colanes(count: int):
+    """Fixed-step co-requests mixed into BOTH runs: hat ICs (no steady
+    opt-in) at a shorter step count. Their fields must come out byte-
+    identical whether or not steady neighbors retire around them."""
+    from heat_tpu.config import HeatConfig
+
+    sides = (24, 32)
+    return [HeatConfig(n=sides[i % 2], ntime=96 + 16 * (i % 2),
+                       dtype="float64", bc="edges",
+                       ic=("hat", "hat_small")[i % 2])
+            for i in range(count)]
+
+
+def run_engine(population, colanes, lanes, chunk, depth, steady: bool):
+    """Drain population + colanes through one engine; count every
+    host_fetch. ``steady=True`` submits the population as until=steady
+    (per-request tol); colanes are always fixed-step."""
+    from heat_tpu.serve import Engine, ServeConfig
+    from heat_tpu.serve import engine as engine_mod
+
+    eng = Engine(ServeConfig(lanes=lanes, chunk=chunk, buckets=(32,),
+                             dispatch_depth=depth, emit_records=False))
+    fetches = [0]
+    real_fetch = engine_mod.host_fetch
+
+    def spy_fetch(x):
+        fetches[0] += 1
+        return real_fetch(x)
+
+    t0 = time.perf_counter()
+    try:
+        engine_mod.host_fetch = spy_fetch
+        ids = []
+        for i, cfg in enumerate(population):
+            if steady:
+                ids.append(eng.submit(cfg, until="steady",
+                                      tol=STEADY_TOL[cfg.n]))
+            else:
+                ids.append(eng.submit(cfg))
+        co_ids = [eng.submit(cfg) for cfg in colanes]
+        records = eng.results()
+    finally:
+        engine_mod.host_fetch = real_fetch
+    wall = time.perf_counter() - t0
+    by_id = {r["id"]: r for r in records}
+    return (wall, eng, [by_id[i] for i in ids],
+            [by_id[i] for i in co_ids], fetches[0])
+
+
+def _block(work, wall, eng, fetches, records):
+    s = eng.summary()
+    return {
+        "wall_s": round(wall, 3),
+        "effective_points_per_s": round(work / wall, 1),
+        "ok": sum(r["status"] == "ok" for r in records),
+        "rejected": sum(r["status"] == "rejected" for r in records),
+        "failed": sum(r["status"] not in ("ok", "rejected")
+                      for r in records),
+        "steady_exits": s["steady_exits"],
+        "steps_saved": s["steps_saved"],
+        "chunks_dispatched": s["chunks_dispatched"],
+        "host_fetches": fetches,
+        "step_compiles": eng.step_compiles,
+        "tail_compiles": eng.tail_compiles,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--colanes", type=int, default=8)
+    ap.add_argument("--lanes", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--out", default=str(Path(__file__).parent
+                                         / "serve_steady_lab.json"))
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from heat_tpu.backends import solve
+
+    population = build_population(args.requests)
+    colanes = build_colanes(args.colanes)
+    # effective throughput bills the REQUESTED work on both sides: the
+    # steady engine answers the same asks, it just stops stepping once
+    # the answer provably stopped changing
+    work = (sum(c.points * c.ntime for c in population)
+            + sum(c.points * c.ntime for c in colanes))
+
+    # fixed-step baseline first so the steady run cannot inherit a
+    # warmer process (each engine owns its compile caches)
+    fx_wall, fx_eng, fx_pop, fx_co, fx_fetches = run_engine(
+        population, colanes, args.lanes, args.chunk, args.depth,
+        steady=False)
+    st_wall, st_eng, st_pop, st_co, st_fetches = run_engine(
+        population, colanes, args.lanes, args.chunk, args.depth,
+        steady=True)
+
+    fixed = _block(work, fx_wall, fx_eng, fx_fetches, fx_pop + fx_co)
+    steady = _block(work, st_wall, st_eng, st_fetches, st_pop + st_co)
+
+    # lock 1: steady exits are scheduling decisions, not numerics —
+    # sampled early-exit fields == the truncated solo run, bit for bit
+    sample = sorted({0, 1, args.requests // 2, args.requests - 1})
+    steady_bit = True
+    for i in sample:
+        rec = st_pop[i]
+        if rec["status"] != "ok" or rec.get("exit") != "steady":
+            steady_bit = False
+            break
+        trunc = dataclasses.replace(population[i],
+                                    ntime=int(rec["steps_done"]))
+        if not np.array_equal(rec["T"], solve(trunc).T):
+            steady_bit = False
+            break
+
+    # lock 2: co-lanes that never opted in are untouched across runs
+    colane_bit = all(
+        a["status"] == b["status"] == "ok"
+        and a.get("exit") == b.get("exit") == "steps"
+        and np.array_equal(a["T"], b["T"])
+        for a, b in zip(fx_co, st_co))
+
+    # lock 3: the steady decision costs zero NEW transfers — it reads
+    # the boundary vector the engine fetched anyway
+    zero_added = st_fetches <= fx_fetches
+
+    all_retired = (steady["steady_exits"] == args.requests
+                   and all(r.get("exit") == "steady"
+                           and r["steps_done"] < NTIME for r in st_pop))
+    multiplier = (fx_wall / st_wall) if st_wall > 0 else None
+
+    rec = {
+        "bench": "serve_steady_lab",
+        "config": {"requests": args.requests, "colanes": args.colanes,
+                   "lanes": args.lanes, "chunk": args.chunk,
+                   "dispatch_depth": args.depth, "buckets": [32],
+                   "sides": [24, 32], "ntime": NTIME,
+                   "steady_tol": {str(k): v for k, v
+                                  in sorted(STEADY_TOL.items())},
+                   "dtype": "float64"},
+        "work_cell_steps": work,
+        "fixed": fixed,
+        "steady": steady,
+        "throughput_multiplier": (round(multiplier, 2)
+                                  if multiplier else None),
+        "all_population_retired_steady": all_retired,
+        "steady_bit_identical": steady_bit,
+        "colane_bit_identical": colane_bit,
+        "zero_added_transfers": zero_added,
+    }
+    write_atomic(Path(args.out), rec)
+    print(json.dumps(rec, indent=2))
+    passed = (fixed["ok"] == steady["ok"] == args.requests + args.colanes
+              and fixed["failed"] == steady["failed"] == 0
+              and fixed["steady_exits"] == 0
+              and all_retired
+              and steady_bit and colane_bit and zero_added
+              and multiplier is not None and multiplier >= 1.5)
+    print(f"serve_steady_lab: {'OK' if passed else 'FAILED'} — "
+          f"{rec['throughput_multiplier']}x effective throughput "
+          f"({steady['effective_points_per_s']:.3g} vs "
+          f"{fixed['effective_points_per_s']:.3g} pts/s), "
+          f"{steady['steady_exits']} steady exit(s) saved "
+          f"{steady['steps_saved']} step(s), host fetches "
+          f"{st_fetches} vs {fx_fetches} fixed, bit-identical "
+          f"steady={steady_bit} colane={colane_bit}")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

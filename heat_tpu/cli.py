@@ -148,8 +148,11 @@ def build_parser() -> argparse.ArgumentParser:
                             "= HeatConfig physics fields (n, ntime, sigma, "
                             "nu, dom_len, ndim, dtype, ic, bc, bc_value) + "
                             "optional id, deadline_ms (wall budget from "
-                            "submission), tenant, and class "
-                            "(interactive|standard|batch); '#' lines are "
+                            "submission), tenant, class "
+                            "(interactive|standard|batch), and "
+                            "until=steady with tol (retire at the first "
+                            "chunk boundary whose residual EWMA passes "
+                            "tol); '#' lines are "
                             "comments. Optional when --listen is given "
                             "(then it pre-loads the file before serving)")
     serve.add_argument("--listen", metavar="HOST:PORT",
@@ -327,8 +330,11 @@ def build_parser() -> argparse.ArgumentParser:
                        default=1e-12, metavar="TOL",
                        help="residual-EWMA threshold below which a lane "
                             "with steps remaining emits one steady_state "
-                            "record (interior max|dT| per mini-step; "
-                            "default 1e-12)")
+                            "record (interior max|dT| per mini-step), and "
+                            "— for until=steady requests without their "
+                            "own tol — the default tolerance at which the "
+                            "lane RETIRES early with exit=steady "
+                            "(semantic scheduling; default 1e-12)")
     serve.add_argument("--numerics-guard", dest="numerics_guard",
                        choices=["warn", "quarantine"], default="warn",
                        help="what a numerics_violation does: 'warn' = "
@@ -745,6 +751,10 @@ def _serve_report(summary, ok: int, args) -> None:
                      f"violation(s) (guard "
                      f"{summary.get('numerics_guard', 'warn')})"
                      + probes)
+    if summary.get("steady_exits"):
+        master_print(f"semantic scheduling: {summary['steady_exits']} "
+                     f"steady exit(s), {summary.get('steps_saved', 0)} "
+                     f"step(s) saved")
     cm = summary.get("cost_model") or []
     if cm:
         tops = sorted(cm, key=lambda e: -e["wall_s"])[:3]
@@ -963,13 +973,14 @@ def cmd_usage(args) -> int:
         print(_json.dumps(payload, sort_keys=True))
         return 0
     hdr = (f"{'tenant':<20} {'class':<12} {'requests':>8} {'lane_s':>10} "
-           f"{'steps':>10} {'chunks':>8} {'MiB':>8}")
+           f"{'steps':>10} {'saved':>8} {'chunks':>8} {'MiB':>8}")
     print(hdr)
     print("-" * len(hdr))
 
     def row(name, cls, c):
         print(f"{name:<20} {cls:<12} {c['requests']:>8} "
-              f"{c['lane_s']:>10.3f} {c['steps']:>10} {c['chunks']:>8} "
+              f"{c['lane_s']:>10.3f} {c['steps']:>10} "
+              f"{c.get('steps_saved', 0):>8} {c['chunks']:>8} "
               f"{c['bytes_written'] / 2**20:>8.2f}")
 
     for tenant, t in sorted(payload["tenants"].items()):
@@ -1065,7 +1076,12 @@ def cmd_perfcheck(args) -> int:
              (("on_within_2pct_of_off", lambda v: v is True),
               ("bit_identical_depth0", lambda v: v is True),
               ("bit_identical_depth2", lambda v: v is True),
-              ("probe_verification_ok", lambda v: v is True)))):
+              ("probe_verification_ok", lambda v: v is True))),
+            ("serve_steady_lab.json",
+             (("throughput_multiplier", lambda v: (v or 0) >= 1.5),
+              ("steady_bit_identical", lambda v: v is True),
+              ("colane_bit_identical", lambda v: v is True),
+              ("zero_added_transfers", lambda v: v is True)))):
         p = bdir / fname
         if not p.exists():
             check(False, fname, "committed artifact missing")
@@ -1933,6 +1949,14 @@ def cmd_info(_args) -> int:
           f"max-principle tol f32 {_env_tol['float32']:g} / bf16 "
           f"{_env_tol['bfloat16']:g} of envelope scale; overhead gate "
           f"benchmarks/numerics_overhead_lab.json")
+    print(f"semantic scheduling: until=steady requests (request 'until'/"
+          f"'tol' fields) retire at the first chunk boundary whose "
+          f"residual EWMA passes tolerance (exit=steady, steps_done < "
+          f"requested, bit-identical to the truncated fixed-step run); "
+          f"eigenmode ETA predictor (runtime/convergence.py) feeds EDF "
+          f"ordering, wall forecasts and dispatch sizing; savings on "
+          f"/metrics heat_tpu_serve_steps_saved_total and the usage "
+          f"ledger; gate benchmarks/serve_steady_lab.json")
     print(f"prober: off by default (--probe-interval S, needs --listen) "
           f"— sine-eigenmode known-answer canary through the real "
           f"gateway under tenant '_probe', verified against the closed-"

@@ -256,9 +256,11 @@ _REQUEST_KEYS = ("n", "sigma", "nu", "dom_len", "ntime", "ndim", "dtype",
 # Request keys the SCHEDULER owns (never part of the physics config):
 # "id" names the record, "deadline_ms" bounds the request's wall time from
 # submission (overriding the engine-default --serve-deadline), "tenant"
-# names the submitting tenant (fair-share accounting + per-tenant quotas)
-# and "class" picks the SLO class — see serve/scheduler.py + serve/policy.py.
-_SCHEDULER_KEYS = ("id", "deadline_ms", "tenant", "class")
+# names the submitting tenant (fair-share accounting + per-tenant quotas),
+# "class" picks the SLO class, and "until"/"tol" pick the completion
+# semantics (fixed step count vs steady-state early exit) — see
+# serve/scheduler.py + serve/policy.py.
+_SCHEDULER_KEYS = ("id", "deadline_ms", "tenant", "class", "until", "tol")
 
 # SLO classes of the serving front-end, name -> admission priority (lower
 # is more urgent). The class is a *scheduler* field: it shapes admission
@@ -301,6 +303,43 @@ def validate_slo_fields(tenant, slo_class) -> Tuple[str, str]:
             f"class must be one of {sorted(SLO_CLASSES)} (priority order "
             f"{sorted(SLO_CLASSES, key=SLO_CLASSES.get)}), got {slo_class!r}")
     return tenant, slo_class
+
+
+# Completion semantics of a request (semantic scheduling, ISSUE 16):
+# "steps" runs exactly ntime steps (the default, bit-for-bit the historic
+# behavior); "steady" retires the lane at the first chunk boundary whose
+# residual EWMA passes the steady tolerance (per-request "tol", else the
+# engine-wide --steady-tol), with ntime as the hard cap. Defined here
+# because this module is the one validation chokepoint for request
+# payloads — JSONL (serve/api.py) and HTTP (serve/gateway.py) both funnel
+# through validate_until_fields.
+UNTIL_MODES = ("steps", "steady")
+DEFAULT_UNTIL = "steps"
+
+
+def validate_until_fields(until, tol) -> Tuple[str, Optional[float]]:
+    """Validate (and default) a request's until/tol pair.
+
+    ``tol`` is only meaningful with ``until=steady``; supplying it on a
+    fixed-step request is rejected loudly (same loud-typo contract as
+    validate_slo_fields — a tenant who set ``tol`` expected early exit,
+    and silently running all steps would serve different semantics)."""
+    until = DEFAULT_UNTIL if until is None else str(until)
+    if until not in UNTIL_MODES:
+        raise ValueError(
+            f"until must be one of {list(UNTIL_MODES)}, got {until!r}")
+    if tol is not None:
+        if until != "steady":
+            raise ValueError(
+                f"tol is only valid with until='steady', got until={until!r}")
+        try:
+            tol = float(tol)
+        except (TypeError, ValueError):
+            raise ValueError(f"tol must be a positive number, got {tol!r}")
+        if not (tol > 0.0) or not math.isfinite(tol):
+            raise ValueError(f"tol must be a positive finite number, "
+                             f"got {tol!r}")
+    return until, tol
 
 
 def parse_listen(s) -> Tuple[str, int]:

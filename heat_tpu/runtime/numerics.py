@@ -13,10 +13,13 @@ exactly the prof.py split.
 
 Three detectors per lane:
 
-- **steady state** — the residual EWMA sits below ``--steady-tol``
-  while steps remain: the lane is burning chip on an already-converged
-  field. Observability-only (the ROADMAP's early-exit item will act on
-  it); fires ONCE per request, so long converged jobs cannot log-storm.
+- **steady state** — the residual EWMA sits below the request's steady
+  tolerance (per-request ``tol`` override, else ``--steady-tol``) while
+  steps remain: the lane is burning chip on an already-converged field.
+  Fires ONCE per request, so long converged jobs cannot log-storm. For
+  ``until=steady`` requests the scheduler ACTS on this event — the lane
+  retires at its dispatch frontier (semantic scheduling, ISSUE 16);
+  for fixed-step requests it stays observability-only.
 - **discrete maximum principle** — under the CFL bound each FTCS update
   is a convex combination of old values, so request-region values may
   never escape ``[min(IC, bc), max(IC, bc)]`` (LeVeque's classic
@@ -43,7 +46,7 @@ import dataclasses
 import math
 from typing import Dict, List, Optional
 
-from . import debug
+from . import convergence, debug
 
 # Dtype-aware maximum-principle allowance, RELATIVE to the envelope
 # scale: per-step storage rounding can push a convex combination
@@ -83,6 +86,12 @@ class _LaneState:
     last_resid: float = float("nan")
     last_min: float = float("nan")
     last_max: float = float("nan")
+    # semantic scheduling (ISSUE 16): per-request steady tolerance
+    # override (None -> the engine-wide --steady-tol; distinct from
+    # ``tol`` above, which is the ENVELOPE allowance) and the fused
+    # eigenmode/observed decay-rate estimator feeding ETA prediction.
+    steady_tol: Optional[float] = None
+    fuser: Optional[convergence.RateFuser] = None
 
 
 class NumericsObservatory:
@@ -102,16 +111,24 @@ class NumericsObservatory:
         self.violation_total = 0
 
     # --- lifecycle --------------------------------------------------------
-    def admit(self, req_id: str, lo: float, hi: float, dtype: str) -> None:
+    def admit(self, req_id: str, lo: float, hi: float, dtype: str,
+              steady_tol: Optional[float] = None,
+              log_rate: Optional[float] = None) -> None:
         """Arm the detectors for one request: the maximum-principle
         envelope is [min(IC, bc), max(IC, bc)] — computed by the
         scheduler from the host-side T0 it already builds at lane fill,
-        so admission costs zero device work."""
+        so admission costs zero device work. ``steady_tol`` overrides
+        the engine-wide tolerance for this request (client ``tol``);
+        ``log_rate`` is the closed-form eigenmode log decay rate the
+        ETA fuser starts from (``convergence.closed_form_log_rate``)."""
         lo, hi = float(lo), float(hi)
         scale = max(abs(lo), abs(hi), 1.0)
         tol = ENVELOPE_TOL.get(dtype, ENVELOPE_TOL["float32"]) * scale
         with self._lock:
-            self._lanes[req_id] = _LaneState(lo=lo, hi=hi, tol=tol)
+            self._lanes[req_id] = _LaneState(
+                lo=lo, hi=hi, tol=tol,
+                steady_tol=None if steady_tol is None else float(steady_tol),
+                fuser=convergence.RateFuser(log_rate))
 
     def forget(self, req_id: str) -> None:
         """Drop a request's state (terminal record — any status)."""
@@ -135,6 +152,8 @@ class NumericsObservatory:
                 return events
             st.boundaries += 1
             st.last_resid, st.last_min, st.last_max = resid, tmin, tmax
+            if st.fuser is not None:
+                st.fuser.observe(resid, remaining)
             st.resid_ewma = (resid if st.resid_ewma is None else
                              EWMA_ALPHA * resid
                              + (1.0 - EWMA_ALPHA) * st.resid_ewma)
@@ -164,15 +183,37 @@ class NumericsObservatory:
                                  + (1.0 - EWMA_ALPHA) * st.dheat_ewma)
             st.heat = heat
             # steady state: converged but still burning steps (fire once)
+            eff_tol = (self.steady_tol if st.steady_tol is None
+                       else st.steady_tol)
             if (not st.steady_fired and remaining > 0
-                    and st.resid_ewma < self.steady_tol):
+                    and st.resid_ewma < eff_tol):
                 st.steady_fired = True
                 self.steady_total += 1
                 events.append({
                     "kind": "steady", "resid": resid,
                     "resid_ewma": st.resid_ewma,
-                    "steady_tol": self.steady_tol})
+                    "steady_tol": eff_tol})
         return events
+
+    # --- prediction (semantic scheduling, ISSUE 16) -----------------------
+    def _eta_locked(self, st: _LaneState) -> Optional[int]:
+        """Predicted steps until this lane's residual EWMA crosses its
+        effective steady tolerance (fused eigenmode + observed slope);
+        None before the first boundary or when no decay is predicted.
+        Caller holds the numerics lock."""
+        if st.fuser is None or st.resid_ewma is None:
+            return None
+        eff_tol = self.steady_tol if st.steady_tol is None else st.steady_tol
+        return convergence.predict_steps_to_tol(
+            st.resid_ewma, eff_tol, st.fuser.fused_log_rate())
+
+    def eta_steps(self, req_id: str) -> Optional[int]:
+        """Predicted remaining steps to steady for one request, for the
+        scheduler's tail sizing and the gateway's ETA gauges. Takes only
+        the numerics lock (engine -> numerics order preserved)."""
+        with self._lock:
+            st = self._lanes.get(req_id)
+            return None if st is None else self._eta_locked(st)
 
     # --- export surfaces (gateway scrape threads) -------------------------
     def snapshot(self) -> dict:
@@ -187,7 +228,10 @@ class NumericsObservatory:
                       "lo": st.lo, "hi": st.hi,
                       "steady": st.steady_fired,
                       "violated": st.violated,
-                      "boundaries": st.boundaries}
+                      "boundaries": st.boundaries,
+                      "steady_tol": (self.steady_tol if st.steady_tol is None
+                                     else st.steady_tol),
+                      "eta_steps": self._eta_locked(st)}
                 for rid, st in self._lanes.items()}
             return {"steady_tol": self.steady_tol,
                     "steady_total": self.steady_total,

@@ -221,7 +221,10 @@ class CostModel:
         this (bucket, tier): its lane advances one step whenever the
         whole group does, and a group step costs ``lanes *
         s_per_lane_step`` — queue wait excluded (that is the admission
-        policy's number, not the chunk program's)."""
+        policy's number, not the chunk program's). Semantic scheduling
+        passes the PREDICTED step count here instead of the nominal one
+        for ``until=steady`` admissions (scheduler._forecast_wall), so
+        the forecast reflects the steps the request is expected to run."""
         per = self.estimate_s_per_lane_step(bucket, lanes, depth, kernel,
                                             placement)
         return None if per is None else per * lanes * ntime
@@ -413,24 +416,29 @@ class MemWatermark:
 
 # --- (d) per-tenant usage ledger ---------------------------------------------
 
-USAGE_FIELDS = ("lane_s", "steps", "chunks", "bytes_written")
+# "steps" bills the steps a request ACTUALLY ran (below ntime for an
+# until=steady early exit); "steps_saved" credits the steps a steady
+# exit did not run — saved device time billed as saved (ISSUE 16).
+USAGE_FIELDS = ("lane_s", "steps", "chunks", "bytes_written", "steps_saved")
 
 
 def empty_usage() -> dict:
     """The usage stamp every terminal record carries (schema-stable:
     rejected requests carry zeros, not a missing key)."""
-    return {"lane_s": 0.0, "steps": 0, "chunks": 0, "bytes_written": 0}
+    return {"lane_s": 0.0, "steps": 0, "chunks": 0, "bytes_written": 0,
+            "steps_saved": 0}
 
 
 class _LedgerCell:
-    __slots__ = ("lane_s", "steps", "chunks", "bytes_written", "requests",
-                 "by_status", "by_placement")
+    __slots__ = ("lane_s", "steps", "chunks", "bytes_written",
+                 "steps_saved", "requests", "by_status", "by_placement")
 
     def __init__(self):
         self.lane_s = 0.0
         self.steps = 0
         self.chunks = 0
         self.bytes_written = 0
+        self.steps_saved = 0
         self.requests = 0
         self.by_status: collections.Counter = collections.Counter()
         # placement dimension (ISSUE 10): how many of this cell's
@@ -443,6 +451,7 @@ class _LedgerCell:
     def asdict(self) -> dict:
         return {"lane_s": round(self.lane_s, 6), "steps": self.steps,
                 "chunks": self.chunks, "bytes_written": self.bytes_written,
+                "steps_saved": self.steps_saved,
                 "requests": self.requests, "by_status": dict(self.by_status),
                 "by_placement": dict(self.by_placement)}
 
@@ -467,6 +476,7 @@ class UsageLedger:
             cell.steps += int(usage.get("steps") or 0)
             cell.chunks += int(usage.get("chunks") or 0)
             cell.bytes_written += int(usage.get("bytes_written") or 0)
+            cell.steps_saved += int(usage.get("steps_saved") or 0)
             cell.requests += 1
             cell.by_status[status] += 1
             cell.by_placement[placement or "none"] += 1
@@ -482,7 +492,8 @@ class UsageLedger:
         for (tenant, cls), d in sorted(items):
             tdict = tenants.setdefault(
                 tenant, {"classes": {}, "lane_s": 0.0, "steps": 0,
-                         "chunks": 0, "bytes_written": 0, "requests": 0})
+                         "chunks": 0, "bytes_written": 0, "steps_saved": 0,
+                         "requests": 0})
             tdict["classes"][cls] = d
             for f in (*USAGE_FIELDS, "requests"):
                 tdict[f] = (round(tdict[f] + d[f], 6)
@@ -491,6 +502,7 @@ class UsageLedger:
             totals.steps += d["steps"]
             totals.chunks += d["chunks"]
             totals.bytes_written += d["bytes_written"]
+            totals.steps_saved += d["steps_saved"]
             totals.requests += d["requests"]
             totals.by_status.update(d["by_status"])
             totals.by_placement.update(d.get("by_placement") or {})

@@ -34,7 +34,9 @@ Event model (Chrome trace-event format, the subset Perfetto renders):
 - ``X`` complete spans (ts + dur) on a (pid, tid) *track* — lane
   occupancy, chunk in flight, boundary fetch, writer jobs, HTTP handling;
 - ``i`` instants — enqueue, rollback, quarantine, watchdog, growth,
-  numerics verdicts (steady-state, numerics-violation);
+  numerics verdicts (steady-state, numerics-violation), and steady-exit
+  retirements whose args carry ``at_step`` vs ``predicted_at_step`` so
+  predictor misses are triageable in Perfetto;
 - ``C`` counter samples — the numerics observatory's per-lane residual
   and total-heat series, one sample per chunk boundary, rendered by
   Perfetto as stacked counter tracks;
@@ -471,7 +473,8 @@ def summarize(chrome: dict, top: int = 5) -> List[str]:
         e["name"] for e in data if e.get("ph") == "i"
         and e.get("name") in ("watchdog-fired", "rollback", "quarantine",
                               "deadline-shed", "lane-tier-grow",
-                              "numerics-violation", "steady-state"))
+                              "numerics-violation", "steady-state",
+                              "steady-exit"))
     if notable:
         lines.append("events: " + ", ".join(
             f"{n} {k}" for k, n in sorted(notable.items())))

@@ -16,8 +16,13 @@ default ``--serve-deadline``; an over-deadline lane is preempted at its
 next chunk boundary with status ``deadline`` — and under ``--policy edf``
 the deadline also shapes *admission order*); ``tenant`` and ``class``
 (``config.SLO_CLASSES``: interactive | standard | batch) are the SLO
-fields the fair-share/EDF policies and the per-tenant quota key on.
-Everything else defaults to the ``HeatConfig`` defaults. Unknown keys are
+fields the fair-share/EDF policies and the per-tenant quota key on;
+``until`` picks the completion semantics (``steps`` runs exactly
+``ntime`` steps, ``steady`` retires the lane early once its residual
+EWMA passes the steady tolerance — per-request ``tol``, else the engine
+``--steady-tol`` — with ``ntime`` as the hard cap; see
+``config.validate_until_fields``). Everything else defaults to the
+``HeatConfig`` defaults. Unknown keys are
 a per-request rejection (typos must not silently serve different
 physics). The engine pads each request up to the smallest configured
 bucket side and serves same-bucket requests as vmapped lanes under
@@ -39,7 +44,8 @@ import json
 from pathlib import Path
 from typing import List, Optional, Tuple
 
-from ..config import (HeatConfig, config_from_request, validate_slo_fields)
+from ..config import (HeatConfig, config_from_request, validate_slo_fields,
+                      validate_until_fields)
 from .scheduler import Engine, ServeConfig
 
 
@@ -53,6 +59,8 @@ class ParsedRequest:
     deadline_ms: Optional[float] = None
     tenant: Optional[str] = None
     slo_class: Optional[str] = None
+    until: str = "steps"
+    tol: Optional[float] = None
     error: Optional[str] = None
 
 
@@ -77,9 +85,10 @@ def parse_request_obj(d) -> ParsedRequest:
                     f"deadline_ms must be > 0, got {deadline_ms}")
         tenant, slo_class = validate_slo_fields(d.get("tenant"),
                                                 d.get("class"))
+        until, tol = validate_until_fields(d.get("until"), d.get("tol"))
         return ParsedRequest(id=rid, cfg=config_from_request(d),
                              deadline_ms=deadline_ms, tenant=tenant,
-                             slo_class=slo_class)
+                             slo_class=slo_class, until=until, tol=tol)
     except Exception as e:  # noqa: BLE001 — recorded per request
         return ParsedRequest(id=rid, error=f"{type(e).__name__}: {e}")
 
@@ -114,7 +123,7 @@ def submit_parsed(eng: Engine, row: ParsedRequest) -> str:
     and the gateway). ``row.cfg`` must be set."""
     return eng.submit(row.cfg, request_id=row.id,
                       deadline_ms=row.deadline_ms, tenant=row.tenant,
-                      slo_class=row.slo_class)
+                      slo_class=row.slo_class, until=row.until, tol=row.tol)
 
 
 def serve_requests(path, scfg: Optional[ServeConfig] = None,

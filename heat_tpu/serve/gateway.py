@@ -214,6 +214,26 @@ def render_metrics(engine: Engine) -> str:
            "records carry the witnesses).",
            [([], s.get("numerics_violations", 0))])
 
+    # --- semantic scheduling (ISSUE 16) -----------------------------------
+    metric("heat_tpu_serve_steady_exits_total", "counter",
+           "until=steady requests retired early at their dispatch "
+           "frontier (residual EWMA passed tolerance before ntime).",
+           [([], s.get("steady_exits", 0))])
+    metric("heat_tpu_serve_steps_saved_total", "counter",
+           "Device steps NOT run thanks to steady early exits (requested"
+           " minus actual, summed over steady-exited requests).",
+           [([], s.get("steps_saved", 0))])
+    ns = (engine.numerics.snapshot()
+          if engine.numerics is not None else None)
+    metric("heat_tpu_numerics_predicted_eta_steps", "gauge",
+           "Predicted steps until each resident lane's residual EWMA "
+           "crosses its steady tolerance (fused eigenmode + observed "
+           "slope, runtime/convergence.py); absent lanes have no "
+           "prediction yet.",
+           [([("id", rid)], st["eta_steps"])
+            for rid, st in sorted((ns or {}).get("lanes", {}).items())
+            if st.get("eta_steps") is not None] or [([], 0)])
+
     # --- canary prober (serve/probe.py) -----------------------------------
     pr = engine.prober.stats() if engine.prober is not None else None
     metric("heat_tpu_probe_runs_total", "counter",
@@ -319,6 +339,9 @@ def render_metrics(engine: Engine) -> str:
              "Chunk programs participated in, per tenant and class."),
             ("heat_tpu_usage_bytes_written_total", "bytes_written",
              "Result bytes produced, per tenant and class."),
+            ("heat_tpu_usage_steps_saved_total", "steps_saved",
+             "Steps not run thanks to until=steady early exits, per "
+             "tenant and class (saved device time billed as saved)."),
             ("heat_tpu_usage_requests_total", "requests",
              "Terminal requests accounted, per tenant and class.")):
         metric(name, "counter", help_text,
@@ -400,15 +423,19 @@ def render_statusz(engine: Engine) -> str:
         lines.append(
             f"numerics: guard {s.get('numerics_guard', 'warn')}, "
             f"{s.get('steady_lanes', 0)} steady lane(s), "
-            f"{s.get('numerics_violations', 0)} violation(s)")
+            f"{s.get('numerics_violations', 0)} violation(s); semantic "
+            f"scheduling: {s.get('steady_exits', 0)} steady exit(s), "
+            f"{s.get('steps_saved', 0)} step(s) saved")
         ns = engine.numerics.snapshot() if engine.numerics else None
         for rid, ln in sorted((ns or {}).get("lanes", {}).items()):
             if ln["resid_ewma"] is None:
                 continue
+            eta = ln.get("eta_steps")
             lines.append(
                 f"  {rid}: resid ewma {ln['resid_ewma']:.3e}, heat "
                 f"{ln['heat']:.6g}, range [{ln['tmin']:.4g}, "
                 f"{ln['tmax']:.4g}] in [{ln['lo']:g}, {ln['hi']:g}]"
+                f"{f', eta ~{eta} step(s)' if eta is not None else ''}"
                 f"{' STEADY' if ln['steady'] else ''}"
                 f"{' VIOLATED' if ln['violated'] else ''}")
     else:
@@ -477,7 +504,8 @@ def render_statusz(engine: Engine) -> str:
                  key=lambda kv: -kv[1]["lane_s"])[:5]
     for tenant, t in top:
         lines.append(
-            f"  {tenant}: {t['lane_s']:.3f} lane-s, {t['steps']} steps, "
+            f"  {tenant}: {t['lane_s']:.3f} lane-s, {t['steps']} steps "
+            f"({t.get('steps_saved', 0)} saved), "
             f"{t['requests']} request(s), "
             f"{t['bytes_written'] / 2**20:.2f} MiB")
     if engine.tracer.dumps:

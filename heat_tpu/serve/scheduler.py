@@ -119,9 +119,11 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..config import (DEFAULT_SLO_CLASS, DEFAULT_TENANT, LANE_KERNELS,
-                      SLO_TARGETS, HeatConfig, validate_slo_fields)
+                      SLO_TARGETS, HeatConfig, validate_slo_fields,
+                      validate_until_fields)
 from ..grid import ic_envelope, initial_condition
 from ..runtime import async_io, faults
+from ..runtime import convergence as conv_mod
 from ..runtime import debug as debug_mod
 from ..runtime import numerics as numerics_mod
 from ..runtime import prof as prof_mod
@@ -271,9 +273,12 @@ class ServeConfig:
     steady_tol: float = 1e-12  # steady-state detector (--steady-tol): a
                               # lane whose final-mini-step residual EWMA
                               # sits below this while steps remain emits
-                              # ONE steady_state record per request
-                              # (observability-only; the ROADMAP's
-                              # early-exit item will act on it)
+                              # ONE steady_state record per request; for
+                              # until=steady requests (per-request "tol"
+                              # overrides this default) the scheduler
+                              # also ACTS on it — the lane retires at its
+                              # dispatch frontier with exit=steady
+                              # (semantic scheduling, ISSUE 16)
     numerics_guard: str = "warn"  # violation routing (--numerics-guard):
                               # "warn" = structured numerics_violation
                               # record + flight dump only; "quarantine" =
@@ -397,6 +402,21 @@ class Request:
     trace_id: str = ""                  # request-scoped trace/flow id
                                         # (runtime/trace.py), minted at
                                         # submit and echoed in the record
+    until: str = "steps"                # completion semantics (config.
+                                        # UNTIL_MODES): "steps" runs all
+                                        # ntime steps bit-for-bit as
+                                        # before; "steady" retires at the
+                                        # first chunk boundary whose
+                                        # residual EWMA passes tolerance
+    tol: Optional[float] = None         # per-request steady tolerance
+                                        # (until=steady only; None = the
+                                        # engine-wide --steady-tol)
+    predicted_steps: Optional[int] = None  # closed-form eigenmode ETA to
+                                        # steady, minted at submit
+                                        # (runtime/convergence.py): the
+                                        # EDF predicted-finish rank and
+                                        # the trace's predicted-vs-actual
+                                        # retirement boundary
 
 
 def _bucket_for(cfg: HeatConfig, buckets) -> Optional[int]:
@@ -407,9 +427,12 @@ def _bucket_for(cfg: HeatConfig, buckets) -> Optional[int]:
     return None
 
 
-def _write_result(out_dir, req_id: str, T: np.ndarray, cfg: HeatConfig):
+def _write_result(out_dir, req_id: str, T: np.ndarray, cfg: HeatConfig,
+                  steps: Optional[int] = None):
     """Atomic-publish one request's final field (same torn-file discipline
-    as runtime/checkpoint.py: temp name outside any discovery glob)."""
+    as runtime/checkpoint.py: temp name outside any discovery glob).
+    ``steps`` is the step count the field actually carries — below
+    ``cfg.ntime`` for a steady early exit."""
     from pathlib import Path
 
     d = Path(out_dir)
@@ -417,7 +440,8 @@ def _write_result(out_dir, req_id: str, T: np.ndarray, cfg: HeatConfig):
     path = d / f"{req_id}.npz"
     tmp = d / (path.name + ".tmp")
     with open(tmp, "wb") as f:
-        np.savez_compressed(f, T=np.asarray(T), step=cfg.ntime,
+        np.savez_compressed(f, T=np.asarray(T),
+                            step=cfg.ntime if steps is None else int(steps),
                             n=cfg.n, ndim=cfg.ndim, dtype=cfg.dtype)
     tmp.rename(path)
     return path
@@ -478,6 +502,12 @@ class _GroupRunner:
             [] for _ in range(self.lanes)]
         self.rb_left = [0] * self.lanes
         self.last_good: List[Optional[tuple]] = [None] * self.lanes
+        # semantic scheduling (ISSUE 16): remaining-at-detection for a
+        # lane whose until=steady occupant passed tolerance this
+        # boundary; the judge pass consumes it (same process_boundary
+        # call — _ingest_numerics runs first) and retires the lane at
+        # its dispatch frontier
+        self.steady_exit: List[Optional[int]] = [None] * self.lanes
         self.seq = 0                        # next dispatch's sequence id
         self.inflight: collections.deque = collections.deque()
         self.idle_from: Optional[float] = None  # group device queue empty
@@ -568,15 +598,42 @@ class _GroupRunner:
                     outer._has_lane_faults = True  # gates _maybe_poison
                 self.rb_left[lane] = _MAX_LANE_ROLLBACKS
                 self.last_good[lane] = None
+                self.steady_exit[lane] = None   # never inherit a prior
+                                                # occupant's verdict
                 if outer.numerics is not None:
                     # arm the detectors: the analytic IC/BC envelope (zero
-                    # device work, zero host scans — grid.ic_envelope)
+                    # device work, zero host scans — grid.ic_envelope),
+                    # plus the request's steady tolerance and the closed-
+                    # form eigenmode rate seeding the ETA fuser
                     lo, hi = ic_envelope(req.cfg)
-                    outer.numerics.admit(req.id, lo, hi, req.cfg.dtype)
+                    outer.numerics.admit(
+                        req.id, lo, hi, req.cfg.dtype, steady_tol=req.tol,
+                        log_rate=conv_mod.closed_form_log_rate(req.cfg))
 
     def _live_remaining(self) -> List[int]:
         return [int(self.dev_rem[i]) for i, o in enumerate(self.occupant)
                 if o is not None and self.dev_rem[i] > 0]
+
+    def _effective_remaining(self) -> List[int]:
+        """Per-live-lane remaining WORK for tail sizing: the countdown
+        mirror, tightened for ``until=steady`` occupants by the fused
+        eigenmode/observed ETA (runtime/convergence.py via the numerics
+        observatory). Prediction only moves the full-chunk -> tail-
+        program switch earlier — same two compiled chunk sizes — and
+        never changes results: a mispredicted lane just keeps taking
+        tail chunks until its actual exit."""
+        numerics = self.outer.numerics
+        out = []
+        for i, req in enumerate(self.occupant):
+            rem = int(self.dev_rem[i])
+            if req is None or rem <= 0:
+                continue
+            if req.until == "steady" and numerics is not None:
+                eta = numerics.eta_steps(req.id)
+                if eta is not None:
+                    rem = min(rem, max(int(eta), 1))
+            out.append(rem)
+        return out
 
     # --- dispatch side ----------------------------------------------------
     def _maybe_poison(self) -> None:
@@ -618,10 +675,12 @@ class _GroupRunner:
                 self._maybe_poison()
             k = self.chunk
             tail = self.eng.tail
-            if tail is not None and max(live) <= self.chunk - tail:
-                # every live lane finishes inside the chunk, with enough
-                # headroom that ceil(rem/tail) tail programs compute
-                # strictly fewer masked steps than one full chunk
+            if (tail is not None
+                    and max(self._effective_remaining()) <= self.chunk - tail):
+                # every live lane finishes (or is PREDICTED to steady-
+                # exit) inside the chunk, with enough headroom that
+                # ceil(rem/tail) tail programs compute strictly fewer
+                # masked steps than one full chunk
                 k = tail
                 self.outer.tail_chunks += 1
             t_disp = wall_clock()
@@ -702,15 +761,49 @@ class _GroupRunner:
                 continue
             if finite is not None and not finite[lane]:
                 self._handle_nonfinite(lane, req, int(rem[lane]), snap)
-            elif rem[lane] == 0:
-                self._trace_occupancy(lane, req, "retired")
+            elif rem[lane] == 0 or self.steady_exit[lane] is not None:
+                steady_at = self.steady_exit[lane]
+                self.steady_exit[lane] = None
                 chunks = int(self.lane_chunks[lane])
+                steps_done = req.cfg.ntime
+                exit_mode = "steps"
+                if steady_at is not None:
+                    # steady exit retires at the dispatch FRONTIER: the
+                    # chunks already in flight keep executing (the
+                    # countdown mirror is untouched — the desync check
+                    # stays exact) and the retirement snapshot is
+                    # enqueued behind them, so the delivered field has
+                    # exactly ntime - dev_rem steps — bit-identical to a
+                    # fixed-step run truncated there, with zero new
+                    # transfers. At depth 0 the frontier IS the
+                    # detection boundary. A pipeline that already
+                    # dispatched every step simply retires normally.
+                    steps_done = req.cfg.ntime - int(self.dev_rem[lane])
+                    if steps_done < req.cfg.ntime:
+                        exit_mode = "steady"
+                        outer.steady_exits += 1
+                        outer.steps_saved_total += (req.cfg.ntime
+                                                    - steps_done)
+                        if self.tracer.enabled:
+                            self.tracer.instant(
+                                "steady-exit", self.lane_tracks[lane],
+                                trace_id=req.trace_id,
+                                args={"id": req.id, "at_step": steps_done,
+                                      "requested": req.cfg.ntime,
+                                      "saved": req.cfg.ntime - steps_done,
+                                      "predicted_at_step":
+                                          req.predicted_steps})
+                self._trace_occupancy(lane, req, "retired")
                 if sync:
                     outer._finish_sync(self.eng, lane, req, self.writer,
-                                       chunks=chunks)
+                                       chunks=chunks,
+                                       steps_done=steps_done,
+                                       exit_mode=exit_mode)
                 else:
                     outer._finish_async(self.eng, lane, req, self.writer,
-                                        chunks=chunks)
+                                        chunks=chunks,
+                                        steps_done=steps_done,
+                                        exit_mode=exit_mode)
                 self.occupant[lane] = None
             elif req.deadline_t is not None and now > req.deadline_t:
                 done = req.cfg.ntime - int(rem[lane])
@@ -948,6 +1041,7 @@ class _GroupRunner:
         old_eng, old_occ = self.eng, self.occupant
         old_rem, old_nan, old_rb = self.dev_rem, self.nan_pending, self.rb_left
         old_chunks, old_pert = self.lane_chunks, self.perturb_pending
+        old_steady = self.steady_exit
         if self.tracer.enabled:
             self.tracer.instant("lane-tier-grow", self.group_track,
                                 args={"from": self.lanes, "to": want})
@@ -969,6 +1063,7 @@ class _GroupRunner:
         self.perturb_pending = [[] for _ in range(want)]
         self.rb_left = [0] * want
         self.last_good = [None] * want
+        self.steady_exit = [None] * want
         self.lane_tracks = [self.tracer.track(self.track_name, f"lane {i}")
                             for i in range(want)]
         for lane, req in enumerate(old_occ):
@@ -983,6 +1078,7 @@ class _GroupRunner:
             self.nan_pending[lane] = old_nan[lane]
             self.perturb_pending[lane] = old_pert[lane]
             self.rb_left[lane] = old_rb[lane]
+            self.steady_exit[lane] = old_steady[lane]
             # the old tier's stack snapshots have the old lane count: drop
             # them; a post-growth rollback re-steps from the IC instead
         outer.lane_grows += 1
@@ -1100,6 +1196,7 @@ class MegaLaneRunner:
         self.perturb_pending: List[List[tuple]] = [[]]
         self.rb_left = [0]
         self.last_good: List[Optional[tuple]] = [None]
+        self.steady_exit: List[Optional[int]] = [None]
         self.seq = 0
         self.inflight: collections.deque = collections.deque()
         self.idle_from: Optional[float] = None
@@ -1178,9 +1275,12 @@ class MegaLaneRunner:
                 outer._has_lane_faults = True
             self.rb_left[0] = _MAX_LANE_ROLLBACKS
             self.last_good[0] = None
+            self.steady_exit[0] = None
             if outer.numerics is not None:
                 lo, hi = ic_envelope(req.cfg)
-                outer.numerics.admit(req.id, lo, hi, req.cfg.dtype)
+                outer.numerics.admit(
+                    req.id, lo, hi, req.cfg.dtype, steady_tol=req.tol,
+                    log_rate=conv_mod.closed_form_log_rate(req.cfg))
 
     def maybe_grow(self) -> None:
         """Interface parity with ``_GroupRunner``: nothing to grow."""
@@ -1282,7 +1382,7 @@ class MegaLaneRunner:
             return
         if finite is not None and not finite[0]:
             self._handle_nonfinite(req, int(rem[0]), snap)
-        elif rem[0] == 0:
+        elif rem[0] == 0 or self.steady_exit[0] is not None:
             self._retire(req, sync)
         elif req.deadline_t is not None and now > req.deadline_t:
             done = req.cfg.ntime - int(rem[0])
@@ -1308,6 +1408,7 @@ class MegaLaneRunner:
         self.nan_pending[0] = []
         self.perturb_pending[0] = []
         self.last_good[0] = None
+        self.steady_exit[0] = None
         self.epoch[0] = self.seq
 
     def _handle_nonfinite(self, req: Request, rem_at: int, snap) -> None:
@@ -1417,8 +1518,33 @@ class MegaLaneRunner:
         The writeback closure holds only the cropped snapshot, so the
         padded mesh state is freed with the slot."""
         outer = self.outer
+        steady_at = self.steady_exit[0]
+        self.steady_exit[0] = None
+        steps_done = req.cfg.ntime
+        exit_mode = "steps"
+        if steady_at is not None:
+            # dispatch-frontier retirement, the _judge_lanes contract:
+            # in-flight mega chunks still execute (countdown untouched),
+            # final_snapshot() crops the state behind them — exactly
+            # ntime - dev_rem steps, zero new programs (the AOT chunk
+            # sizes never change) and zero new transfers
+            steps_done = req.cfg.ntime - int(self.dev_rem[0])
+            if steps_done < req.cfg.ntime:
+                exit_mode = "steady"
+                outer.steady_exits += 1
+                outer.steps_saved_total += req.cfg.ntime - steps_done
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "steady-exit", self.lane_tracks[0],
+                        trace_id=req.trace_id,
+                        args={"id": req.id, "at_step": steps_done,
+                              "requested": req.cfg.ntime,
+                              "saved": req.cfg.ntime - steps_done,
+                              "predicted_at_step": req.predicted_steps})
         self._trace_occupancy(0, req, "retired")
-        rec = outer._finish_timing(req, chunks=int(self.lane_chunks[0]))
+        rec = outer._finish_timing(req, chunks=int(self.lane_chunks[0]),
+                                   steps_done=steps_done,
+                                   exit_mode=exit_mode)
         snap = self.eng.final_snapshot()
         if sync:
             T = MegaLaneEngine.extract(snap)
@@ -1636,6 +1762,12 @@ class Engine:
         self.lanes_quarantined = 0   # requests failed nonfinite
         self.rollbacks = 0           # per-lane restore-and-re-step events
         self.deadline_misses = 0     # requests preempted/shed past deadline
+        # semantic scheduling (ISSUE 16): until=steady early retirements
+        # and the device steps they did NOT run (the effective-throughput
+        # multiplier the steady lab gates; /metrics + usage ledger bill
+        # saved work as saved)
+        self.steady_exits = 0
+        self.steps_saved_total = 0
         self.shed = 0                # submits rejected by --max-queue
         self.watchdog_fired = 0      # boundary-fetch watchdog timeouts
         # engine-scoped fault plan (scfg.inject / HEAT_TPU_FAULTS); None on
@@ -1751,24 +1883,40 @@ class Engine:
     def submit(self, cfg: HeatConfig, request_id: Optional[str] = None,
                deadline_ms: Optional[float] = None,
                tenant: Optional[str] = None,
-               slo_class: Optional[str] = None) -> str:
+               slo_class: Optional[str] = None,
+               until: Optional[str] = None,
+               tol: Optional[float] = None) -> str:
         """Admit one request; returns its id. Unservable requests become
         status='rejected' records instead of raising (see module doc).
         ``deadline_ms`` (request JSONL field of the same name) bounds the
         request's wall time from submission; it overrides the engine
         default ``ServeConfig.deadline_ms``. ``tenant``/``slo_class``
         (JSONL/HTTP fields ``tenant``/``class``) drive the fair-share and
-        EDF admission policies; malformed values raise (the JSONL/HTTP
-        front doors pre-validate them into per-request rejections).
+        EDF admission policies; ``until``/``tol`` pick the completion
+        semantics (``until="steady"`` retires the lane once its residual
+        EWMA passes ``tol`` — default the engine ``--steady-tol`` — with
+        ``ntime`` as the hard cap); malformed values raise (the
+        JSONL/HTTP front doors pre-validate them into per-request
+        rejections).
 
         Thread-safe: the gateway's HTTP handler threads call this while
         the online scheduler thread is mid-drain — shared state mutates
         under the engine lock and the scheduler is woken per submit."""
         tenant, slo_class = validate_slo_fields(tenant, slo_class)
+        until, tol = validate_until_fields(until, tol)
         if deadline_ms is not None and deadline_ms <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
         deadline_ms = (deadline_ms if deadline_ms is not None
                        else self.scfg.deadline_ms)
+        # predictive layer (runtime/convergence.py): an until=steady
+        # request gets a closed-form eigenmode ETA at admission — zero
+        # observations needed — feeding EDF predicted-finish ordering,
+        # the fair-share work estimate, and the predicted-vs-actual
+        # retirement instant on the trace
+        predicted = None
+        if until == "steady":
+            eff_tol = tol if tol is not None else self.scfg.steady_tol
+            predicted = conv_mod.predict_admission_steps(cfg, eff_tol)
         shed_reason = None
         with self._lock:
             seq = self._seq
@@ -1784,6 +1932,8 @@ class Engine:
                    "bucket": None, "lane": None, "queue_wait_s": None,
                    "solve_s": None, "steps_per_s": None, "error": None,
                    "deadline_ms": deadline_ms, "trace_id": trace_id,
+                   "until": until, "steps_done": None, "exit": None,
+                   "predicted_steps": predicted, "predicted_wall_s": None,
                    "_submit_t": wall_clock()}
             self._records.append(rec)
             self._by_id[rid] = rec
@@ -1813,6 +1963,8 @@ class Engine:
             placement = "mega"
         else:
             key = BucketKey(ndim=cfg.ndim, n=b, dtype=cfg.dtype, bc=cfg.bc)
+        if predicted is not None and self.prof.enabled:
+            rec["predicted_wall_s"] = self._forecast_wall(cfg, b, predicted)
         with self._cond:
             queued = (sum(len(q) for q in self._queues.values())
                       + (len(self._mega_queue) if self._mega_queue else 0))
@@ -1849,7 +2001,8 @@ class Engine:
                     deadline_t=(submit_t + deadline_ms / 1e3
                                 if deadline_ms is not None else None),
                     tenant=tenant, slo_class=slo_class, seq=seq,
-                    trace_id=trace_id)
+                    trace_id=trace_id, until=until, tol=tol,
+                    predicted_steps=predicted)
                 q.push(req)
                 if self.tracer.enabled:
                     policy_mod.note_enqueue(self.tracer, self.scfg.policy,
@@ -1860,6 +2013,28 @@ class Engine:
         if shed_reason is not None:
             self._reject(rec, shed_reason)
         return rid
+
+    def _forecast_wall(self, cfg: HeatConfig, b: Optional[int],
+                       steps: int) -> Optional[float]:
+        """Cost-model wall forecast for an ``until=steady`` admission,
+        keyed on PREDICTED rather than nominal steps (runtime/prof.py).
+        Best effort by design: None until the model has observed this
+        geometry, and the lane tier is assumed saturated at ``--lanes``
+        (the steady state of a loaded server)."""
+        d = self.scfg.dispatch_depth
+        depth = max(1, d) if d > 0 else 0
+        if b is None:
+            est = self.prof.cost.estimate_request_s(
+                f"{cfg.ndim}d/n{cfg.n}/{cfg.dtype}/{cfg.bc}", 1, depth,
+                steps, kernel="sharded", placement="mega")
+            return None if est is None else round(est, 6)
+        bucket = f"{cfg.ndim}d/n{b}/{cfg.dtype}/{cfg.bc}"
+        for kernel in ("pallas", "xla"):
+            est = self.prof.cost.estimate_request_s(
+                bucket, self.scfg.lanes, depth, steps, kernel=kernel)
+            if est is not None:
+                return round(est, 6)
+        return None
 
     def _lane_nan_steps(self, req: Request) -> List[int]:
         """Poison thresholds for one admitted request: the union of its
@@ -1905,6 +2080,13 @@ class Engine:
                                     runner.lane_tracks[lane],
                                     trace_id=req.trace_id,
                                     args={"id": req.id, "at_step": done})
+            if req.until == "steady":
+                # semantic scheduling (ISSUE 16): ACT on the detector —
+                # flag the lane for frontier retirement; the judge pass
+                # of this same process_boundary call consumes the flag
+                # (_ingest_numerics runs first, same epoch guard), and
+                # _fill backfills the freed lane immediately after
+                runner.steady_exit[lane] = rem_at
             return
         why = ev["why"]
         master_print(
@@ -1968,9 +2150,10 @@ class Engine:
                 rec["lane"] = lane
             rec["status"] = status
             rec["error"] = reason
+            rec["steps_done"] = int(steps_done)
             rec["usage"] = {"lane_s": rec["solve_s"] or 0.0,
                             "steps": int(steps_done), "chunks": int(chunks),
-                            "bytes_written": 0}
+                            "bytes_written": 0, "steps_saved": 0}
         if self.numerics is not None:
             self.numerics.forget(req.id)   # terminal: drop detector state
         self._emit(rec)
@@ -2471,20 +2654,28 @@ class Engine:
                     self._cond.notify_all()  # unblock wait() callers
 
     # --- lane retirement --------------------------------------------------
-    def _finish_timing(self, req: Request, chunks: int = 0) -> dict:
+    def _finish_timing(self, req: Request, chunks: int = 0,
+                       steps_done: Optional[int] = None,
+                       exit_mode: str = "steps") -> dict:
+        steps = int(req.cfg.ntime if steps_done is None else steps_done)
         rec = self._by_id[req.id]
         now = wall_clock()
         with self._lock:
             start = rec.pop("_start_t", now)
             rec["solve_s"] = round(now - start, 6)
-            rec["steps_per_s"] = (round(req.cfg.ntime / (now - start), 3)
+            rec["steps_per_s"] = (round(steps / (now - start), 3)
                                   if now > start else None)
+            rec["steps_done"] = steps
+            rec["exit"] = exit_mode
             # the usage-ledger stamp (runtime/prof.py): what THIS request
             # consumed — bytes_written is finalized by the writer thread
-            # once the publish lands, before the record is emitted
+            # once the publish lands, before the record is emitted.
+            # Semantic scheduling bills ACTUAL steps; the steps a steady
+            # exit did not run are credited as steps_saved.
             rec["usage"] = {"lane_s": rec["solve_s"],
-                            "steps": int(req.cfg.ntime),
-                            "chunks": int(chunks), "bytes_written": 0}
+                            "steps": steps,
+                            "chunks": int(chunks), "bytes_written": 0,
+                            "steps_saved": int(req.cfg.ntime) - steps}
         if self.numerics is not None:
             self.numerics.forget(req.id)   # terminal: drop detector state
         return rec
@@ -2498,6 +2689,9 @@ class Engine:
         fallback passes a host array already fetched."""
         cfg, scfg = req.cfg, self.scfg
         attempts = {"n": 0}
+        # captured before the job runs: _finish_timing already stamped the
+        # actual step count (ntime, or the steady-exit frontier)
+        steps_done = rec.get("steps_done")
 
         def job():
             # Runs in the writer thread. Transient sink errors are
@@ -2511,7 +2705,8 @@ class Engine:
                 plan = faults.plan_for(cfg)
                 if plan is not None:
                     plan.sink_fault(cfg.ntime)
-                path = (str(_write_result(scfg.out_dir, req.id, T, cfg))
+                path = (str(_write_result(scfg.out_dir, req.id, T, cfg,
+                                          steps=steps_done))
                         if scfg.out_dir else None)
                 # bytes the tenant's result cost: the published file's
                 # size, or the in-memory field bytes when nothing hits
@@ -2542,20 +2737,26 @@ class Engine:
         writer.submit(job)
 
     def _finish_async(self, eng: LaneEngine, lane: int, req: Request,
-                      writer, chunks: int = 0) -> None:
+                      writer, chunks: int = 0,
+                      steps_done: Optional[int] = None,
+                      exit_mode: str = "steps") -> None:
         """Dispatch-ahead retirement: take a one-lane ON-DEVICE snapshot
         (enqueued behind the in-flight chunks; the scheduler thread never
         blocks) and move the D2H + writeback wholly into the writer."""
-        rec = self._finish_timing(req, chunks=chunks)
+        rec = self._finish_timing(req, chunks=chunks, steps_done=steps_done,
+                                  exit_mode=exit_mode)
         snap = eng.snapshot_lane(lane)
         n = req.cfg.n
         self._writeback_job(rec, req, writer, lambda: eng.extract(snap, n))
 
     def _finish_sync(self, eng: LaneEngine, lane: int, req: Request,
-                     writer, chunks: int = 0) -> None:
+                     writer, chunks: int = 0,
+                     steps_done: Optional[int] = None,
+                     exit_mode: str = "steps") -> None:
         """Sync-fallback retirement: fetch the lane on the scheduler
         thread (fences every chunk in flight), write back in the writer."""
-        rec = self._finish_timing(req, chunks=chunks)
+        rec = self._finish_timing(req, chunks=chunks, steps_done=steps_done,
+                                  exit_mode=exit_mode)
         T = eng.extract_lane(lane, req.cfg.n)
         self._writeback_job(rec, req, writer, lambda: T)
 
@@ -2606,5 +2807,7 @@ class Engine:
                 "lanes_quarantined": self.lanes_quarantined,
                 "rollbacks": self.rollbacks,
                 "deadline_misses": self.deadline_misses,
+                "steady_exits": self.steady_exits,
+                "steps_saved": self.steps_saved_total,
                 "shed": self.shed,
                 "watchdog_fired": self.watchdog_fired}

@@ -1,0 +1,493 @@
+"""Semantic scheduling (ISSUE 16): ``until=steady`` early exit, the
+eigenmode ETA predictor, and convergence-aware dispatch.
+
+The load-bearing contracts:
+
+- an ``until=steady`` request retires at the first chunk boundary whose
+  residual EWMA passes tolerance, and its record is BIT-IDENTICAL to a
+  fixed-step run truncated at that boundary — at dispatch depths 0 and
+  2, packed (xla + pallas) and mega placements. The exit is a
+  *scheduling* decision: the device program never changes;
+- the predictor (runtime/convergence.py) fuses the closed-form
+  eigenmode decay rate with the observed residual slope, and its
+  admission-time ETA shapes EDF order and fair-share billing without
+  perturbing any pre-existing ordering;
+- ``until``/``tol`` validate loudly everywhere (config, JSONL/HTTP
+  front doors, Engine.submit, CLI);
+- savings are accounted end to end: per-record usage, the tenant
+  ledger, Engine.summary(), /metrics, /statusz, and the trace all
+  reconcile.
+"""
+
+import json
+import math
+import time
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig, validate_until_fields
+from heat_tpu import grid
+from heat_tpu.runtime import convergence, faults
+from heat_tpu.runtime import trace as trace_mod
+from heat_tpu.runtime.prof import UsageLedger
+from heat_tpu.serve import Engine, ServeConfig
+from heat_tpu.serve import api as api_mod
+from heat_tpu.serve import policy as policy_mod
+from heat_tpu.serve import scheduler as sched_mod
+from heat_tpu.serve.gateway import render_metrics, render_statusz
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def quiet(**kw) -> ServeConfig:
+    kw.setdefault("emit_records", False)
+    return ServeConfig(**kw)
+
+
+# sine is THE eigenmode IC: its residual decays exactly as lambda**s
+# (grid.sine_decay_factor), so tol=2e-3 on the n=12 grid crosses near
+# step ~80 — far inside the requested 160 steps
+STEADY_CFG = HeatConfig(n=12, ntime=160, dtype="float64", bc="edges",
+                        ic="sine")
+STEADY_TOL = 2e-3
+CO_CFG = HeatConfig(n=12, ntime=40, dtype="float64", bc="edges", ic="hat")
+
+
+# --- packed bit-identity ------------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_steady_bit_identical_to_truncated_fixed_step(tmp_path, depth):
+    """Acceptance: the early-exit record equals the fixed-step run of
+    ``ntime=steps_done`` bit for bit (in-memory field AND npz payload),
+    while a fixed-step co-lane stays untouched — at depths 0 and 2."""
+    out = tmp_path / f"steady{depth}"
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(16,),
+                       dispatch_depth=depth, out_dir=str(out),
+                       keep_fields=True))
+    sid = eng.submit(STEADY_CFG, until="steady", tol=STEADY_TOL)
+    cid = eng.submit(CO_CFG)
+    recs = {r["id"]: r for r in eng.results()}
+
+    rec = recs[sid]
+    assert rec["status"] == "ok"
+    assert rec["until"] == "steady" and rec["exit"] == "steady"
+    assert 0 < rec["steps_done"] < STEADY_CFG.ntime
+    trunc = STEADY_CFG.with_(ntime=int(rec["steps_done"]))
+    solo = solve(trunc).T
+    np.testing.assert_array_equal(rec["T"], solo)
+    with np.load(out / f"{sid}.npz") as z:
+        assert z["T"].tobytes() == solo.tobytes()
+        assert int(z["step"]) == rec["steps_done"]
+
+    co = recs[cid]
+    assert co["exit"] == "steps" and co["steps_done"] == CO_CFG.ntime
+    np.testing.assert_array_equal(co["T"], solve(CO_CFG).T)
+
+    s = eng.summary()
+    assert s["steady_exits"] == 1
+    assert s["steps_saved"] == STEADY_CFG.ntime - rec["steps_done"]
+    assert rec["usage"]["steps_saved"] == s["steps_saved"]
+    assert co["usage"]["steps_saved"] == 0
+    # the admission-time eigenmode ETA brackets the actual retirement
+    pred = rec["predicted_steps"]
+    assert pred is not None and 0 < pred <= STEADY_CFG.ntime
+    assert 0.5 <= pred / rec["steps_done"] <= 1.5
+
+
+def test_steady_unreachable_tol_runs_all_steps():
+    """A tolerance the decay never reaches inside ntime falls back to
+    the fixed-step semantics: exit='steps', zero savings, bit-identical
+    to the plain run (ntime is the hard cap, never exceeded)."""
+    cfg = STEADY_CFG.with_(ntime=48)
+    eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,)))
+    rid = eng.submit(cfg, until="steady", tol=1e-14)
+    recs = {r["id"]: r for r in eng.results()}
+    rec = recs[rid]
+    assert rec["status"] == "ok"
+    assert rec["exit"] == "steps" and rec["steps_done"] == cfg.ntime
+    assert rec["usage"]["steps_saved"] == 0
+    np.testing.assert_array_equal(rec["T"], solve(cfg).T)
+    assert eng.summary()["steady_exits"] == 0
+
+
+def test_steady_pallas_lane_kernel_matches_xla():
+    """The steady decision rides the boundary vector, not the chunk
+    body: pallas and xla lane kernels retire at the same boundary with
+    byte-identical fields (f32 — the lane-kernel dtype)."""
+    cfg = STEADY_CFG.with_(dtype="float32")
+    outs = {}
+    for kernel in ("xla", "pallas"):
+        eng = Engine(quiet(lanes=2, chunk=4, buckets=(12,),
+                           lane_kernel=kernel))
+        rid = eng.submit(cfg, until="steady", tol=STEADY_TOL)
+        recs = {r["id"]: r for r in eng.results()}
+        assert eng.lane_kernel_fallbacks == 0
+        assert recs[rid]["exit"] == "steady"
+        outs[kernel] = recs[rid]
+    assert outs["xla"]["steps_done"] == outs["pallas"]["steps_done"]
+    assert (np.asarray(outs["xla"]["T"]).tobytes()
+            == np.asarray(outs["pallas"]["T"]).tobytes())
+    trunc = cfg.with_(ntime=int(outs["xla"]["steps_done"]))
+    np.testing.assert_array_equal(outs["xla"]["T"], solve(trunc).T)
+
+
+# --- mega bit-identity --------------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_steady_mega_lane_bit_identical(depth):
+    """The mega tier honors until=steady too: the mesh-spanning lane
+    retires at its frontier and matches the solo sharded drive of the
+    truncated config (8-virtual-device harness, tests/conftest.py)."""
+    cfg = HeatConfig(n=16, ntime=120, dtype="float64", bc="edges",
+                     ic="sine")
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(8,),
+                       dispatch_depth=depth, keep_fields=True))
+    rid = eng.submit(cfg, until="steady", tol=5e-3)
+    recs = {r["id"]: r for r in eng.results()}
+    rec = recs[rid]
+    assert rec["status"] == "ok" and rec["placement"] == "mega"
+    assert rec["exit"] == "steady"
+    assert 0 < rec["steps_done"] < cfg.ntime
+    trunc = cfg.with_(ntime=int(rec["steps_done"]))
+    np.testing.assert_array_equal(
+        rec["T"], solve(trunc.with_(backend="sharded")).T)
+    s = eng.summary()
+    assert s["steady_exits"] == 1
+    assert s["steps_saved"] == cfg.ntime - rec["steps_done"]
+
+
+# --- predictor (runtime/convergence.py) ---------------------------------------
+
+
+def test_closed_form_rate_matches_sine_decay_factor():
+    cfg = HeatConfig(n=24, ntime=10, dtype="float64", ic="sine")
+    lam = grid.sine_decay_factor(cfg)
+    assert 0.0 < lam < 1.0
+    assert convergence.closed_form_log_rate(cfg) == pytest.approx(
+        math.log(lam))
+
+
+def test_rate_fuser_recovers_exact_geometric_decay():
+    """Fed an exact lambda**s residual sequence (variable chunk sizes —
+    the observed-step delta comes from `remaining`, not an assumed
+    chunk), the fused rate converges to log(lambda) and the prediction
+    lands within one step of the analytic crossing."""
+    lam = 0.99
+    fuser = convergence.RateFuser(None)   # no closed form: observed only
+    resid, remaining = 1e-2, 400
+    for k in (8, 8, 4, 8, 12, 8):         # tail/variable chunks
+        fuser.observe(resid, remaining)
+        remaining -= k
+        resid *= lam ** k
+    assert fuser.fused_log_rate() == pytest.approx(math.log(lam),
+                                                   rel=1e-9)
+    tol = 1e-4
+    pred = convergence.predict_steps_to_tol(resid, tol,
+                                            fuser.fused_log_rate())
+    exact = math.ceil(math.log(tol / resid) / math.log(lam))
+    assert abs(pred - exact) <= 1
+
+
+def test_predict_steps_to_tol_edge_cases():
+    log_rate = math.log(0.99)
+    assert convergence.predict_steps_to_tol(1e-5, 1e-4, log_rate) == 0
+    assert convergence.predict_steps_to_tol(1e-2, 1e-4, None) is None
+    # a non-decaying (growing) rate can never promise steady
+    assert convergence.predict_steps_to_tol(1e-2, 1e-4,
+                                            math.log(1.5)) is None
+
+
+def test_admission_prediction_clamped_to_request():
+    """The admission ETA is capped by ntime (never promises more work
+    than requested) and floors at 0."""
+    pred = convergence.predict_admission_steps(STEADY_CFG, STEADY_TOL)
+    assert pred is not None and 0 < pred <= STEADY_CFG.ntime
+    tiny = convergence.predict_admission_steps(
+        STEADY_CFG.with_(ntime=8), STEADY_TOL)
+    assert tiny == 8
+
+
+# --- convergence-aware admission ordering -------------------------------------
+
+
+def _req(seq, ntime=100, until="steps", predicted=None,
+         slo_class="standard", deadline_t=None):
+    return sched_mod.Request(
+        id=f"r{seq}", cfg=HeatConfig(n=12, ntime=ntime, dtype="float32"),
+        submit_t=0.0, seq=seq, slo_class=slo_class, deadline_t=deadline_t,
+        until=until, predicted_steps=predicted)
+
+
+def test_edf_orders_on_predicted_finish():
+    """Among undated same-class peers, EDF runs the steady request with
+    the earliest PREDICTED finish first; deadlines and classes still
+    dominate, and unpredicted requests keep exact FIFO order (the
+    pre-ISSUE-16 orderings are preserved bit for bit)."""
+    q = policy_mod.EdfQueue()
+    reqs = [
+        _req(0),                                        # fixed, undated
+        _req(1, until="steady", predicted=40),
+        _req(2, until="steady", predicted=10),
+        _req(3, deadline_t=5.0),                        # dated: first
+        _req(4, until="steady", predicted=None),        # cold predictor
+        _req(5, slo_class="interactive"),               # class trumps all
+    ]
+    for r in reqs:
+        q.push(r)
+    order = [q.pop().id for _ in range(len(reqs))]
+    assert order == ["r5", "r3", "r2", "r1", "r0", "r4"]
+
+
+def test_edf_degrades_to_fifo_without_predictions():
+    q = policy_mod.EdfQueue()
+    for r in (_req(0), _req(1), _req(2)):
+        q.push(r)
+    assert [q.pop().id for _ in range(3)] == ["r0", "r1", "r2"]
+
+
+def test_fair_share_bills_predicted_work():
+    """Fair share charges an until=steady tenant its PREDICTED steps —
+    a tenant of early-exiting requests gets proportionally more
+    admissions than one of equal-nominal fixed-step requests."""
+    q = policy_mod.FairShareQueue()
+    s1, s2 = (_req(0, until="steady", predicted=10),
+              _req(1, until="steady", predicted=10))
+    f1, f2 = _req(2), _req(3)
+    for r in (s1, s2):
+        r.tenant = "steady-co"
+    for r in (f1, f2):
+        r.tenant = "fixed-co"
+    for r in (s1, s2, f1, f2):
+        q.push(r)
+    # vtime tie -> tenant name; then the cheap (predicted 10 of 100)
+    # steady tenant stays below the fixed tenant's virtual time
+    order = [q.pop().id for _ in range(4)]
+    assert order == ["r2", "r0", "r1", "r3"]
+
+
+# --- until/tol validation -----------------------------------------------------
+
+
+def test_validate_until_fields_contract():
+    assert validate_until_fields(None, None) == ("steps", None)
+    assert validate_until_fields("steps", None) == ("steps", None)
+    assert validate_until_fields("steady", None) == ("steady", None)
+    assert validate_until_fields("steady", 1e-3) == ("steady", 1e-3)
+    assert validate_until_fields("steady", "1e-3") == ("steady", 1e-3)
+    with pytest.raises(ValueError, match="until"):
+        validate_until_fields("forever", None)
+    # the loud-typo contract: tol without steady is a rejection
+    with pytest.raises(ValueError, match="tol"):
+        validate_until_fields(None, 1e-3)
+    with pytest.raises(ValueError, match="tol"):
+        validate_until_fields("steps", 1e-3)
+    for bad in (0.0, -1.0, float("nan"), float("inf"), "tight"):
+        with pytest.raises(ValueError, match="tol"):
+            validate_until_fields("steady", bad)
+
+
+def test_parse_request_obj_until_rows():
+    row = api_mod.parse_request_obj(
+        {"n": 12, "ntime": 8, "until": "steady", "tol": 1e-3})
+    assert row.error is None
+    assert row.until == "steady" and row.tol == 1e-3
+    row = api_mod.parse_request_obj({"n": 12, "ntime": 8})
+    assert row.error is None and row.until == "steps" and row.tol is None
+    # malformed until/tol is that request's rejection, not a raise
+    for bad in ({"until": "forever"}, {"tol": 1e-3},
+                {"until": "steady", "tol": -1.0}):
+        row = api_mod.parse_request_obj({"n": 12, "ntime": 8, **bad})
+        assert row.error is not None and row.cfg is None
+
+
+def test_engine_submit_validates_until():
+    eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,)))
+    with pytest.raises(ValueError, match="until"):
+        eng.submit(CO_CFG, until="forever")
+    with pytest.raises(ValueError, match="tol"):
+        eng.submit(CO_CFG, tol=1e-3)
+    assert eng.results() == []
+
+
+def test_serve_config_default_steady_tol_applies(tmp_path):
+    """An until=steady request without its own tol uses the engine-wide
+    --steady-tol default."""
+    eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,),
+                       steady_tol=STEADY_TOL))
+    rid = eng.submit(STEADY_CFG, until="steady")
+    recs = {r["id"]: r for r in eng.results()}
+    assert recs[rid]["exit"] == "steady"
+    assert recs[rid]["steps_done"] < STEADY_CFG.ntime
+
+
+# --- accounting reconciliation (ledger + /metrics + /statusz) -----------------
+
+
+def test_steps_saved_reconciles_across_surfaces():
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(16,)))
+    sid = eng.submit(STEADY_CFG, until="steady", tol=STEADY_TOL,
+                     tenant="acme")
+    eng.submit(CO_CFG, tenant="acme")
+    recs = eng.results()
+    s = eng.summary()
+    saved = sum(r["usage"]["steps_saved"] for r in recs)
+    assert saved == s["steps_saved"] > 0
+    by_id = {r["id"]: r for r in recs}
+    assert (by_id[sid]["usage"]["steps"] + by_id[sid]["usage"]["steps_saved"]
+            == STEADY_CFG.ntime)
+
+    # the engine's live ledger and an offline re-aggregation of the
+    # records agree (the heat-tpu usage reconciliation contract)
+    live = eng.prof.ledger.snapshot()
+    assert live["totals"]["steps_saved"] == saved
+    offline = UsageLedger()
+    for r in recs:
+        offline.add(r["tenant"], r["class"], r["status"], r["usage"],
+                    placement=r.get("placement"))
+    assert offline.snapshot()["totals"]["steps_saved"] == saved
+    assert (offline.snapshot()["tenants"]["acme"]["steps_saved"]
+            == saved)
+
+    # /metrics and /statusz surface the same totals
+    metrics = render_metrics(eng)
+    assert "heat_tpu_serve_steady_exits_total 1" in metrics
+    assert f"heat_tpu_serve_steps_saved_total {saved}" in metrics
+    assert "heat_tpu_usage_steps_saved_total" in metrics
+    assert "heat_tpu_numerics_predicted_eta_steps" in metrics
+    statusz = render_statusz(eng)
+    assert f"semantic scheduling: 1 steady exit(s), {saved} step(s) saved" \
+        in statusz
+
+
+def test_predicted_eta_gauge_live_on_metrics():
+    """While a steady lane is mid-flight, /metrics exports its per-lane
+    predicted-ETA gauge (labeled by request id)."""
+    eng = Engine(quiet(lanes=1, chunk=8, buckets=(16,)))
+    eng.start()
+    try:
+        # unreachable tol: the lane stays resident for the whole drain,
+        # so the gauge cannot race its own retirement
+        rid = eng.submit(STEADY_CFG.with_(ntime=4000), until="steady",
+                         tol=1e-30)
+        # wait for the observatory to have enough boundaries for an ETA
+        # (cheap probe), then scrape the expensive /metrics render once
+        seen = False
+        for _ in range(400):
+            if eng.numerics.eta_steps(rid) is not None:
+                seen = True
+                break
+            time.sleep(0.02)
+        assert seen, "predictor never produced an ETA"
+        metrics = render_metrics(eng)
+        assert (f'heat_tpu_numerics_predicted_eta_steps{{id="{rid}"}}'
+                in metrics)
+    finally:
+        eng.shutdown()
+
+
+# --- gateway e2e --------------------------------------------------------------
+
+
+def test_gateway_steady_request_e2e():
+    """A steady request over real HTTP: the JSONL line POSTed to
+    /v1/solve retires early, its record carries the semantic-scheduling
+    fields, and a malformed until is a per-line rejection."""
+    import urllib.error
+    import urllib.request
+
+    from heat_tpu.serve.gateway import Gateway
+
+    timeout = 60
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(16,)))
+    gw = Gateway(eng, "127.0.0.1", 0).start()
+    try:
+        def post(body):
+            req = urllib.request.Request(
+                f"http://{gw.address}/v1/solve?wait=0",
+                data=body.encode(), method="POST")
+            try:
+                resp = urllib.request.urlopen(req, timeout=timeout)
+                raw, st = resp.read(), resp.status
+            except urllib.error.HTTPError as e:
+                raw, st = e.read(), e.code
+            return st, [json.loads(l) for l in raw.decode().splitlines()
+                        if l.strip()]
+
+        st, lines = post(json.dumps(
+            {"id": "s", "n": 12, "ntime": 160, "ic": "sine",
+             "until": "steady", "tol": STEADY_TOL}) + "\n")
+        assert st == 202 and lines[0]["accepted"] == ["s"]
+        rec = eng.wait("s", timeout=timeout)
+        assert rec["status"] == "ok" and rec["exit"] == "steady"
+        assert rec["steps_done"] < 160
+        assert rec["predicted_steps"] is not None
+
+        # typo'd until: that line is rejected, nothing is admitted
+        st, lines = post(json.dumps(
+            {"id": "bad", "n": 12, "ntime": 8, "until": "forever"}) + "\n")
+        assert lines[0]["accepted"] == []
+        (row,) = lines[0]["records"]
+        assert row["status"] == "rejected" and "until" in row["error"]
+    finally:
+        eng.shutdown(timeout=timeout)
+        gw.close()
+
+
+# --- trace (heat-tpu trace) ---------------------------------------------------
+
+
+def test_trace_carries_steady_exit_instant(tmp_path):
+    path = tmp_path / "steady.trace.json"
+    eng = Engine(quiet(lanes=2, chunk=8, buckets=(16,),
+                       trace=str(path)))
+    sid = eng.submit(STEADY_CFG, until="steady", tol=STEADY_TOL)
+    recs = {r["id"]: r for r in eng.results()}
+    assert recs[sid]["exit"] == "steady"
+    evs = json.loads(path.read_text())["traceEvents"]
+    (ev,) = [e for e in evs if e.get("name") == "steady-exit"]
+    args = ev["args"]
+    assert args["id"] == sid
+    assert args["at_step"] == recs[sid]["steps_done"]
+    assert args["requested"] == STEADY_CFG.ntime
+    assert args["saved"] == STEADY_CFG.ntime - recs[sid]["steps_done"]
+    # predicted-vs-actual retirement boundary rides the same instant
+    assert args["predicted_at_step"] == recs[sid]["predicted_steps"]
+    # and the text summary counts it among the notable instants
+    text = "\n".join(trace_mod.summarize_file(path))
+    assert "steady-exit" in text
+
+
+# --- CLI ----------------------------------------------------------------------
+
+
+def test_serve_cli_steady_requests(tmp_cwd, capsys):
+    """`heat-tpu serve --requests` honors until/tol lines end to end:
+    the record carries exit=steady and the report prints the semantic-
+    scheduling savings line; --steady-tol parses as the default."""
+    from heat_tpu.cli import main
+
+    (tmp_cwd / "reqs.jsonl").write_text(
+        '{"id": "s", "n": 12, "ntime": 160, "ic": "sine",'
+        ' "until": "steady", "tol": 2e-3}\n'
+        '{"id": "f", "n": 12, "ntime": 40}\n')
+    assert main(["serve", "--requests", "reqs.jsonl", "--buckets", "16",
+                 "--chunk", "8", "--steady-tol", "1e-9"]) == 0
+    out = capsys.readouterr().out
+    assert '"exit": "steady"' in out
+    assert "semantic scheduling: 1 steady exit(s)," in out
+    recs = [json.loads(l) for l in out.splitlines()
+            if l.startswith("{") and '"event": "serve_request"' in l]
+    by_id = {r["id"]: r for r in recs}
+    assert by_id["s"]["exit"] == "steady"
+    assert by_id["s"]["steps_done"] < 160
+    assert by_id["s"]["predicted_steps"] is not None
+    assert by_id["f"]["exit"] == "steps" and by_id["f"]["steps_done"] == 40
