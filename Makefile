@@ -9,7 +9,8 @@ SHELL := /bin/bash
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
-        numerics-lab steady-lab lane-lab mega-lab perfcheck native run viz clean
+        numerics-lab steady-lab lane-lab mega-lab resume-lab perfcheck \
+        native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -145,6 +146,12 @@ mega-lab:              # two-tier placement A/B (virtual 8-device mesh):
                        # npz byte-identity vs solo sharded drive, packed
                        # throughput within 10% with a mega-lane resident
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_mega_lab.py
+
+resume-lab:            # zero-downtime serving A/B: uninterrupted wave vs
+                       # kill-at-50%-then-resume (npz byte-identity over
+                       # all 64 requests, zero re-stepped chunks, recovery
+                       # overhead = one manifest load + lane reseed)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_resume_lab.py
 
 perfcheck:             # CI perf gate: fresh prof-lab vs committed baseline
                        # (tolerance band) + every committed lab's internal

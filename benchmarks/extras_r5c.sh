@@ -161,6 +161,13 @@ phase numerics_overhead_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/numeric
 # run, co-lanes byte-identical, zero added D2H (host_fetch-spy-gated).
 # CPU-world: runs with the tunnel down.
 phase serve_steady_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_steady_lab.py
+# Zero-downtime serving A/B (ISSUE 17): the 64-request wave run
+# uninterrupted vs killed at the generation nearest 50% of its
+# boundaries and resumed from the surviving engine manifest — all 64
+# npz byte-identical, zero re-stepped chunks past the last checkpointed
+# boundary, recovery overhead = one manifest load + lane reseed.
+# CPU-world: runs with the tunnel down.
+phase serve_resume_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_resume_lab.py
 # Invariant guard (ISSUE 11 + 14): lint + the project-native
 # static-analysis suite (hot-path purity, lock discipline, traced-code
 # determinism, Mosaic kernel safety, race lockset inference) + the

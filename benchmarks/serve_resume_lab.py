@@ -1,0 +1,202 @@
+"""Zero-downtime serving A/B: kill-at-50%-then-resume vs uninterrupted.
+
+The ISSUE-17 claim, measured: engine-state checkpointing plus ``serve
+--resume`` must make a mid-wave kill invisible in the *results* and
+nearly free in *work*. The serve_lab 64-request population runs twice:
+
+- **uninterrupted**: one engine drains the wave, npz per request — the
+  golden bytes;
+- **kill + resume**: the SAME wave runs with ``--engine-ckpt-interval``
+  cadence checkpoints; the kill is simulated at the generation closest
+  to 50% of the wave's boundaries by deleting every newer generation
+  (exactly what a SIGKILL leaves: the FIFO writer ordering guarantees a
+  surviving manifest's fields and pre-cut writebacks are durable) and
+  every result file the manifest does not list as done. A second engine
+  ``resume_engine``-s from the surviving generation and drains the rest.
+
+Three acceptance gates ride in the artifact:
+
+- ``resumed_bit_identical``: every one of the 64 npz files — done-
+  before-the-cut from the killed run, the rest re-published by the
+  resumed run — byte-identical to the uninterrupted golden bytes;
+- ``zero_resteps``: per resumed request, chunks and steps (summed
+  across both incarnations by the cumulative usage stamps) equal the
+  uninterrupted run's — no chunk re-stepped past the last checkpointed
+  boundary, no step double-billed;
+- ``resumed_requests_recovered``: the surviving manifest accounts for
+  the whole wave (in-flight + queued + done = all 64 ids) and every
+  resumed request finishes ok.
+
+Recovery overhead is reported as the wall time of the resume call
+itself — one manifest load + per-lane reseed, no recompute.
+
+    JAX_PLATFORMS=cpu python benchmarks/serve_resume_lab.py [--requests 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+from pathlib import Path
+
+from _util import write_atomic
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+CKPT_INTERVAL = 25   # boundaries between generations (~16 gens per wave)
+
+
+def run_wave(reqs, workdir: Path, tag: str, lanes: int, chunk: int,
+             depth: int, interval: int = 0, engine=None):
+    from heat_tpu.serve import Engine, ServeConfig
+
+    out = workdir / tag
+    eng = engine
+    if eng is None:
+        eng = Engine(ServeConfig(
+            lanes=lanes, chunk=chunk, buckets=(32, 48),
+            dispatch_depth=depth, emit_records=False, out_dir=str(out),
+            engine_ckpt_interval=interval,
+            engine_ckpt_dir=str(workdir / f"{tag}-ckpt")))
+    for i, cfg in enumerate(reqs):
+        eng.submit(cfg, request_id=f"r{i:03d}")
+    t0 = time.perf_counter()
+    records = eng.results()
+    return time.perf_counter() - t0, eng, {r["id"]: r for r in records}
+
+
+def simulate_kill_at_half(ckdir: Path, outdir: Path):
+    """Delete every generation newer than the one closest to 50% of the
+    wave's boundaries, plus every npz the survivor does NOT list as done
+    — the on-disk state a SIGKILL at that cut would have left."""
+    gens = {}
+    for p in sorted(ckdir.glob("engine_gen*.json")):
+        man = json.loads(p.read_text())
+        gens[int(man["generation"])] = man
+    final_boundaries = max(m["boundaries"] for m in gens.values())
+    cut = min(gens, key=lambda g: abs(gens[g]["boundaries"]
+                                      - final_boundaries / 2))
+    for p in list(ckdir.glob("engine_gen*")):
+        if int(re.search(r"gen(\d+)", p.name).group(1)) > cut:
+            p.unlink()
+    done = set(gens[cut]["done"])
+    for p in list(outdir.glob("*.npz")):
+        if p.stem not in done:
+            p.unlink()
+    return gens[cut], final_boundaries
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--lanes", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh tempdir)")
+    ap.add_argument("--out", default=str(Path(__file__).parent
+                                         / "serve_resume_lab.json"))
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    from serve_lab import build_requests
+
+    from heat_tpu.serve import Engine, ServeConfig
+    from heat_tpu.serve.resume import resume_engine
+
+    workdir = Path(args.workdir or tempfile.mkdtemp(prefix="resume_lab_"))
+    reqs = build_requests(args.requests)
+
+    golden_wall, _, golden = run_wave(reqs, workdir, "golden", args.lanes,
+                                      args.chunk, args.depth)
+    killed_wall, _, _ = run_wave(reqs, workdir, "killed", args.lanes,
+                                 args.chunk, args.depth,
+                                 interval=CKPT_INTERVAL)
+    ckdir = workdir / "killed-ckpt"
+    survivor, final_boundaries = simulate_kill_at_half(
+        ckdir, workdir / "killed")
+
+    resumed_eng = Engine(ServeConfig(
+        lanes=args.lanes, chunk=args.chunk, buckets=(32, 48),
+        dispatch_depth=args.depth, emit_records=False,
+        out_dir=str(workdir / "resumed"),
+        engine_ckpt_interval=CKPT_INTERVAL,
+        engine_ckpt_dir=str(ckdir)))
+    t0 = time.perf_counter()
+    skip = resume_engine(resumed_eng, ckdir)
+    recovery_s = time.perf_counter() - t0
+    resume_wall, _, resumed = run_wave(reqs[:0], workdir, "resumed",
+                                       args.lanes, args.chunk, args.depth,
+                                       engine=resumed_eng)
+
+    all_ids = [f"r{i:03d}" for i in range(args.requests)]
+    recovered_all = set(skip) == set(all_ids)
+    resumed_ok = all(r["status"] == "ok" for r in resumed.values())
+
+    # byte-identity over the MERGED result set: done-before-the-cut files
+    # survive the kill in killed/, everything else re-published by the
+    # resumed engine
+    identical = []
+    for rid in all_ids:
+        a = workdir / "golden" / f"{rid}.npz"
+        b = workdir / "killed" / f"{rid}.npz"
+        if not b.exists():
+            b = workdir / "resumed" / f"{rid}.npz"
+        identical.append(b.exists()
+                         and a.read_bytes() == b.read_bytes())
+    bit_identical = all(identical)
+
+    # zero re-stepped chunks / no double billing: the resumed records'
+    # usage stamps are cumulative across incarnations by construction
+    resteps = []
+    for rid, rec in resumed.items():
+        g = golden[rid]
+        if (rec["usage"]["chunks"] != g["usage"]["chunks"]
+                or rec["usage"]["steps"] != g["usage"]["steps"]):
+            resteps.append({"id": rid,
+                            "chunks": [g["usage"]["chunks"],
+                                       rec["usage"]["chunks"]],
+                            "steps": [g["usage"]["steps"],
+                                      rec["usage"]["steps"]]})
+    zero_resteps = not resteps and resumed_ok
+
+    rec = {
+        "bench": "serve_resume_lab",
+        "config": {"requests": args.requests, "lanes": args.lanes,
+                   "chunk": args.chunk, "dispatch_depth": args.depth,
+                   "ckpt_interval": CKPT_INTERVAL},
+        "golden_wall_s": round(golden_wall, 3),
+        "killed_wall_s": round(killed_wall, 3),
+        "resume_wall_s": round(resume_wall, 3),
+        "recovery_overhead_s": round(recovery_s, 4),
+        "cut": {"generation": survivor["generation"],
+                "boundaries": survivor["boundaries"],
+                "of_total_boundaries": final_boundaries,
+                "inflight": len(survivor["inflight"]),
+                "queued": len(survivor["queued"]),
+                "done": len(survivor["done"])},
+        "resumed_requests": len(resumed),
+        "resumed_bit_identical": bit_identical,
+        "zero_resteps": zero_resteps,
+        "restep_witnesses": resteps[:5],
+        "resumed_requests_recovered": recovered_all,
+    }
+    write_atomic(Path(args.out), rec)
+    print(json.dumps(rec, indent=2))
+    passed = bit_identical and zero_resteps and recovered_all
+    print(f"serve_resume_lab: {'OK' if passed else 'FAILED'} — killed at "
+          f"gen {survivor['generation']} (boundary "
+          f"{survivor['boundaries']}/{final_boundaries}), "
+          f"{len(survivor['inflight'])} in-flight + "
+          f"{len(survivor['queued'])} queued resumed in {recovery_s:.3f}s "
+          f"overhead; {sum(identical)}/{len(identical)} npz byte-identical")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -351,6 +351,35 @@ def build_parser() -> argparse.ArgumentParser:
                             "its closed-form decay) through the real "
                             "gateway every S seconds (serve/probe.py; "
                             "0 = prober off, the default)")
+    serve.add_argument("--engine-ckpt-interval", dest="engine_ckpt_interval",
+                       type=int, default=0, metavar="N",
+                       help="engine-state checkpoint cadence: every N "
+                            "processed chunk boundaries the scheduler "
+                            "pauses dispatch at the next empty-pipeline "
+                            "cut and snapshots the WHOLE engine — one "
+                            "on-device copy per occupied lane (D2H on the "
+                            "writer thread) plus a JSON manifest of lane "
+                            "occupancy, queued requests, and usage "
+                            "partials, written atomically with a "
+                            "generation counter; a final checkpoint "
+                            "always lands at drain. 0 = off (default)")
+    serve.add_argument("--engine-ckpt-dir", dest="engine_ckpt_dir",
+                       metavar="DIR",
+                       help="where engine-state generations live "
+                            "(default: <--out-dir>/engine-ckpt, else "
+                            "./engine-ckpt)")
+    serve.add_argument("--resume", metavar="DIR",
+                       help="crash-safe resume: before serving, rebuild "
+                            "the engine from the newest valid engine "
+                            "manifest in DIR — in-flight requests "
+                            "continue at their last checkpointed boundary "
+                            "(bit-identical to an uninterrupted run), "
+                            "queued requests re-queue in policy order, "
+                            "usage billing resumes from stamped partials; "
+                            "a corrupt manifest is quarantined loudly and "
+                            "discovery falls back one generation. "
+                            "--requests rows whose ids the manifest "
+                            "accounts for are skipped")
     serve.add_argument("--json", action="store_true",
                        help="also print a machine-readable summary line")
 
@@ -798,9 +827,9 @@ def cmd_serve(args) -> int:
         if not path.exists():
             print(f"error: {path} not found", file=sys.stderr)
             return 2
-    elif args.listen is None:
+    elif args.listen is None and args.resume is None:
         print("error: need --requests FILE.jsonl, --listen HOST:PORT, "
-              "or both", file=sys.stderr)
+              "--resume DIR, or a combination", file=sys.stderr)
         return 2
     try:
         buckets = tuple(int(b) for b in str(args.buckets).split(",") if b)
@@ -831,6 +860,8 @@ def cmd_serve(args) -> int:
                                                  "--numerics"),
                            steady_tol=args.steady_tol,
                            numerics_guard=args.numerics_guard,
+                           engine_ckpt_interval=args.engine_ckpt_interval,
+                           engine_ckpt_dir=args.engine_ckpt_dir,
                            **({"mem_poll_every": args.mem_poll}
                               if args.mem_poll is not None else {}))
         if args.probe_interval < 0:
@@ -843,8 +874,29 @@ def cmd_serve(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    eng = None
+    skip_ids = set()
+    if args.resume is not None:
+        # resume BEFORE any file rows or HTTP traffic: the manifest is
+        # the authority on every request it accounts for (including
+        # mid-solve progress); later submits only add NEW work
+        from .serve.resume import resume_engine
+
+        eng = Engine(scfg)
+        try:
+            skip_ids = resume_engine(eng, args.resume)
+        except (ValueError, OSError) as e:
+            print(f"error: --resume {args.resume} failed: {e}",
+                  file=sys.stderr)
+            return 2
+
     if listen is None:
-        records, summary = serve_requests(path, scfg)
+        if path is not None:
+            records, summary = serve_requests(path, scfg, engine=eng,
+                                              skip_ids=skip_ids)
+        else:
+            records = eng.results()
+            summary = eng.summary()
         ok = sum(1 for r in records if r["status"] == "ok")
         _serve_report(summary, ok, args)
         if scfg.trace:
@@ -856,10 +908,12 @@ def cmd_serve(args) -> int:
     # --- online gateway mode ---------------------------------------------
     from .serve import Gateway, load_requests, submit_parsed
 
-    eng = Engine(scfg)
+    eng = eng if eng is not None else Engine(scfg)
     parse_failures = 0
     if path is not None:
         for row in load_requests(path):
+            if row.id is not None and row.id in skip_ids:
+                continue   # recovered (or finished) by --resume
             if row.cfg is None:
                 parse_failures += 1
                 master_print(f"serve: rejected request line: {row.error}")
@@ -1081,7 +1135,11 @@ def cmd_perfcheck(args) -> int:
              (("throughput_multiplier", lambda v: (v or 0) >= 1.5),
               ("steady_bit_identical", lambda v: v is True),
               ("colane_bit_identical", lambda v: v is True),
-              ("zero_added_transfers", lambda v: v is True)))):
+              ("zero_added_transfers", lambda v: v is True))),
+            ("serve_resume_lab.json",
+             (("resumed_bit_identical", lambda v: v is True),
+              ("zero_resteps", lambda v: v is True),
+              ("resumed_requests_recovered", lambda v: v is True)))):
         p = bdir / fname
         if not p.exists():
             check(False, fname, "committed artifact missing")
@@ -1976,6 +2034,17 @@ def cmd_info(_args) -> int:
           f"(--tenant-quota), endpoints POST /v1/solve + "
           f"GET /v1/requests/<id> /healthz /metrics, POST /drainz "
           f"(graceful drain; overload answers 429 + Retry-After)")
+    print(f"engine checkpoint: interval "
+          f"{_sd.engine_ckpt_interval or 'off'} boundaries "
+          f"(--engine-ckpt-interval N; always one at drain when on), "
+          f"dir {_sd.engine_ckpt_dir or '<out-dir>/engine-ckpt'} "
+          f"(--engine-ckpt-dir) — atomic generation manifests + per-lane "
+          f"field files; serve --resume DIR continues in-flight lanes "
+          f"bit-identically, re-queues waiting requests in policy order, "
+          f"resumes usage billing from stamped partials; POST "
+          f"/drainz?handoff=1 = drain-to-checkpoint (zero-downtime "
+          f"handoff); corrupt manifests quarantine + fall back one "
+          f"generation")
 
     # invariant guard (ISSUE 11): the static-analysis suite's static
     # half — rule families, committed schema registry population, and

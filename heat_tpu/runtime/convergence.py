@@ -93,6 +93,23 @@ class RateFuser:
         w = min(1.0, self.samples / float(OBS_FULL_WEIGHT_SAMPLES))
         return w * self.obs + (1.0 - w) * self.closed
 
+    # --- engine-state checkpoint / resume (serve --resume) ----------------
+    # ``closed`` is NOT exported: it is recomputed from the config at
+    # re-admission (deterministic), so only the observed half travels.
+    def export_state(self) -> dict:
+        return {"obs": self.obs, "samples": self.samples,
+                "last_resid": self._last_resid,
+                "last_remaining": self._last_remaining}
+
+    def reseed(self, state: dict) -> None:
+        self.obs = (None if state.get("obs") is None
+                    else float(state["obs"]))
+        self.samples = int(state.get("samples") or 0)
+        lr = state.get("last_resid")
+        self._last_resid = None if lr is None else float(lr)
+        lrem = state.get("last_remaining")
+        self._last_remaining = None if lrem is None else int(lrem)
+
 
 def predict_steps_to_tol(resid: float, tol: float,
                          log_rate: Optional[float]) -> Optional[int]:

@@ -37,6 +37,18 @@ serve/scheduler.py — ignored by the solo drive loop):
                                before transferring: a wedged-device
                                analog for the boundary fetch watchdog
                                (fire-once).
+- ``engine-kill@N``          — SIGKILL the serve process once the engine
+                               has processed >= N chunk boundaries
+                               (engine-wide counter, every runner).
+                               The hard-death analog for engine-state
+                               checkpointing: no atexit, no drain, no
+                               flushed buffers — exactly what ``serve
+                               --resume`` must recover from.
+- ``ckpt-manifest-corrupt@N`` — scribble over the engine-state manifest
+                               published at generation >= N (no ``@N`` =
+                               the first one). The resume loader must
+                               quarantine it and fall back one
+                               generation loudly.
 - ``perturb@N[:req=ID][:eps=E]`` — add a bounded (finite!) perturbation
                                ``eps`` (default 1e3) to one cell of a
                                serving lane's field once that lane's
@@ -86,7 +98,8 @@ RESTART_ENV_VAR = "HEAT_TPU_RESTART"
 CRASH_RC = 43
 
 _KINDS = ("crash", "nan", "ckpt-corrupt", "ckpt-truncate",
-          "sink-error", "sink-slow", "lane-nan", "fetch-hang", "perturb")
+          "sink-error", "sink-slow", "lane-nan", "fetch-hang", "perturb",
+          "engine-kill", "ckpt-manifest-corrupt")
 
 
 @dataclasses.dataclass
@@ -162,7 +175,8 @@ def parse_spec(spec: str) -> List[Fault]:
                         else int(val))
             except ValueError:
                 raise ValueError(f"bad value {val!r} for {key} in {entry!r}")
-        if f.kind in ("crash", "nan", "lane-nan", "perturb") and f.step is None:
+        if (f.kind in ("crash", "nan", "lane-nan", "perturb", "engine-kill")
+                and f.step is None):
             raise ValueError(f"fault {entry!r} needs a step: '{f.kind}@N'")
         faults.append(f)
     return faults
@@ -242,6 +256,22 @@ class FaultPlan:
                              f"(spec {self.spec!r})")
                 time.sleep(f.ms / 1000.0)
 
+    def maybe_engine_kill(self, boundary: int) -> None:
+        """Called once per processed chunk boundary (engine-wide counter,
+        serve/scheduler.py): SIGKILL this process — not ``os._exit``, so
+        even interpreter-level cleanup is denied — once the counter
+        reaches ``@N``. Fire-once per plan, though a SIGKILL that lands
+        never gets a second chance anyway."""
+        import signal
+
+        for f in self._live("engine-kill"):
+            if not f.fired and boundary >= f.step:
+                f.fired = True
+                print(f"fault: injected engine SIGKILL at boundary "
+                      f"{boundary} (spec {self.spec!r})",
+                      file=sys.stderr, flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
+
     # --- checkpoint-sink faults (runtime.checkpoint.save/save_shards) ----
     def sink_fault(self, step: int) -> None:
         """Called at the top of a checkpoint write: transient-error and
@@ -278,6 +308,22 @@ class FaultPlan:
                 path.write_bytes(data[:len(data) // 2])
                 master_print(f"fault: truncated checkpoint {path.name} "
                              f"(spec {self.spec!r})")
+
+    def damage_manifest(self, path: Path, generation: int) -> None:
+        """Called after an engine-state manifest is published
+        (runtime.checkpoint.save_engine_manifest): xor-scribble 64 bytes
+        at the midpoint — JSON turns to garbage, the resume loader's
+        validate step must quarantine it and fall back one generation."""
+        for f in self._live("ckpt-manifest-corrupt"):
+            if not f.fired and (f.step is None or generation >= f.step):
+                f.fired = True
+                data = bytearray(path.read_bytes())
+                mid = len(data) // 2
+                for i in range(mid, min(mid + 64, len(data))):
+                    data[i] ^= 0xFF
+                path.write_bytes(bytes(data))
+                master_print(f"fault: corrupted engine manifest "
+                             f"{path.name} (spec {self.spec!r})")
 
 
 def _inject_nan(T):

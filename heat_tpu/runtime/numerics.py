@@ -195,6 +195,55 @@ class NumericsObservatory:
                     "steady_tol": eff_tol})
         return events
 
+    # --- engine-state checkpoint / resume (serve --resume) ----------------
+    def export_state(self, req_id: str) -> Optional[dict]:
+        """JSON-safe detector state for one in-flight request, captured
+        at a chunk-boundary cut for the engine manifest. The envelope
+        (lo/hi/tol) and the closed-form rate are NOT exported — both are
+        recomputed deterministically at re-admission; only the observed
+        history (EWMAs, fire-once flags, rate samples) travels."""
+        with self._lock:
+            st = self._lanes.get(req_id)
+            if st is None:
+                return None
+            return {"resid_ewma": st.resid_ewma, "heat": st.heat,
+                    "dheat_ewma": st.dheat_ewma,
+                    "steady_fired": st.steady_fired,
+                    "violated": st.violated,
+                    "boundaries": st.boundaries,
+                    "last_resid": st.last_resid,
+                    "last_min": st.last_min, "last_max": st.last_max,
+                    "fuser": (None if st.fuser is None
+                              else st.fuser.export_state())}
+
+    def reseed(self, req_id: str, state: Optional[dict]) -> None:
+        """Restore exported detector state over a fresh ``admit`` (call
+        admit first: it re-arms envelope/tolerance/closed-form rate).
+        The EWMAs continue where the killed engine left them, so a
+        resumed ``until=steady`` lane retires on accumulated evidence
+        instead of re-warming from scratch — and an already-fired
+        steady flag stays fired (no duplicate steady_state record)."""
+        if not state:
+            return
+        with self._lock:
+            st = self._lanes.get(req_id)
+            if st is None:
+                return
+            if state.get("resid_ewma") is not None:
+                st.resid_ewma = float(state["resid_ewma"])
+            if state.get("heat") is not None:
+                st.heat = float(state["heat"])
+            if state.get("dheat_ewma") is not None:
+                st.dheat_ewma = float(state["dheat_ewma"])
+            st.steady_fired = bool(state.get("steady_fired", False))
+            st.violated = bool(state.get("violated", False))
+            st.boundaries = int(state.get("boundaries") or 0)
+            for k in ("last_resid", "last_min", "last_max"):
+                if state.get(k) is not None:
+                    setattr(st, k, float(state[k]))
+            if st.fuser is not None and state.get("fuser"):
+                st.fuser.reseed(state["fuser"])
+
     # --- prediction (semantic scheduling, ISSUE 16) -----------------------
     def _eta_locked(self, st: _LaneState) -> Optional[int]:
         """Predicted steps until this lane's residual EWMA crosses its

@@ -33,6 +33,7 @@ from .api import (ParsedRequest, load_requests,  # noqa: F401
                   parse_request_obj, serve_requests, submit_parsed)
 from .engine import (BucketKey, LaneEngine, lane_buffer,  # noqa: F401
                      lane_tier, tail_size)
+from .resume import resume_engine  # noqa: F401
 from .scheduler import (TERMINAL_STATUSES, Engine,  # noqa: F401
                         Request, ServeConfig)
 

@@ -127,19 +127,26 @@ def submit_parsed(eng: Engine, row: ParsedRequest) -> str:
 
 
 def serve_requests(path, scfg: Optional[ServeConfig] = None,
-                   engine: Optional[Engine] = None) -> Tuple[List[dict], dict]:
+                   engine: Optional[Engine] = None,
+                   skip_ids=()) -> Tuple[List[dict], dict]:
     """Serve every request in a JSONL file; returns (records, summary).
 
     Parse failures become status='rejected' records alongside the engine's
     own admission rejections, so the records list covers every input line.
     ``scfg`` defaults to ``ServeConfig()`` (resolved per call, not at
     definition — the B008 mutable-default-adjacent footgun ruff now
-    gates).
+    gates). ``skip_ids`` (``serve --resume``) names requests already
+    recovered from — or finished before — an engine-state checkpoint;
+    matching file rows are not re-submitted (the resume replay is the
+    authority on their state, including mid-solve progress).
     """
     scfg = scfg if scfg is not None else ServeConfig()
     eng = engine or Engine(scfg)
+    skip_ids = frozenset(skip_ids)
     parse_failures = []
     for i, row in enumerate(load_requests(path)):
+        if row.id is not None and row.id in skip_ids:
+            continue
         if row.cfg is None:
             rec = {"id": row.id or f"line-{i}", "status": "rejected",
                    "error": row.error}

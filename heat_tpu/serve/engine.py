@@ -861,6 +861,23 @@ class MegaLaneEngine:
         del T0
         self._rem = np.asarray([self.cfg.ntime], np.int32)
 
+    def load(self, T, steps_left: int) -> None:
+        """Seed the carried padded state from a HOST field with
+        ``steps_left`` steps to go — engine-state resume (serve
+        --resume). Owned-cell values are invariant under chunk
+        partitioning (the fused-exchange margin argument the solo
+        sharded drive rides), so seeding from a cropped checkpoint
+        field at a chunk boundary continues bit-identically."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P(*self.mesh.axis_names))
+        T_dev = jax.device_put(
+            np.asarray(T, dtype=jnp_dtype(self.cfg.dtype)), sharding)
+        self._state = self._seed_c(T_dev)
+        del T_dev
+        self._rem = np.asarray([int(steps_left)], np.int32)
+
     def dispatch_chunk(self, k: int):
         """Enqueue one k-step mesh program and return the DEVICE handle
         to its ``(K_BOUNDARY, 1)`` boundary vector — no fence, no host

@@ -192,6 +192,15 @@ def render_metrics(engine: Engine) -> str:
     metric("heat_tpu_serve_boundary_wait_seconds_total", "counter",
            "Host wall seconds blocked on chunk-boundary fetches.",
            [([], s["boundary_wait_s"])])
+    metric("heat_tpu_serve_resumed_requests_total", "counter",
+           "Requests re-admitted from an engine-state checkpoint "
+           "(serve --resume): in-flight lanes continued at their last "
+           "boundary plus queued requests re-queued in policy order.",
+           [([], s.get("serve_resumed", 0))])
+    metric("heat_tpu_engine_ckpt_generation", "gauge",
+           "Newest durable engine-checkpoint generation this process "
+           "has published (0 = none yet; --engine-ckpt-interval).",
+           [([], s.get("engine_ckpt_generation", 0))])
     metric("heat_tpu_flightrec_dumps_total", "counter",
            "Flight-recorder dumps written (watchdog fire / quarantine-"
            "after-rollbacks / numerics violation / scheduler crash); "
@@ -419,6 +428,13 @@ def render_statusz(engine: Engine) -> str:
         f"faults: {s['lanes_quarantined']} quarantined, "
         f"{s['rollbacks']} rollback(s), {s['deadline_misses']} deadline "
         f"miss(es), {s['shed']} shed, {s['watchdog_fired']} watchdog")
+    iv = s.get("engine_ckpt_interval", 0)
+    lines.append(
+        f"resume: engine checkpoint "
+        f"{f'every {iv} boundaries' if iv else 'OFF (--engine-ckpt-interval 0)'}"
+        f", last published generation {s.get('engine_ckpt_generation', 0)}, "
+        f"{s.get('serve_resumed', 0)} request(s) re-admitted from a "
+        f"checkpoint this incarnation")
     if s.get("numerics"):
         lines.append(
             f"numerics: guard {s.get('numerics_guard', 'warn')}, "
@@ -565,11 +581,19 @@ class Gateway:
         return self
 
     # --- drain ------------------------------------------------------------
-    def request_drain(self) -> bool:
+    def request_drain(self, handoff: bool = False) -> bool:
         """Begin the graceful drain (idempotent): admission stops now,
         in-flight lanes and already-queued requests finish, then the
-        scheduler exits. Returns True once fully drained."""
-        self.engine.begin_drain()
+        scheduler exits. Returns True once fully drained.
+
+        ``handoff=True`` (POST /drainz?handoff=1) is drain-to-checkpoint:
+        instead of waiting for lanes to finish, the scheduler checkpoints
+        the whole engine at the next empty-pipeline boundary and exits —
+        a replacement process picks the work up with ``serve --resume``.
+        Handoff wins over a concurrent plain drain (escalation is safe;
+        de-escalation would strand in-flight work unfinished AND
+        uncheckpointed)."""
+        self.engine.begin_drain(handoff=handoff)
         with self._drain_lock:
             if self._drainer is None:
                 self._drainer = threading.Thread(target=self._drain_worker,
@@ -688,7 +712,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._text(200, json.dumps(eng.tracer.to_chrome()),
                        "application/json")
         elif path == "/drainz":
-            self._drainz()
+            self._drainz(parts)
         elif path.startswith("/v1/requests/"):
             rid = path[len("/v1/requests/"):]
             rec = eng.poll(rid)
@@ -717,18 +741,24 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802
         parts = urlsplit(self.path)
         if parts.path == "/drainz":
-            self._drainz()
+            self._drainz(parts)
         elif parts.path == "/v1/solve":
             self._solve(parts)
         else:
             self._json(404, {"error": f"no route for POST {parts.path}"})
 
-    def _drainz(self) -> None:
+    def _drainz(self, parts=None) -> None:
         """Idempotent graceful drain trigger (POST preferred; GET kept
-        for curl ergonomics)."""
-        drained = self.gw.request_drain()
+        for curl ergonomics). ``?handoff=1`` checkpoints the engine at
+        the next empty-pipeline boundary instead of finishing lanes —
+        the zero-downtime handoff contract (see Gateway.request_drain)."""
+        handoff = (parts is not None
+                   and parse_qs(parts.query).get("handoff", ["0"])[0]
+                   in ("1", "true"))
+        drained = self.gw.request_drain(handoff=handoff)
         eng = self.gw.engine
         self._json(200, {"draining": True, "drained": drained,
+                         "handoff": handoff,
                          "queued": sum(eng.queue_depths().values())})
 
     # --- /v1/solve --------------------------------------------------------

@@ -89,6 +89,11 @@ class FifoQueue:
     def pop(self):
         return self._q.popleft() if self._q else None
 
+    def items(self) -> List:
+        """Non-destructive snapshot of every queued request (engine-state
+        checkpointing reads the queue without disturbing pop order)."""
+        return list(self._q)
+
     def __len__(self) -> int:
         return len(self._q)
 
@@ -107,6 +112,11 @@ class EdfQueue:
 
     def pop(self):
         return heapq.heappop(self._h)[1] if self._h else None
+
+    def items(self) -> List:
+        """Snapshot of queued requests (heap order, NOT pop order — the
+        checkpoint replays them through push() again, which re-sorts)."""
+        return [entry[1] for entry in self._h]
 
     def __len__(self) -> int:
         return len(self._h)
@@ -170,6 +180,13 @@ class FairShareQueue:
         work = float(req.cfg.points * max(steps, 1))
         self._vtime[tenant] += work / self._weight(tenant)
         return req
+
+    def items(self) -> List:
+        """Snapshot of every tenant's queued requests (unordered; resume
+        re-pushes them, rebuilding the heaps. Virtual-time credit is NOT
+        part of the snapshot — a resumed engine restarts every tenant at
+        vtime 0, the same already-fair state a fresh engine starts in)."""
+        return [entry[1] for h in self._tenants.values() for entry in h]
 
     def __len__(self) -> int:
         return self._count

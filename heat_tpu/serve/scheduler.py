@@ -123,6 +123,7 @@ from ..config import (DEFAULT_SLO_CLASS, DEFAULT_TENANT, LANE_KERNELS,
                       validate_until_fields)
 from ..grid import ic_envelope, initial_condition
 from ..runtime import async_io, faults
+from ..runtime import checkpoint as ckpt_mod
 from ..runtime import convergence as conv_mod
 from ..runtime import debug as debug_mod
 from ..runtime import numerics as numerics_mod
@@ -212,7 +213,9 @@ class ServeConfig:
     flight_dir: Optional[str] = None  # flight-recorder dump directory
                               # (flightrec-<ts>.trace.json on watchdog /
                               # quarantine-after-rollbacks / scheduler
-                              # crash); None = out_dir, else the cwd
+                              # crash); None = out_dir. With neither set
+                              # the dump is skipped (never the cwd — the
+                              # ring is retained in memory either way)
     prof: bool = True         # the performance & cost observatory
                               # (runtime/prof.py): online chunk-cost
                               # model, per-tenant usage ledger, memory
@@ -286,6 +289,17 @@ class ServeConfig:
                               # exit — the request fails nonfinite, the
                               # lane frees, co-scheduled lanes continue
                               # byte-identically
+    engine_ckpt_interval: int = 0  # zero-downtime serving (ISSUE 17):
+                              # checkpoint the whole engine state (lane
+                              # fields + occupancy/queue/usage manifest)
+                              # every N processed chunk boundaries, and
+                              # always at drain; ``serve --resume DIR``
+                              # reconstructs the engine from the newest
+                              # valid generation. 0 = off (no manifest is
+                              # ever written — bit-identical to PR 16)
+    engine_ckpt_dir: Optional[str] = None  # manifest + lane-field
+                              # directory; None = <out_dir>/engine-ckpt,
+                              # or ./engine-ckpt with no out_dir
 
     def __post_init__(self):
         if self.lanes < 1:
@@ -356,6 +370,9 @@ class ServeConfig:
         if self.numerics_guard not in ("warn", "quarantine"):
             raise ValueError(f"numerics_guard must be 'warn' or "
                              f"'quarantine', got {self.numerics_guard!r}")
+        if self.engine_ckpt_interval < 0:
+            raise ValueError(f"engine_ckpt_interval must be >= 0 (0 = "
+                             f"off), got {self.engine_ckpt_interval}")
         if self.inject:
             # fail at construction, not at a boundary mid-drain (same
             # parse-time contract as HeatConfig.inject)
@@ -417,6 +434,14 @@ class Request:
                                         # EDF predicted-finish rank and
                                         # the trace's predicted-vs-actual
                                         # retirement boundary
+    restore: Optional[dict] = None      # engine-state resume payload
+                                        # (serve/resume.py): the
+                                        # checkpointed host field ("T"),
+                                        # "remaining", the cumulative
+                                        # "chunks" meter, and the saved
+                                        # "numerics" detector state; the
+                                        # admitting _fill consumes it —
+                                        # None for every normal request
 
 
 def _bucket_for(cfg: HeatConfig, buckets) -> Optional[int]:
@@ -548,6 +573,11 @@ class _GroupRunner:
         the admission policy's call (serve/policy.py), recorded in
         ``Engine.admission_trace``."""
         outer = self.outer
+        if outer._ckpt_pause:
+            # checkpoint bubble: no new admissions while the engine is
+            # draining its pipeline toward the consistent cut — queued
+            # requests are part of the manifest, not of a lane
+            return
         for lane in range(self.lanes):
             while self.occupant[lane] is None and self.q:
                 with outer._lock:
@@ -584,14 +614,29 @@ class _GroupRunner:
                     rec["queue_wait_s"] = round(now - req.submit_t, 6)
                     rec["status"] = "running"
                     rec["_start_t"] = now
-                T0 = initial_condition(req.cfg)
-                self.eng.load_lane(lane, T0, float(req.cfg.r),
-                                   req.cfg.ntime, req.cfg.bc_value)
+                rst = req.restore
+                if rst:
+                    # engine-state resume (serve/resume.py): re-seed the
+                    # lane from the checkpointed field at its last
+                    # boundary — the maybe_grow transplant contract, so
+                    # continuation is bit-identical to an uninterrupted
+                    # run. The chunk meter continues where it stopped:
+                    # usage stamps stay cumulative across incarnations.
+                    req.restore = None
+                    self.eng.load_lane(lane, rst["T"], float(req.cfg.r),
+                                       int(rst["remaining"]),
+                                       req.cfg.bc_value)
+                    self.dev_rem[lane] = int(rst["remaining"])
+                    self.lane_chunks[lane] = int(rst.get("chunks", 0))
+                else:
+                    T0 = initial_condition(req.cfg)
+                    self.eng.load_lane(lane, T0, float(req.cfg.r),
+                                       req.cfg.ntime, req.cfg.bc_value)
+                    self.dev_rem[lane] = req.cfg.ntime
+                    self.lane_chunks[lane] = 0   # usage meter restarts
+                                                 # with the new occupant
                 self.occupant[lane] = req
                 self.epoch[lane] = self.seq
-                self.dev_rem[lane] = req.cfg.ntime
-                self.lane_chunks[lane] = 0   # usage meter restarts with
-                                             # the new occupant
                 self.nan_pending[lane] = outer._lane_nan_steps(req)
                 self.perturb_pending[lane] = outer._lane_perturb_events(req)
                 if self.nan_pending[lane] or self.perturb_pending[lane]:
@@ -609,6 +654,12 @@ class _GroupRunner:
                     outer.numerics.admit(
                         req.id, lo, hi, req.cfg.dtype, steady_tol=req.tol,
                         log_rate=conv_mod.closed_form_log_rate(req.cfg))
+                    if rst and rst.get("numerics"):
+                        # resume continuity: EWMAs, fired latches, and
+                        # the ETA fuser pick up where the checkpointed
+                        # incarnation left them (until=steady lanes keep
+                        # their convergence history)
+                        outer.numerics.reseed(req.id, rst["numerics"])
 
     def _live_remaining(self) -> List[int]:
         return [int(self.dev_rem[i]) for i, o in enumerate(self.occupant)
@@ -660,6 +711,10 @@ class _GroupRunner:
         no lane has steps left to run. Pure host->device enqueue: no
         fetch, no fence (a rollback-mode stack snapshot is a device-side
         copy, also enqueued without a fence)."""
+        if self.outer._ckpt_pause:
+            # checkpoint bubble: stop feeding the pipeline so the
+            # in-flight chunks drain to the empty cut (_ckpt_tick)
+            return
         poison = self.outer._has_lane_faults
         while len(self.inflight) < self.depth:
             if self.allow_growth and self._growth_wanted():
@@ -997,6 +1052,7 @@ class _GroupRunner:
             if outer.numerics is not None:
                 self._ingest_numerics(seq, b)
             self._judge_lanes(seq, rem, finite, snap, sync=False)
+            outer._note_boundary()
         else:
             # nothing in flight and nothing left to step: occupants whose
             # countdown is already settled at zero (ntime=0 admits, or
@@ -1130,6 +1186,7 @@ class _GroupRunner:
                 snap = self.eng.snapshot_stack()
             if outer.numerics is not None:
                 self._ingest_numerics(self.seq, b)
+            outer._note_boundary()
         else:
             rem = self.dev_rem
         self._judge_lanes(self.seq, rem, finite, snap, sync=True)
@@ -1146,6 +1203,9 @@ class _GroupRunner:
         taken after the fetch, from a boundary already judged."""
         while self.has_work():
             self.sync_round()
+            # every fenced round is an empty-pipeline cut: take an armed
+            # engine checkpoint here (depth > 0 ticks in the drive loops)
+            self.outer._ckpt_tick()
 
 
 class MegaLaneRunner:
@@ -1220,6 +1280,10 @@ class MegaLaneRunner:
         failure (a compile error on THIS config) fails that one request
         — never the scheduler loop."""
         outer = self.outer
+        if outer._ckpt_pause:
+            # checkpoint bubble: same no-new-admissions contract as the
+            # packed tier — queued mega requests ride the manifest
+            return
         while self.occupant[0] is None and self.q:
             with outer._lock:
                 req = self.q.pop()
@@ -1265,10 +1329,21 @@ class MegaLaneRunner:
                 continue
             self.cost_label = (f"{req.cfg.ndim}d/n{req.cfg.n}/"
                                f"{req.cfg.dtype}/{req.cfg.bc}")
+            rst = req.restore
+            if rst:
+                # engine-state resume: overwrite the freshly seeded mesh
+                # state with the checkpointed owned field (crop -> seed
+                # round trip at a chunk boundary is bit-exact — the
+                # owned-cell invariance argument of serve/engine.py)
+                req.restore = None
+                self.eng.load(rst["T"], int(rst["remaining"]))
+                self.dev_rem[0] = int(rst["remaining"])
+                self.lane_chunks[0] = int(rst.get("chunks", 0))
+            else:
+                self.dev_rem[0] = req.cfg.ntime
+                self.lane_chunks[0] = 0
             self.occupant[0] = req
             self.epoch[0] = self.seq
-            self.dev_rem[0] = req.cfg.ntime
-            self.lane_chunks[0] = 0
             self.nan_pending[0] = outer._lane_nan_steps(req)
             self.perturb_pending[0] = outer._lane_perturb_events(req)
             if self.nan_pending[0] or self.perturb_pending[0]:
@@ -1281,6 +1356,8 @@ class MegaLaneRunner:
                 outer.numerics.admit(
                     req.id, lo, hi, req.cfg.dtype, steady_tol=req.tol,
                     log_rate=conv_mod.closed_form_log_rate(req.cfg))
+                if rst and rst.get("numerics"):
+                    outer.numerics.reseed(req.id, rst["numerics"])
 
     def maybe_grow(self) -> None:
         """Interface parity with ``_GroupRunner``: nothing to grow."""
@@ -1312,6 +1389,9 @@ class MegaLaneRunner:
         the at-most-one remainder program was AOT-compiled at
         admission)."""
         outer = self.outer
+        if outer._ckpt_pause:
+            # checkpoint bubble: drain toward the empty cut
+            return
         poison = outer._has_lane_faults
         while len(self.inflight) < self.depth:
             rem = int(self.dev_rem[0])
@@ -1596,6 +1676,7 @@ class MegaLaneRunner:
             if outer.numerics is not None:
                 self._ingest_numerics(seq, b)
             self._judge(seq, rem, finite, snap, sync=False)
+            outer._note_boundary()
         else:
             self._judge(self.seq, self.dev_rem, None, None, sync=False)
         self._fill()
@@ -1641,6 +1722,7 @@ class MegaLaneRunner:
                 snap = self.eng.snapshot_state()
             if outer.numerics is not None:
                 self._ingest_numerics(self.seq, b)
+            outer._note_boundary()
         self._judge(self.seq, rem_vec, finite, snap, sync=True)
         self.seq += 1
         self._fill()
@@ -1648,6 +1730,7 @@ class MegaLaneRunner:
     def run_sync(self) -> None:
         while self.has_work():
             self.sync_round()
+            self.outer._ckpt_tick()
 
 
 class Engine:
@@ -1770,6 +1853,29 @@ class Engine:
         self.steps_saved_total = 0
         self.shed = 0                # submits rejected by --max-queue
         self.watchdog_fired = 0      # boundary-fetch watchdog timeouts
+        # zero-downtime serving (ISSUE 17): engine-state checkpointing.
+        # The cadence clock counts PROCESSED chunk boundaries across all
+        # runners; crossing the interval arms _ckpt_pause (runners stop
+        # feeding the pipeline), and the driving loop takes the manifest
+        # at the first empty-pipeline cut (_ckpt_tick). All mutated on
+        # the scheduler thread under the engine lock; the gateway's
+        # /drainz?handoff=1 thread flips _ckpt_pause/_handoff under the
+        # same lock, and its scrape threads read _engine_ckpt_gen there.
+        self.serve_resumed_total = 0  # requests re-admitted by --resume
+        self.boundaries_total = 0     # processed chunk boundaries (the
+                                      # checkpoint cadence clock and the
+                                      # engine-kill@N fault address)
+        self._engine_ckpt_gen = 0     # last PUBLISHED manifest generation
+        self._engine_ckpt_next = 0    # next generation to write (0 =
+                                      # scan the directory first; resume
+                                      # seeds loaded generation + 1)
+        self._last_ckpt_boundary = 0  # cadence clock at the last publish
+        self._ckpt_pause = False      # armed: drain to the empty cut
+        self._handoff = False         # drain-to-checkpoint requested
+        self._active_runners = ()     # the driving loop's live runners
+        self._active_writer = None    # ... and its SnapshotWriter
+                                      # (both thread-confined to the
+                                      # scheduler thread that set them)
         # engine-scoped fault plan (scfg.inject / HEAT_TPU_FAULTS); None on
         # every normal run — the hot loop then does no fault work at all
         self._plan = faults.plan_for(scfg)
@@ -1885,7 +1991,8 @@ class Engine:
                tenant: Optional[str] = None,
                slo_class: Optional[str] = None,
                until: Optional[str] = None,
-               tol: Optional[float] = None) -> str:
+               tol: Optional[float] = None,
+               _restore: Optional[dict] = None) -> str:
         """Admit one request; returns its id. Unservable requests become
         status='rejected' records instead of raising (see module doc).
         ``deadline_ms`` (request JSONL field of the same name) bounds the
@@ -1898,6 +2005,12 @@ class Engine:
         ``ntime`` as the hard cap); malformed values raise (the
         JSONL/HTTP front doors pre-validate them into per-request
         rejections).
+
+        ``_restore`` (serve/resume.py only) re-admits a request recovered
+        from an engine-state checkpoint: ``{}`` for one that was still
+        queued, or a payload with the checkpointed field/remaining/usage
+        partials for one that was mid-solve — the admitting lane fill
+        continues it at its last boundary, bit-identically.
 
         Thread-safe: the gateway's HTTP handler threads call this while
         the online scheduler thread is mid-drain — shared state mutates
@@ -1934,7 +2047,15 @@ class Engine:
                    "deadline_ms": deadline_ms, "trace_id": trace_id,
                    "until": until, "steps_done": None, "exit": None,
                    "predicted_steps": predicted, "predicted_wall_s": None,
+                   "resumed": _restore is not None,
                    "_submit_t": wall_clock()}
+            if _restore is not None:
+                # usage partials from the checkpointed incarnation: the
+                # terminal stamp folds them in (no double billing — the
+                # step count spans both incarnations by construction)
+                self.serve_resumed_total += 1
+                rec["_resumed_lane_s"] = float(_restore.get("lane_s")
+                                               or 0.0)
             self._records.append(rec)
             self._by_id[rid] = rec
         if self.tracer.enabled:
@@ -2002,7 +2123,8 @@ class Engine:
                                 if deadline_ms is not None else None),
                     tenant=tenant, slo_class=slo_class, seq=seq,
                     trace_id=trace_id, until=until, tol=tol,
-                    predicted_steps=predicted)
+                    predicted_steps=predicted,
+                    restore=(_restore if _restore else None))
                 q.push(req)
                 if self.tracer.enabled:
                     policy_mod.note_enqueue(self.tracer, self.scfg.policy,
@@ -2142,8 +2264,11 @@ class Engine:
         now = wall_clock()
         with self._lock:
             start = rec.pop("_start_t", None)
+            base = rec.pop("_resumed_lane_s", 0.0)
             if start is not None:
-                rec["solve_s"] = round(now - start, 6)
+                rec["solve_s"] = round(now - start + base, 6)
+            elif base:
+                rec["solve_s"] = round(base, 6)
             if rec["queue_wait_s"] is None:
                 rec["queue_wait_s"] = round(now - req.submit_t, 6)
             if lane is not None:
@@ -2252,15 +2377,19 @@ class Engine:
     def _flight_dump(self, reason: str) -> None:
         """Flight-recorder dump (watchdog fire / quarantine-after-
         rollbacks / scheduler crash): atomic write of the event ring to
-        ``flight_dir`` (default: ``out_dir``, else the cwd). Must never
-        raise into the failure path it is documenting. A successful dump
-        additionally emits a structured ``flightrec`` record naming the
-        file — operators find the dump from the log stream, not by
-        grepping the filesystem — and bumps the
-        ``heat_tpu_flightrec_dumps_total`` counter (/metrics)."""
+        ``flight_dir`` (default: ``out_dir``; with neither set the dump
+        is SKIPPED — never the cwd, which is how 81 stray trace files
+        once landed at a repo root). Must never raise into the failure
+        path it is documenting. A successful dump additionally emits a
+        structured ``flightrec`` record naming the file — operators find
+        the dump from the log stream, not by grepping the filesystem —
+        and bumps the ``heat_tpu_flightrec_dumps_total`` counter
+        (/metrics)."""
+        d = self.scfg.flight_dir or self.scfg.out_dir
+        if d is None:
+            return
         try:
-            path = self.tracer.flight_dump(
-                self.scfg.flight_dir or self.scfg.out_dir or ".", reason)
+            path = self.tracer.flight_dump(d, reason)
         except Exception as e:  # noqa: BLE001 — best-effort by contract
             master_print(f"flight recorder: dump failed "
                          f"({type(e).__name__}: {e})")
@@ -2394,6 +2523,190 @@ class Engine:
         with self._lock:
             return {t: n for t, n in self._queued_by_tenant.items() if n}
 
+    # --- engine-state checkpointing (ISSUE 17) ----------------------------
+    def engine_ckpt_dir(self) -> str:
+        """Resolved manifest directory: explicit --engine-ckpt-dir, else
+        <out_dir>/engine-ckpt, else ./engine-ckpt."""
+        from pathlib import Path
+
+        if self.scfg.engine_ckpt_dir:
+            return self.scfg.engine_ckpt_dir
+        if self.scfg.out_dir:
+            return str(Path(self.scfg.out_dir) / "engine-ckpt")
+        return "engine-ckpt"
+
+    def _note_boundary(self) -> None:
+        """One processed chunk boundary (every runner calls this from the
+        scheduler thread): advance the checkpoint cadence clock, arm the
+        checkpoint pause when the interval is crossed, and give
+        ``engine-kill@N`` its boundary address."""
+        with self._lock:
+            self.boundaries_total += 1
+            n = self.boundaries_total
+            interval = self.scfg.engine_ckpt_interval
+            if (interval > 0 and not self._ckpt_pause
+                    and n - self._last_ckpt_boundary >= interval):
+                self._ckpt_pause = True
+        if self._plan is not None:
+            self._plan.maybe_engine_kill(n)
+
+    def _ckpt_tick(self) -> None:
+        """Take the armed checkpoint once the pipeline is EMPTY: every
+        runner's in-flight deque drained, so the live device state is
+        exactly the last judged boundary (the ``maybe_grow`` transplant
+        precedent — the consistent cut). Called once per scheduler round
+        by the driving loops; a no-op unless the pause is armed."""
+        if not self._ckpt_pause:
+            return
+        runners = self._active_runners or ()
+        if any(r.inflight for r in runners):
+            return
+        try:
+            self._engine_checkpoint(reason="interval")
+        finally:
+            with self._cond:
+                self._ckpt_pause = False
+                self._last_ckpt_boundary = self.boundaries_total
+                self._cond.notify_all()
+
+    def _engine_checkpoint(self, reason: str) -> None:
+        """Snapshot the whole engine at THIS empty-pipeline cut: one
+        on-device copy per occupied lane (D2H deferred to the writer
+        thread), plus a JSON manifest of lane occupancy, queued requests
+        in policy order, and usage partials. The manifest is submitted to
+        the FIFO writer AFTER every field job and every earlier
+        writeback, so a manifest on disk proves everything it references
+        is durable — a kill mid-generation leaves fields without a
+        manifest and discovery falls back one generation."""
+        from pathlib import Path
+
+        d = Path(self.engine_ckpt_dir())
+        with self._lock:
+            if self._engine_ckpt_next <= 0:
+                self._engine_ckpt_next = ckpt_mod.next_engine_generation(d)
+            gen = self._engine_ckpt_next
+            self._engine_ckpt_next = gen + 1
+        now = wall_clock()
+        inflight_entries: List[dict] = []
+        field_jobs: List = []
+        failed: List[str] = []
+
+        def _entry(req: Request, remaining: int, chunks: int,
+                   lane_s: float, numerics) -> dict:
+            rec = self._by_id[req.id]
+            return {"id": req.id,
+                    "cfg": dataclasses.asdict(req.cfg),
+                    "fingerprint": ckpt_mod.config_fingerprint(req.cfg),
+                    "placement": req.placement,
+                    "remaining": int(remaining),
+                    "steps_done": int(req.cfg.ntime - remaining),
+                    "chunks": int(chunks),
+                    "lane_s": round(float(lane_s), 6),
+                    "until": req.until, "tol": req.tol,
+                    "tenant": req.tenant, "class": req.slo_class,
+                    "deadline_ms": rec.get("deadline_ms"),
+                    "seq": req.seq,
+                    "numerics": numerics}
+
+        def _field_job(rid: str, fp: str, remaining: int, get_field):
+            def job():
+                try:
+                    ckpt_mod.save_engine_field(d, gen, rid, get_field(),
+                                               fp, remaining)
+                except BaseException as e:  # noqa: BLE001 — abort the gen
+                    failed.append(f"{rid}: {type(e).__name__}: {e}")
+            job._trace = (f"engine-ckpt field {rid}", None)
+            return job
+
+        for r in (self._active_runners or ()):
+            mega = isinstance(r, MegaLaneRunner)
+            for lane, req in enumerate(r.occupant):
+                if req is None:
+                    continue
+                remaining = int(r.dev_rem[lane])
+                rec = self._by_id[req.id]
+                lane_s = (now - rec.get("_start_t", now)
+                          + rec.get("_resumed_lane_s", 0.0))
+                num = (self.numerics.export_state(req.id)
+                       if self.numerics is not None else None)
+                e = _entry(req, remaining, int(r.lane_chunks[lane]),
+                           lane_s, num)
+                if mega:
+                    snap = r.eng.final_snapshot()
+                    get_field = (lambda s=snap:
+                                 MegaLaneEngine.extract(s))
+                else:
+                    snap = r.eng.snapshot_lane(lane)
+                    get_field = (lambda eng=r.eng, s=snap, n=req.cfg.n:
+                                 eng.extract(s, n))
+                inflight_entries.append(e)
+                field_jobs.append(_field_job(req.id, e["fingerprint"],
+                                             remaining, get_field))
+        queued_entries: List[dict] = []
+        with self._lock:
+            queues = list(self._queues.values())
+            if self._mega_queue is not None:
+                queues.append(self._mega_queue)
+            queued_reqs = [q2 for q in queues for q2 in q.items()]
+        for req in sorted(queued_reqs, key=lambda q2: q2.seq):
+            rst = req.restore
+            if rst:
+                # a resumed request still waiting for a lane carries its
+                # checkpointed mid-solve field in host memory — persist
+                # it as an in-flight entry or its progress would be lost
+                e = _entry(req, int(rst["remaining"]),
+                           int(rst.get("chunks", 0)),
+                           float(rst.get("lane_s", 0.0)),
+                           rst.get("numerics"))
+                inflight_entries.append(e)
+                field_jobs.append(_field_job(
+                    req.id, e["fingerprint"], int(rst["remaining"]),
+                    lambda rst=rst: rst["T"]))
+            else:
+                e = _entry(req, req.cfg.ntime, 0, 0.0, None)
+                e.pop("numerics")
+                queued_entries.append(e)
+        with self._lock:
+            live = ({e["id"] for e in inflight_entries}
+                    | {e["id"] for e in queued_entries})
+            done = sorted(rid for rid in self._by_id if rid not in live)
+        manifest = {"kind": ckpt_mod.ENGINE_MANIFEST_KIND,
+                    "version": ckpt_mod.ENGINE_MANIFEST_VERSION,
+                    "generation": gen, "reason": reason,
+                    "boundaries": self.boundaries_total,
+                    "policy": self.scfg.policy,
+                    "inflight": inflight_entries,
+                    "queued": queued_entries,
+                    "done": done}
+
+        def manifest_job():
+            if failed:
+                master_print(
+                    f"engine checkpoint: generation {gen} ABORTED — "
+                    f"{len(failed)} lane field(s) failed to persist "
+                    f"({'; '.join(failed)}); the previous generation "
+                    f"remains the resume point")
+                return
+            path = ckpt_mod.save_engine_manifest(d, gen, manifest,
+                                                 plan=self._plan)
+            with self._lock:
+                self._engine_ckpt_gen = gen
+            json_record("engine_ckpt", generation=gen, reason=reason,
+                        path=str(path), boundaries=manifest["boundaries"],
+                        inflight=len(inflight_entries),
+                        queued=len(queued_entries), done=len(done))
+        manifest_job._trace = (f"engine-ckpt manifest gen {gen}", None)
+
+        writer = self._active_writer
+        if writer is not None:
+            for job in field_jobs:
+                writer.submit(job)
+            writer.submit(manifest_job)
+        else:
+            for job in field_jobs:
+                job()
+            manifest_job()
+
     # --- execution --------------------------------------------------------
     def run(self) -> List[dict]:
         """Drain every queued request through dispatch-ahead continuous
@@ -2423,6 +2736,10 @@ class Engine:
                     MegaLaneRunner(self, i, self._mega_queue, writer)
                     for i in range(min(self.mega_lanes,
                                        len(self._mega_queue)))]
+            # engine-state checkpointing reads the live runners + writer
+            # from the driving loop (scheduler-thread-confined)
+            self._active_runners = tuple(runners)
+            self._active_writer = writer
             if self.scfg.dispatch_depth == 0:
                 # synchronous debugging fallback: groups drain one at a
                 # time with a fence at every boundary (the PR-3 shape)
@@ -2434,6 +2751,9 @@ class Engine:
             else:
                 live = [r for r in runners if r.has_work()]
                 while live:
+                    # an armed engine checkpoint fires at the empty cut,
+                    # BEFORE the pipeline refills (see _ckpt_tick)
+                    self._ckpt_tick()
                     # prime every group's device queue before anyone
                     # blocks: one group's boundary D2H + bookkeeping then
                     # hides under the other groups' queued compute
@@ -2462,10 +2782,17 @@ class Engine:
             # not mask the scheduler error already propagating
             self._flight_dump(f"scheduler crashed: {type(e).__name__}: {e}")
             writer.drain(raise_errors=False)
+            self._active_runners, self._active_writer = (), None
             raise
+        # always-at-drain checkpoint (engine_ckpt_interval > 0 opts in):
+        # the batch's end state — every request done — becomes the newest
+        # generation, so a later --resume re-admits nothing twice
+        if self.scfg.engine_ckpt_interval > 0:
+            self._engine_checkpoint(reason="drain")
         # normal exit: per-request jobs swallow their own failures, so a
         # surviving writer error here is a real bug and must surface
         writer.drain()
+        self._active_runners, self._active_writer = (), None
         self._stamp_timing(Timing, wall_clock() - t0)
         if self.tracer.enabled:
             self.tracer.complete("engine.run", self.tracer.thread_track(),
@@ -2531,13 +2858,23 @@ class Engine:
             self._thread.start()
         return self
 
-    def begin_drain(self) -> None:
+    def begin_drain(self, handoff: bool = False) -> None:
         """Stop admission-by-policy: the online loop finishes every lane
         already admitted AND every request already queued, then exits.
         Callers gate *new* work themselves (the gateway 503s new solves
-        the moment draining flips). Idempotent."""
+        the moment draining flips). ``handoff=True`` is drain-to-
+        checkpoint (POST /drainz?handoff=1): the loop additionally stops
+        lane fills and chunk dispatch, takes the in-flight boundaries
+        already queued, checkpoints the whole engine at the first
+        empty-pipeline cut — WITHOUT waiting for lanes to finish — and
+        exits; ``serve --resume`` picks the work up where it stopped.
+        Idempotent, and a later plain drain never cancels a requested
+        handoff."""
         with self._cond:
             self._draining = True
+            if handoff:
+                self._handoff = True
+                self._ckpt_pause = True
             self._cond.notify_all()
 
     def shutdown(self, timeout: Optional[float] = None) -> bool:
@@ -2568,9 +2905,27 @@ class Engine:
         writer = async_io.SnapshotWriter(tracer=self.tracer)
         # bucket groups keyed by BucketKey; mega slots by ("mega-slot", i)
         runners: Dict[object, object] = {}
+        self._active_writer = writer
         t0 = wall_clock()
         try:
             while True:
+                if self._handoff:
+                    # drain-to-checkpoint: no fills, no new dispatch —
+                    # take only the boundaries already in flight, then
+                    # checkpoint at the first empty cut and exit. Lane
+                    # occupants stay status="running" (no terminal
+                    # records); they and the queue ride the manifest.
+                    self._active_runners = tuple(runners.values())
+                    busy = [r for r in runners.values() if r.inflight]
+                    for r in busy:
+                        try:
+                            r.process_boundary()
+                        except async_io.BoundedFetchTimeout as e:
+                            self._fail_group(r, e)
+                    if not any(r.inflight for r in runners.values()):
+                        self._engine_checkpoint(reason="handoff")
+                        break
+                    continue
                 with self._lock:
                     keys = [k for k, q in self._queues.items() if q]
                 for key in keys:
@@ -2595,6 +2950,8 @@ class Engine:
                                 self, i, self._mega_queue, writer)
                         else:
                             mr._fill()
+                self._active_runners = tuple(runners.values())
+                self._ckpt_tick()
                 live = [r for r in runners.values() if r.has_work()]
                 if not live:
                     with self._cond:
@@ -2623,6 +2980,11 @@ class Engine:
                             r.dispatch_fill()
                         except async_io.BoundedFetchTimeout as e:
                             self._fail_group(r, e)
+            # normal drain exit (the handoff exit checkpointed already,
+            # pre-break): an interval-opted engine always leaves a final
+            # generation at drain — the zero-downtime restart point
+            if self.scfg.engine_ckpt_interval > 0 and not self._handoff:
+                self._engine_checkpoint(reason="drain")
         except BaseException as e:  # noqa: BLE001 — surfaced via loop_error
             # a scheduler-loop crash in a daemon thread has nowhere to
             # propagate: record it (gateway /healthz + cmd_serve check it)
@@ -2639,6 +3001,7 @@ class Engine:
             try:
                 writer.drain(raise_errors=False)
             finally:
+                self._active_runners, self._active_writer = (), None
                 self._stamp_timing(Timing, wall_clock() - t0)
                 if self.tracer.enabled:
                     self.tracer.complete("serve-loop",
@@ -2662,9 +3025,13 @@ class Engine:
         now = wall_clock()
         with self._lock:
             start = rec.pop("_start_t", now)
-            rec["solve_s"] = round(now - start, 6)
-            rec["steps_per_s"] = (round(steps / (now - start), 3)
-                                  if now > start else None)
+            # a resumed request's first incarnation billed lane seconds
+            # too — fold the checkpointed partial in; steps_done already
+            # spans both incarnations (ntime - final remaining)
+            lane_s = (now - start) + rec.pop("_resumed_lane_s", 0.0)
+            rec["solve_s"] = round(lane_s, 6)
+            rec["steps_per_s"] = (round(steps / lane_s, 3)
+                                  if lane_s > 0 else None)
             rec["steps_done"] = steps
             rec["exit"] = exit_mode
             # the usage-ledger stamp (runtime/prof.py): what THIS request
@@ -2809,5 +3176,8 @@ class Engine:
                 "deadline_misses": self.deadline_misses,
                 "steady_exits": self.steady_exits,
                 "steps_saved": self.steps_saved_total,
+                "serve_resumed": self.serve_resumed_total,
+                "engine_ckpt_interval": self.scfg.engine_ckpt_interval,
+                "engine_ckpt_generation": self._engine_ckpt_gen,
                 "shed": self.shed,
                 "watchdog_fired": self.watchdog_fired}

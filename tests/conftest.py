@@ -12,6 +12,14 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+# The suite is CPU-pinned (chips are for perf, not tests) but the
+# chipless topology tests still construct against libtpu, and on a host
+# without a reachable GCP metadata server each construct burns ~30 HTTP
+# retries per metadata variable — one test then stalls for minutes at
+# ~0% CPU (the tunnel-wedge signature) and eats the tier-1 wall budget.
+# Topology descriptors don't need instance metadata; skip the queries.
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -63,3 +71,32 @@ def _no_leaked_serve_threads():
         f"test leaked live serving thread(s): {leaked} — call "
         f"Engine.shutdown() / Gateway.request_drain()+close() before "
         f"returning")
+
+
+# The repo root once accumulated 81 stray flightrec-*.trace.json dumps
+# from tests whose engines had neither flight_dir nor out_dir set (the
+# dump used to default to cwd). The dump now lands in out_dir or is
+# skipped; this guard keeps the litter from ever coming back.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_flightrec_litter():
+    """Fail any test that leaks a flight-recorder dump (or engine
+    checkpoint dir) outside its tmp_path into the repo root."""
+    import glob
+
+    def _strays():
+        return sorted(
+            glob.glob(os.path.join(_REPO_ROOT, "flightrec-*.trace.json"))
+            + glob.glob(os.path.join(_REPO_ROOT, "engine-ckpt", "*")))
+
+    before = set(_strays())
+    yield
+    leaked = [p for p in _strays() if p not in before]
+    for p in leaked:
+        os.unlink(p)   # clean up so ONE offender doesn't fail the rest
+    assert not leaked, (
+        f"test leaked dump(s) into the repo root: "
+        f"{[os.path.basename(p) for p in leaked]} — pass flight_dir/"
+        f"out_dir/engine_ckpt_dir pointing at tmp_path")
