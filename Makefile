@@ -9,8 +9,8 @@ SHELL := /bin/bash
         weak-scaling collective-overhead exchange-lab sharded3d-check sweep \
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
-        numerics-lab steady-lab lane-lab mega-lab resume-lab perfcheck \
-        native run viz clean
+        numerics-lab steady-lab lane-lab mega-lab resume-lab fleet-lab \
+        perfcheck native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -37,7 +37,7 @@ race:           # the dynamic race sanitizer over the chaos + serving
                 # raises RaceError and fails the suite
 	env JAX_PLATFORMS=cpu HEAT_TPU_RACECHECK=1 $(PY) -m pytest \
 	  tests/test_chaos.py tests/test_serve.py tests/test_gateway.py \
-	  -q -p no:cacheprovider
+	  tests/test_fleet.py -q -p no:cacheprovider
 
 lint:           # ruff when installed; syntax-level fallback otherwise
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
@@ -152,6 +152,13 @@ resume-lab:            # zero-downtime serving A/B: uninterrupted wave vs
                        # all 64 requests, zero re-stepped chunks, recovery
                        # overhead = one manifest load + lane reseed)
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_resume_lab.py
+
+fleet-lab:             # pod-scale fleet: 1/2/4 serve subprocesses behind
+                       # the router (>= 1.7x at 2 backends, monotone at
+                       # 4), SIGKILL drill with zero lost/duplicated
+                       # requests, forced checkpoint-handoff steal with
+                       # recovery overhead recorded
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_lab.py
 
 perfcheck:             # CI perf gate: fresh prof-lab vs committed baseline
                        # (tolerance band) + every committed lab's internal

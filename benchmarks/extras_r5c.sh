@@ -168,6 +168,15 @@ phase serve_steady_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_steady
 # boundary, recovery overhead = one manifest load + lane reseed.
 # CPU-world: runs with the tunnel down.
 phase serve_resume_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_resume_lab.py
+# Pod-scale fleet lab (ISSUE 18): the 64-request wave drained through
+# the fleet router over 1/2/4 real serve subprocesses (each request
+# carrying a 200 ms writer-sink sleep so per-engine serialization makes
+# fleet width measurable on one core) — gates >= 1.7x aggregate
+# throughput at 2 backends and monotone at 4, plus a SIGKILL drill
+# (zero lost / zero double-served via checkpoint adoption) and a forced
+# /drainz?handoff=1 steal with its recovery wall recorded. CPU-world:
+# runs with the tunnel down.
+phase fleet_lab        1200 env JAX_PLATFORMS=cpu python benchmarks/fleet_lab.py
 # Invariant guard (ISSUE 11 + 14): lint + the project-native
 # static-analysis suite (hot-path purity, lock discipline, traced-code
 # determinism, Mosaic kernel safety, race lockset inference) + the

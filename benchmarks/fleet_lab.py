@@ -1,0 +1,449 @@
+"""Fleet-router scaling + chaos lab: 1/2/4 CPU backends behind one router.
+
+Four claims, one harness (ISSUE 18):
+
+- **Scaling**: the serve_lab 64-request population, each request carrying
+  ``inject: sink-slow:ms=200`` (a writer-sink sleep — the CPU-world
+  stand-in for the per-request device/IO time a one-core host cannot
+  otherwise exhibit; results are untouched), drained through the router
+  over 1 vs 2 vs 4 backend PROCESSES. Per-engine the sink serializes, so
+  aggregate throughput scales with the fleet: the committed gate is
+  >= 1.7x at 2 backends and monotone (no worse) at 4.
+- **Bit-identity**: a sample of the fleet's npz outputs must be
+  byte-identical to solo in-process solves — the router routes, it never
+  does arithmetic.
+- **Kill drill**: at 2 backends, one backend process is SIGKILLed
+  mid-wave. The router's probe sees the loss, flight-dumps its fleet
+  timeline, adopts the victim's engine-checkpoint manifest onto the
+  survivor and re-drives the rest — the gate is all 64 requests reach a
+  terminal ok record with zero lost and zero double-delivered.
+- **Steal overhead**: a forced ``/drainz?handoff=1`` checkpoint-handoff
+  steal from a loaded backend to an idle one, recording the end-to-end
+  recovery wall (drain + manifest pickup + resume) and how many
+  requests migrated mid-flight.
+
+Backends are real ``heat-tpu serve`` subprocesses on localhost ports;
+the router runs in-process so its counters/steal events are directly
+inspectable. Walls are measured from first POST with every backend
+already probed healthy (process spin-up and compile warming are paid
+before the clock starts — serving latency, not cold-start latency).
+
+    JAX_PLATFORMS=cpu python benchmarks/fleet_lab.py [--requests 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+from _util import write_atomic
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+LISTEN_RE = re.compile(r"listening on http://([0-9.]+):(\d+)")
+SINK_MS = 200
+
+
+class BackendProc:
+    """One ``heat-tpu serve`` subprocess; stdout goes to a log file we
+    poll for the bound port (--listen 127.0.0.1:0)."""
+
+    def __init__(self, name: str, workdir: Path, env: dict):
+        self.name = name
+        self.dir = workdir / name
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.log = self.dir / "serve.log"
+        cmd = [sys.executable, "-m", "heat_tpu", "serve",
+               "--listen", "127.0.0.1:0",
+               "--lanes", "4", "--chunk", "16", "--buckets", "32,48",
+               "--out-dir", str(self.dir),
+               "--engine-ckpt-interval", "2",
+               "--engine-ckpt-dir", str(self.dir / "ckpt")]
+        self.proc = subprocess.Popen(cmd, stdout=self.log.open("wb"),
+                                     stderr=subprocess.STDOUT, env=env,
+                                     cwd=str(REPO))
+        self.address = None
+
+    def wait_address(self, timeout: float = 180.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"backend {self.name} exited rc={self.proc.returncode}:"
+                    f"\n{self.log.read_text()[-2000:]}")
+            m = LISTEN_RE.search(self.log.read_text(errors="replace"))
+            if m:
+                self.address = f"{m.group(1)}:{m.group(2)}"
+                return self.address
+            time.sleep(0.2)
+        raise RuntimeError(f"backend {self.name} never bound a port")
+
+    def wait_healthy(self, timeout: float = 60.0) -> None:
+        host, _, port = self.address.rpartition(":")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                conn = http.client.HTTPConnection(host, int(port),
+                                                  timeout=5)
+                conn.request("GET", "/healthz")
+                ok = conn.getresponse().status == 200
+                conn.close()
+                if ok:
+                    return
+            except OSError:
+                pass
+            time.sleep(0.2)
+        raise RuntimeError(f"backend {self.name} never went healthy")
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=30)
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.kill()
+
+
+def build_lines(count: int, prefix: str, sink_ms: int = SINK_MS):
+    """The serve_lab population as request lines, each carrying the
+    writer-sink sleep that models per-request device/IO time."""
+    from serve_lab import build_requests
+
+    lines = []
+    for i, cfg in enumerate(build_requests(count)):
+        lines.append({"id": f"{prefix}-r{i}", "n": cfg.n,
+                      "ntime": cfg.ntime, "dtype": cfg.dtype,
+                      "bc": cfg.bc, "ic": cfg.ic, "nu": cfg.nu,
+                      "inject": f"sink-slow:ms={sink_ms}"})
+    return lines
+
+
+def post_stream(rt, lines, timeout: float = 600.0):
+    """One streaming POST through the router; returns the terminal
+    records (the wall the caller measures around this IS the wave)."""
+    body = "".join(json.dumps(ln) + "\n" for ln in lines).encode()
+    conn = http.client.HTTPConnection(rt.host, rt.port, timeout=timeout)
+    conn.request("POST", "/v1/solve", body=body)
+    resp = conn.getresponse()
+    recs = []
+    while True:
+        raw = resp.readline()
+        if not raw:
+            break
+        raw = raw.strip()
+        if raw:
+            recs.append(json.loads(raw))
+    conn.close()
+    return recs
+
+
+def make_router(addresses, **fcfg_kw):
+    from heat_tpu.fleet.registry import BackendRegistry, parse_backends
+    from heat_tpu.fleet.router import FleetConfig, Router
+
+    spec = ",".join(f"{n}={a}" for n, a in addresses)
+    fcfg_kw.setdefault("health_interval_s", 0.5)
+    rt = Router(BackendRegistry(parse_backends(spec)), "127.0.0.1", 0,
+                FleetConfig(**fcfg_kw))
+    return rt.start()
+
+
+def warm_backend(b, lines, timeout: float = 300.0):
+    """Pay a backend's bucket compiles before any timed wave: a short
+    sink-free wave POSTed DIRECTLY to it (the shared JAX compilation
+    cache makes every backend after the first a cache hit)."""
+    host, _, port = b.address.rpartition(":")
+    body = "".join(json.dumps(dict(ln, id=f"{b.name}-{ln['id']}")) + "\n"
+                   for ln in lines).encode()
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request("POST", "/v1/solve", body=body)
+    resp = conn.getresponse()
+    while resp.readline():
+        pass
+    conn.close()
+
+
+def run_wave(backends, lines):
+    """Drain the wave through a fresh router over already-warm backends;
+    returns (wall_s, records, snapshot)."""
+    rt = make_router([(b.name, b.address) for b in backends])
+    try:
+        time.sleep(1.2)   # a probe round: status payloads for placement
+        t0 = time.perf_counter()
+        recs = post_stream(rt, lines)
+        wall = time.perf_counter() - t0
+        snap = rt.snapshot()
+    finally:
+        rt.close()
+    return wall, recs, snap
+
+
+def check_sample(backends, lines, sample_idx):
+    """npz byte-identity: fleet outputs vs solo in-process solves."""
+    import numpy as np
+
+    from heat_tpu.backends import solve
+    from heat_tpu.config import HeatConfig
+
+    for i in sample_idx:
+        ln = dict(lines[i])
+        rid = ln.pop("id")
+        ln.pop("inject", None)
+        paths = [b.dir / f"{rid}.npz" for b in backends
+                 if (b.dir / f"{rid}.npz").exists()]
+        if len(paths) != 1:
+            return False
+        with np.load(paths[0]) as z:
+            got = z["T"]
+        if not np.array_equal(got, solve(HeatConfig(**ln)).T):
+            return False
+    return True
+
+
+def kill_drill(backends, lines, flight_dir):
+    """SIGKILL one of two backends mid-wave; the router must recover the
+    victim's checkpointed work onto the survivor and still deliver every
+    request exactly once."""
+    rt = make_router([(b.name, b.address) for b in backends],
+                     flightrec_dir=str(flight_dir))
+    try:
+        time.sleep(1.2)
+        recs = []
+        t0 = time.perf_counter()
+        waver = threading.Thread(
+            target=lambda: recs.extend(post_stream(rt, lines)))
+        waver.start()
+        # kill the victim once it is genuinely mid-wave (several sink
+        # sleeps deep, checkpoints on disk)
+        time.sleep(2.5)
+        backends[0].kill()
+        waver.join(timeout=600)
+        wall = time.perf_counter() - t0
+        snap = rt.snapshot()
+        assert not waver.is_alive(), "kill-drill wave never finished"
+    finally:
+        rt.close()
+    statuses = [r.get("status") for r in recs]
+    ids = [r.get("id") for r in recs]
+    return {
+        "wall_s": round(wall, 3),
+        "records": len(recs),
+        "ok": statuses.count("ok"),
+        "zero_lost": (sorted(ids) == sorted(ln["id"] for ln in lines)
+                      and statuses.count("ok") == len(lines)),
+        "zero_duplicates": (snap["router"]["duplicates"] == 0
+                            and len(ids) == len(set(ids))),
+        "victim_recovered": snap["backends"][backends[0].name]["lost"],
+        "flight_dumps": len(list(Path(flight_dir).glob(
+            "flightrec-*.trace.json"))),
+    }
+
+
+def steal_drill(victim, thief, lines, workdir):
+    """Forced checkpoint-handoff steal from a loaded backend to an idle
+    one; records the end-to-end recovery wall."""
+    bfile = workdir / "steal_backends.txt"
+    bfile.write_text(f"{victim.name}={victim.address}\n")
+    from heat_tpu.fleet.registry import BackendRegistry
+    from heat_tpu.fleet.router import FleetConfig, Router
+
+    rt = Router(BackendRegistry(backends_file=bfile), "127.0.0.1", 0,
+                FleetConfig(health_interval_s=0.3)).start()
+    try:
+        time.sleep(0.8)
+        body = "".join(json.dumps(ln) + "\n" for ln in lines).encode()
+        conn = http.client.HTTPConnection(rt.host, rt.port, timeout=60)
+        conn.request("POST", "/v1/solve?wait=0", body=body)
+        assert conn.getresponse().status == 202
+        conn.close()
+        time.sleep(1.5)   # victim mid-wave on the sink-slow work
+        bfile.write_text(f"{victim.name}={victim.address}\n"
+                         f"{thief.name}={thief.address}\n")
+        deadline = time.monotonic() + 30
+        while (rt.registry.get(thief.name) is None
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        ev = rt.steal(victim.name, thief.name, reason="lab")
+        assert ev is not None, "steal refused"
+        deadline = time.monotonic() + 600
+        while rt.pending_count() and time.monotonic() < deadline:
+            time.sleep(0.25)
+        ok = 0
+        for ln in lines:
+            conn = http.client.HTTPConnection(rt.host, rt.port,
+                                              timeout=30)
+            conn.request("GET", f"/v1/requests/{ln['id']}")
+            resp = conn.getresponse()
+            rec = json.loads(resp.read())
+            conn.close()
+            ok += resp.status == 200 and rec.get("status") == "ok"
+    finally:
+        rt.close()
+    return {
+        "recovered_requests": ev["recovered"],
+        "redriven_requests": ev["redriven"],
+        "recovery_s": ev["wall_s"],
+        "drain_s": ev["drain_s"],
+        "resume_s": ev["resume_s"],
+        "generation": ev["generation"],
+        "all_ok": ok == len(lines),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--sink-ms", type=int, default=SINK_MS)
+    ap.add_argument("--out", default=str(Path(__file__).parent
+                                         / "fleet_lab.json"))
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: a fresh TemporaryDirectory)")
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    tmp = None
+    if args.workdir:
+        workdir = Path(args.workdir)
+        workdir.mkdir(parents=True, exist_ok=True)
+    else:
+        tmp = tempfile.TemporaryDirectory(prefix="heat-tpu-fleet-lab-")
+        workdir = Path(tmp.name)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   str(workdir / "jax-cache"))
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+
+    from serve_lab import build_requests
+
+    work = sum(cfg.points * cfg.ntime
+               for cfg in build_requests(args.requests))
+    # a short sink-free wave covering all three sides pays each
+    # backend's bucket compiles before any timed wave
+    warmup = [dict(ln, inject="") for ln in build_lines(6, "w", sink_ms=0)]
+
+    print(f"fleet_lab: starting 4 scaling + 2 kill-drill + 1 steal "
+          f"backend processes under {workdir}", flush=True)
+    fleet = [BackendProc(f"s{i}", workdir, env) for i in range(4)]
+    killers = [BackendProc(f"k{i}", workdir, env) for i in range(2)]
+    stealers = [BackendProc("victim", workdir, env)]
+    everyone = fleet + killers + stealers
+    rec = {}
+    try:
+        for b in everyone:
+            b.wait_address()
+        for b in everyone:
+            b.wait_healthy()
+        for b in everyone:
+            warm_backend(b, warmup)
+
+        walls, scaling = {}, {}
+        sample = sorted({0, args.requests // 2, args.requests - 1})
+        bit_identical = True
+        for nb in (1, 2, 4):
+            lines = build_lines(args.requests, f"f{nb}",
+                                sink_ms=args.sink_ms)
+            wall, recs, snap = run_wave(fleet[:nb], lines)
+            per_backend = {n: b["delivered"]
+                           for n, b in snap["backends"].items()}
+            oks = sum(r.get("status") == "ok" for r in recs)
+            walls[nb] = wall
+            scaling[f"fleet_{nb}"] = {
+                "wall_s": round(wall, 3),
+                "points_per_s": round(work / wall, 1),
+                "ok": oks, "records": len(recs),
+                "per_backend_delivered": per_backend,
+                "retries": snap["router"]["retries"],
+            }
+            print(f"fleet_lab: F={nb} wall {wall:.2f}s ok {oks}/"
+                  f"{len(lines)} split {per_backend}", flush=True)
+            assert oks == len(lines), scaling[f"fleet_{nb}"]
+            if nb == 2:
+                bit_identical = check_sample(fleet[:nb], lines, sample)
+
+        kill = kill_drill(killers,
+                          build_lines(args.requests, "kd",
+                                      sink_ms=args.sink_ms),
+                          workdir / "flightrec")
+        print(f"fleet_lab: kill drill {kill}", flush=True)
+        # double the sink on a deeper wave so the victim is genuinely
+        # mid-flight when the steal fires (lanes occupied + queue work
+        # for the manifest to cover — the drill must migrate, not mop up)
+        steal = steal_drill(stealers[0], fleet[0],
+                            build_lines(16, "st",
+                                        sink_ms=2 * args.sink_ms),
+                            workdir)
+        print(f"fleet_lab: steal drill {steal}", flush=True)
+
+        speedup2 = walls[1] / walls[2] if walls[2] > 0 else None
+        speedup4 = walls[1] / walls[4] if walls[4] > 0 else None
+        rec = {
+            "bench": "fleet_lab",
+            "config": {"requests": args.requests,
+                       "sink_ms": args.sink_ms,
+                       "population": "serve_lab sides 24/32/48",
+                       "backend": "heat-tpu serve subprocess, lanes 4, "
+                                  "chunk 16, buckets (32,48), "
+                                  "engine-ckpt-interval 2",
+                       "policy": "least-loaded"},
+            "work_cell_steps": work,
+            "scaling": scaling,
+            "speedup_2_backends": round(speedup2, 2) if speedup2 else None,
+            "speedup_4_backends": round(speedup4, 2) if speedup4 else None,
+            "monotone_at_4": bool(walls[4] <= walls[2]),
+            "fleet_bit_identical": bool(bit_identical),
+            "kill_drill": kill,
+            "kill_zero_lost": bool(kill["zero_lost"]),
+            "kill_zero_duplicates": bool(kill["zero_duplicates"]),
+            "steal_drill": steal,
+            "steal_recovered_requests": steal["recovered_requests"],
+            "steal_recovery_s": steal["recovery_s"],
+        }
+    finally:
+        for b in everyone:
+            b.stop()
+        if tmp is not None:
+            tmp.cleanup()
+
+    write_atomic(Path(args.out), rec)
+    print(json.dumps(rec, indent=2))
+    passed = (rec["speedup_2_backends"] is not None
+              and rec["speedup_2_backends"] >= 1.7
+              and rec["monotone_at_4"]
+              and rec["fleet_bit_identical"]
+              and rec["kill_zero_lost"]
+              and rec["kill_zero_duplicates"]
+              and rec["steal_recovered_requests"] >= 1
+              and steal["all_ok"]
+              and kill["victim_recovered"]
+              and kill["flight_dumps"] >= 1)
+    print(f"fleet_lab: {'OK' if passed else 'FAILED'} — 2-backend "
+          f"speedup {rec['speedup_2_backends']}x (gate >= 1.7), 4-backend "
+          f"{rec['speedup_4_backends']}x monotone={rec['monotone_at_4']}; "
+          f"kill drill lost=0:{rec['kill_zero_lost']} "
+          f"dup=0:{rec['kill_zero_duplicates']}; steal moved "
+          f"{rec['steal_recovered_requests']} mid-flight + "
+          f"{steal['redriven_requests']} re-driven in "
+          f"{rec['steal_recovery_s']}s")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,8 +37,11 @@ from typing import Dict, List, Optional, Tuple
 
 from .core import Context, Violation, attr_chain, dotted, register
 
-# rank table (mirrors runtime/debug.LOCK_RANKS)
-RANKS = {"gateway": 0, "engine": 10, "writer": 20, "observatory": 30}
+# rank table (mirrors runtime/debug.LOCK_RANKS): the fleet router is
+# outermost in every request path — fleet < gateway < engine < writer <
+# observatory
+RANKS = {"fleet": -10, "gateway": 0, "engine": 10, "writer": 20,
+         "observatory": 30}
 
 # lock-expression classification: (path suffix the file must match,
 # attribute-chain suffix of the with-item expression) -> rank name.
@@ -48,6 +51,8 @@ LOCK_EXPRS: List[Tuple[str, Tuple[str, ...], str]] = [
     ("serve/scheduler.py", ("_lock",), "engine"),
     ("serve/scheduler.py", ("_cond",), "engine"),
     ("serve/gateway.py", ("_drain_lock",), "gateway"),
+    ("fleet/router.py", ("_lock",), "fleet"),
+    ("fleet/registry.py", ("_lock",), "fleet"),
     ("runtime/prof.py", ("_lock",), "observatory"),
     ("runtime/prof.py", ("_COMPILE_LOG_LOCK",), "observatory"),
     ("runtime/trace.py", ("_lock",), "observatory"),
@@ -115,7 +120,8 @@ def _call_rank(node: ast.Call) -> Optional[str]:
 def check(ctx: Context) -> List[Violation]:
     out: List[Violation] = []
     for src in ctx.sources:
-        if not ("serve/" in src.rel or "runtime/" in src.rel):
+        if not ("serve/" in src.rel or "runtime/" in src.rel
+                or "fleet/" in src.rel):
             continue
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.With):

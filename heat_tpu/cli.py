@@ -383,6 +383,85 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--json", action="store_true",
                        help="also print a machine-readable summary line")
 
+    fleet = sub.add_parser(
+        "fleet",
+        help="pod-scale fleet router: one stdlib-HTTP front end over N "
+             "independent `heat-tpu serve --listen` gateways — edge "
+             "admission, burn-aware least-loaded placement fed from "
+             "each backend's GET /v1/status, fleet-wide /metrics + "
+             "/statusz + /v1/usage, health probes with retry-on-"
+             "alternate, and checkpoint-handoff work stealing "
+             "(drain a loaded backend to its engine manifest, resume "
+             "it on an idle one — bit-identical bytes across the "
+             "migration)")
+    fleet.add_argument("--backends", metavar="[NAME=]HOST:PORT,...",
+                       help="comma-separated backend gateways (each a "
+                            "`heat-tpu serve --listen` process); unnamed "
+                            "entries get positional names b0,b1,...")
+    fleet.add_argument("--backends-file", dest="backends_file",
+                       metavar="FILE",
+                       help="backend registry file: one [name=]host:port "
+                            "per line, '#' comments; re-read when its "
+                            "mtime changes, so new backends join the "
+                            "fleet live (removing a line never evicts a "
+                            "live backend)")
+    fleet.add_argument("--listen", default="127.0.0.1:0",
+                       metavar="HOST:PORT",
+                       help="router bind address (default 127.0.0.1:0 = "
+                            "ephemeral port, printed)")
+    fleet.add_argument("--fleet-policy", dest="fleet_policy",
+                       choices=["least-loaded", "round-robin"],
+                       default="least-loaded",
+                       help="placement policy: 'least-loaded' (default) "
+                            "ranks by predicted backlog seconds (cost "
+                            "model x queue work) with burn-aware "
+                            "demotion and mega-capability routing; "
+                            "'round-robin' is the A/B baseline")
+    fleet.add_argument("--health-interval", dest="health_interval",
+                       type=float, default=2.0, metavar="S",
+                       help="health-probe cadence: GET /healthz + "
+                            "/v1/status per backend every S seconds "
+                            "(default 2)")
+    fleet.add_argument("--steal-threshold", dest="steal_threshold",
+                       type=float, default=0.0, metavar="S",
+                       help="work-stealing imbalance threshold in "
+                            "predicted-backlog seconds: when "
+                            "max-min exceeds S and the victim has "
+                            "queued work, the router drains the victim "
+                            "to a checkpoint (/drainz?handoff=1) and "
+                            "resumes its manifest on the idlest backend "
+                            "(default 0 = automatic stealing off)")
+    fleet.add_argument("--steal-cooldown", dest="steal_cooldown",
+                       type=float, default=10.0, metavar="S",
+                       help="minimum seconds between automatic steals "
+                            "(thrash guard; default 10)")
+    fleet.add_argument("--ckpt-root", dest="ckpt_root", metavar="DIR",
+                       help="fallback checkpoint root: backend NAME's "
+                            "engine manifests under DIR/NAME when its "
+                            "status payload names no checkpoint dir "
+                            "(default: trust each backend's "
+                            "--engine-ckpt-dir as reported)")
+    fleet.add_argument("--inject", metavar="SPEC",
+                       help="fleet-scoped deterministic fault injection "
+                            "(runtime/faults.py grammar): "
+                            "backend-down@N[:backend=K] drops the TCP "
+                            "target at the Nth forwarded request "
+                            "(K names a backend; default = whichever "
+                            "was chosen); backend-slow:ms=M sleeps "
+                            "every forward M ms")
+    fleet.add_argument("--trace", metavar="FILE",
+                       help="export the ROUTER's event ring at drain: "
+                            "forward spans + synthesized backend solve "
+                            "spans per backend track — one fleet "
+                            "timeline (also GET /tracez live)")
+    fleet.add_argument("--trace-buffer", dest="trace_buffer", type=int,
+                       metavar="N",
+                       help="router event-ring capacity (default "
+                            f"{trace_mod.DEFAULT_BUFFER}); the ring is "
+                            "flight-dumped on backend loss; 0 disables")
+    fleet.add_argument("--json", action="store_true",
+                       help="also print a machine-readable summary line")
+
     usage = sub.add_parser(
         "usage",
         help="per-tenant usage ledger: render lane-seconds / steps / "
@@ -970,6 +1049,78 @@ def cmd_serve(args) -> int:
     return 0 if ok == summary["requests"] else 1
 
 
+def cmd_fleet(args) -> int:
+    """Run the fleet router (heat_tpu/fleet) until drained: the pod-
+    scale front end over N ``heat-tpu serve --listen`` backends. The
+    router itself never touches a device — it is pure stdlib HTTP +
+    placement math, so it runs happily on the smallest host in the
+    pod."""
+    import time
+
+    from .config import parse_listen
+    from .fleet.registry import BackendRegistry, parse_backends
+    from .fleet.router import FleetConfig, Router
+
+    if not args.backends and not args.backends_file:
+        print("error: need --backends HOST:PORT,... and/or "
+              "--backends-file FILE", file=sys.stderr)
+        return 2
+    try:
+        listen = parse_listen(args.listen)
+        backends = parse_backends(args.backends) if args.backends else []
+        trace_path, trace_cap = trace_mod.resolve_trace(args.trace,
+                                                        args.trace_buffer)
+        fcfg = FleetConfig(policy=args.fleet_policy,
+                           health_interval_s=args.health_interval,
+                           steal_threshold_s=args.steal_threshold,
+                           steal_cooldown_s=args.steal_cooldown,
+                           ckpt_root=args.ckpt_root,
+                           inject=args.inject or "",
+                           trace_buffer=trace_cap)
+        registry = BackendRegistry(backends,
+                                   backends_file=args.backends_file)
+        if not registry.snapshot():
+            raise ValueError("no backends: the --backends flag and the "
+                             "--backends-file are both empty")
+        rt = Router(registry, listen[0], listen[1], fcfg).start()
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    names = ", ".join(f"{b.name}={b.address}" for b in registry.snapshot())
+    master_print(f"fleet router listening on http://{rt.address} — "
+                 f"POST /v1/solve routes across [{names}] "
+                 f"(policy {fcfg.policy}, steal threshold "
+                 f"{fcfg.steal_threshold_s or 'off'}); GET /metrics "
+                 f"/statusz /v1/status /v1/usage /tracez; POST /drainz "
+                 f"stops admission")
+    try:
+        while not rt.draining:
+            time.sleep(0.25)
+        # admission stopped: let in-flight streams finish
+        deadline = time.monotonic() + fcfg.stream_timeout_s
+        while rt.pending_count() and time.monotonic() < deadline:
+            time.sleep(0.25)
+    except KeyboardInterrupt:
+        master_print("fleet: interrupt — admission stopped (backends "
+                     "keep their in-flight work; drain them "
+                     "individually)")
+        rt.request_drain()
+    snap = rt.snapshot()
+    if trace_path:
+        rt.tracer.export(trace_path)
+        master_print(f"wrote trace {trace_path} (open in Perfetto; "
+                     f"summary: heat-tpu trace {trace_path})")
+    r = snap["router"]
+    master_print(f"fleet: drained — {r['requests']} routed, "
+                 f"{r['edge_rejected']} rejected at the edge, "
+                 f"{r['retries']} batch retries, {len(r['steals'])} "
+                 f"steal(s), {r['lost']} backend(s) lost")
+    if args.json:
+        print(json.dumps({"event": "fleet_summary", **r}, sort_keys=True))
+    rt.close()
+    return 0
+
+
 def cmd_usage(args) -> int:
     """Render the per-tenant usage ledger as a table (or raw JSON) from
     either a running gateway's ``GET /v1/usage`` or a saved stream of
@@ -1139,7 +1290,15 @@ def cmd_perfcheck(args) -> int:
             ("serve_resume_lab.json",
              (("resumed_bit_identical", lambda v: v is True),
               ("zero_resteps", lambda v: v is True),
-              ("resumed_requests_recovered", lambda v: v is True)))):
+              ("resumed_requests_recovered", lambda v: v is True))),
+            ("fleet_lab.json",
+             (("speedup_2_backends", lambda v: (v or 0) >= 1.7),
+              ("monotone_at_4", lambda v: v is True),
+              ("fleet_bit_identical", lambda v: v is True),
+              ("kill_zero_lost", lambda v: v is True),
+              ("kill_zero_duplicates", lambda v: v is True),
+              ("steal_recovered_requests", lambda v: (v or 0) >= 1),
+              ("steal_recovery_s", lambda v: v is not None)))):
         p = bdir / fname
         if not p.exists():
             check(False, fname, "committed artifact missing")
@@ -2046,6 +2205,22 @@ def cmd_info(_args) -> int:
           f"handoff); corrupt manifests quarantine + fall back one "
           f"generation")
 
+    # fleet serving (ISSUE 18): the pod-scale half — one router process
+    # over N gateways; the dynamic story (placements, steals, lost
+    # backends) lives on the router's /metrics and /statusz
+    from .fleet.placement import (BURN_THRESHOLD as _burn_thr,
+                                  POLICIES as _fleet_policies)
+
+    print(f"fleet serving: heat-tpu fleet --backends host:port,... — "
+          f"edge admission + placement over per-backend GET /v1/status "
+          f"(policies {'|'.join(_fleet_policies)}; burn demotion at "
+          f"fast&slow > {_burn_thr:g}, mega-capability routing), "
+          f"health probes with retry-on-alternate, fleet-wide /metrics "
+          f"/statusz /v1/usage, checkpoint-handoff work stealing "
+          f"(--steal-threshold S; /drainz?handoff=1 -> POST /v1/resume "
+          f"on the idlest backend, bit-identical); gate "
+          f"benchmarks/fleet_lab.json")
+
     # invariant guard (ISSUE 11): the static-analysis suite's static
     # half — rule families, committed schema registry population, and
     # whether THIS process's locks were built with the dynamic
@@ -2141,6 +2316,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "launch": cmd_launch, "plan": cmd_plan, "serve": cmd_serve,
             "bench": cmd_bench, "calibrate": cmd_calibrate,
             "trace": cmd_trace, "usage": cmd_usage, "check": cmd_check,
+            "fleet": cmd_fleet,
             "audit": cmd_audit,
             "perfcheck": cmd_perfcheck}[args.command](args)
 

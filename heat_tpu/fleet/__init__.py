@@ -1,0 +1,16 @@
+"""Pod-scale fleet serving: a router in front of many engine gateways.
+
+``heat-tpu fleet`` runs a stdlib-HTTP router (``router.py``) over a
+:class:`~.registry.BackendRegistry` of independent ``heat-tpu serve``
+processes. Placement is a pure policy (``placement.py``) over each
+backend's ``GET /v1/status`` control payload — least-loaded by predicted
+backlog seconds, burn-aware demotion, mega-capability routing — and
+rebalancing is **work stealing as checkpoint handoff**: drain a loaded
+backend to its engine manifest, resume it on an idle one, bit-identical
+bytes across the migration.
+
+Import the pieces from their modules (``fleet.router``,
+``fleet.registry``, ``fleet.placement``); this package init stays
+import-light so ``heat_tpu.fleet.placement`` unit tests never pull the
+HTTP stack.
+"""

@@ -1,0 +1,1221 @@
+"""The fleet router: one stdlib-HTTP process in front of N engine gateways.
+
+``heat-tpu fleet --backends host:port,... --listen HOST:PORT`` runs this
+in front of independent ``heat-tpu serve --listen`` processes. The
+router is the pod-scale half of the ROADMAP's north star: admission
+moves to the edge, placement becomes a policy over live backend status,
+and the PR-17 drain-to-checkpoint machinery becomes a **work-stealing
+migration primitive** between backends.
+
+- ``POST /v1/solve`` — the same NDJSON front door every gateway has.
+  The router validates each line with ``parse_request_obj`` (edge
+  admission: malformed lines are rejected here and never travel),
+  mints/echoes ``X-Trace-Id``, picks a backend per request via the
+  placement policy (fleet/placement.py) fed from each gateway's
+  ``GET /v1/status`` control payload, forwards per-backend batches, and
+  streams every backend's chunked ndjson records back to the caller as
+  they land — one merged stream, exactly-once per request id.
+- **Retry-on-alternate**: a forward that provably never reached
+  admission (connect refused/reset, 503 draining, 429 all-shed) is
+  re-placed on the next-best backend; only when every backend refuses
+  does the client see a terminal rejection record (error
+  ``unroutable:``/``overloaded:`` — the router-502-vs-backend-429
+  distinction TROUBLESHOOTING.md documents).
+- **Checkpoint-handoff work stealing**: when the imbalance estimator
+  sees one backend's predicted backlog exceed ``--steal-threshold``
+  seconds while another idles, the router POSTs ``/drainz?handoff=1``
+  to the victim, waits for the engine manifest generation to land in
+  the victim's checkpoint dir, and re-drives the orphaned queued +
+  in-flight work through ``resume_engine``'s skip-set front door on the
+  idle backend (``POST /v1/resume``) — mid-flight lanes continue at
+  their last checkpointed boundary, bit-identical bytes across the
+  migration (tests/test_fleet.py proves it). The same path recovers a
+  backend that dies outright: manifest-covered work resumes, the rest
+  re-drives fresh (deterministic solver — same bytes either way), and
+  the delivered-set dedup guarantees no double-served ids.
+- Fleet-wide ``/metrics`` + ``/statusz`` + ``/v1/usage`` aggregation
+  with per-backend labels; ``/v1/usage`` merges the per-engine ledgers
+  so fleet totals reconcile exactly with per-backend billing.
+- ``/tracez`` — the router's OWN Tracer: forward spans per backend
+  track, synthesized backend-side solve spans from each record's
+  ``solve_s`` + ``trace_id``, so ``heat-tpu trace`` renders one fleet
+  timeline; the ring is flight-dumped when a backend is lost.
+
+Threading model mirrors the gateway: handler threads (admission +
+client streaming), one relay thread per forwarded batch, one health/
+imbalance thread, recovery/steal threads spawned on demand, pollers
+for resumed orphans. All router tables live under one fleet-rank lock
+(``runtime/debug.LOCK_RANKS``: fleet < gateway < engine — the router is
+outermost in every request path); backend state lives under the
+registry's own fleet-rank lock, and the two NEVER nest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import queue as queue_lib
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from ..runtime import checkpoint as ckpt_mod
+from ..runtime import debug
+from ..runtime import faults
+from ..runtime import trace as trace_mod
+from ..runtime.logging import json_record, master_print
+from ..serve.api import parse_request_obj
+from ..serve.gateway import MAX_BODY_BYTES, _TRACE_ID_RE
+from ..serve.scheduler import TERMINAL_STATUSES
+from . import placement
+from .registry import BackendRegistry
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Router-level knobs (per-backend engine knobs live with each
+    ``heat-tpu serve`` process)."""
+
+    policy: str = "least-loaded"   # placement policy (fleet/placement.py)
+    health_interval_s: float = 2.0  # /healthz + /v1/status probe cadence
+    steal_threshold_s: float = 0.0  # imbalance estimator: steal when
+                                    # max-min predicted backlog exceeds
+                                    # this many seconds (0 = stealing
+                                    # off; forced steals via Router.steal
+                                    # still work)
+    steal_cooldown_s: float = 10.0  # min wall between automatic steals
+                                    # (thrash guard — TROUBLESHOOTING.md)
+    steal_timeout_s: float = 60.0   # drain-to-manifest wait bound
+    ckpt_root: Optional[str] = None  # fallback checkpoint root: backend
+                                    # K's manifests under <root>/<K> when
+                                    # its status payload names no dir
+    inject: str = ""                # fleet fault spec (backend-down /
+                                    # backend-slow; runtime/faults.py)
+    retry_after_s: float = 1.0
+    connect_timeout_s: float = 5.0
+    stream_timeout_s: float = 600.0
+    flightrec_dir: str = "."        # backend-loss flight dumps land here
+    trace_buffer: int = trace_mod.DEFAULT_BUFFER
+    quiet: bool = True
+
+
+class Router:
+    """The long-running fleet front-end over a :class:`BackendRegistry`.
+
+    >>> reg = BackendRegistry(parse_backends("127.0.0.1:8001,127.0.0.1:8002"))
+    >>> rt = Router(reg, "127.0.0.1", 0).start()
+    >>> rt.address
+    >>> rt.close()
+    """
+
+    def __init__(self, registry: BackendRegistry, host: str = "127.0.0.1",
+                 port: int = 0, fcfg: Optional[FleetConfig] = None):
+        self.registry = registry
+        self.fcfg = fcfg or FleetConfig()
+        if self.fcfg.policy not in placement.POLICIES:
+            raise ValueError(f"unknown placement policy "
+                             f"{self.fcfg.policy!r}; known: "
+                             f"{placement.POLICIES}")
+        self.tracer = trace_mod.Tracer(capacity=self.fcfg.trace_buffer)
+        self._plan = faults.plan_for_spec(self.fcfg.inject)
+        self._lock = debug.make_lock("fleet:router")
+        # --- under self._lock -------------------------------------------
+        self._requests: Dict[str, dict] = {}   # rid -> routing state
+        self._live_relays: Dict[str, set] = {}  # backend -> open responses
+        self._recovering: Set[str] = set()     # backends mid-recovery/steal
+        self._steals: List[dict] = []          # steal event log (statusz)
+        self._forwards = 0                     # chaos counter (backend-down@N)
+        self._rr = 0                           # round-robin tiebreak clock
+        self._duplicates = 0
+        self._edge_rejected = 0
+        self._retries = 0
+        self._lost = 0
+        self._draining = False
+        self._last_steal_t = 0.0
+        # -----------------------------------------------------------------
+        self.httpd = ThreadingHTTPServer((host, port), _FleetHandler)
+        self.httpd.daemon_threads = True
+        self.httpd.router = self
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+        self._health: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        debug.instrument_races(
+            self, label="Router",
+            exempt=frozenset({"registry", "httpd", "tracer", "fcfg"}))
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "Router":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True,
+                                        name="heat-tpu-fleet-http")
+        self._thread.start()
+        self._health = threading.Thread(target=self._health_loop,
+                                        daemon=True,
+                                        name="heat-tpu-fleet-health")
+        self._health.start()
+        return self
+
+    def request_drain(self) -> None:
+        """Stop admission (healthz flips 503; new solves get 503). The
+        backends are independent processes and are NOT drained — drain
+        them individually, or steal their work first."""
+        with self._lock:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return sum(1 for st in self._requests.values()
+                       if not st["delivered"])
+
+    def close(self) -> None:
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # --- HTTP client helpers ----------------------------------------------
+    def _conn(self, backend, timeout: float) -> http.client.HTTPConnection:
+        if backend.fault_down:
+            raise ConnectionRefusedError(
+                f"injected backend-down: {backend.name}")
+        host, _, port = backend.address.rpartition(":")
+        return http.client.HTTPConnection(host, int(port), timeout=timeout)
+
+    def _http(self, backend, method: str, path: str, body=None,
+              headers=(), timeout: Optional[float] = None
+              ) -> Tuple[int, bytes]:
+        conn = self._conn(backend,
+                          timeout or self.fcfg.connect_timeout_s)
+        try:
+            conn.request(method, path, body=body, headers=dict(headers))
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    # --- edge admission + placement ---------------------------------------
+    def admit_lines(self, body: bytes, client_q: Optional[queue_lib.Queue],
+                    trace_id: str) -> Tuple[List[dict], List[dict]]:
+        """Parse NDJSON lines at the edge. Returns ``(immediate,
+        accepted_states)``: per-line rejection records that never travel,
+        and the routing-state dicts registered for the valid rows (not
+        yet dispatched — the handler calls :meth:`dispatch` next, after
+        it has sent response headers for the 202 path)."""
+        immediate, states = [], []
+        now = time.monotonic()
+        for line in body.decode("utf-8", "replace").splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                obj = json.loads(line)
+                row = parse_request_obj(obj)
+            except Exception as e:  # noqa: BLE001 — per-line record
+                immediate.append({"id": None, "status": "rejected",
+                                  "error": f"{type(e).__name__}: {e}"})
+                continue
+            if row.error is not None:
+                immediate.append({"id": row.id, "status": "rejected",
+                                  "error": row.error})
+                continue
+            st = {"id": row.id, "line": obj, "n": int(row.cfg.n),
+                  "steps": int(row.cfg.ntime), "backend": None,
+                  "tried": [], "delivered": False, "rec": None,
+                  "q": client_q, "t0": now, "trace_id": trace_id}
+            with self._lock:
+                if row.id in self._requests:
+                    self._edge_rejected += 1
+                    immediate.append(
+                        {"id": row.id, "status": "rejected",
+                         "error": f"duplicate request id {row.id!r} "
+                                  f"(already routed by this fleet)"})
+                    continue
+                self._requests[row.id] = st
+            states.append(st)
+        with self._lock:
+            self._edge_rejected += len(
+                [r for r in immediate if r["status"] == "rejected"])
+        return immediate, states
+
+    def _choose(self, n: Optional[int], exclude: Set[str]):
+        backends = [b for b in self.registry.snapshot()
+                    if b.name not in exclude]
+        with self._lock:
+            self._rr += 1
+            rr = self._rr
+        return placement.choose(self.fcfg.policy, backends, n, rr)
+
+    def _chaos_forward(self, chosen_name: str) -> None:
+        """backend-down@N / backend-slow chaos, one call per forwarded
+        request (strictly opt-in: None plan = one falsy test)."""
+        if self._plan is None:
+            return
+        self._plan.backend_slow()
+        with self._lock:
+            self._forwards += 1
+            nth = self._forwards
+        target = self._plan.backend_down_target(nth)
+        if target is not None:
+            victim = target or chosen_name
+            self.registry.set_fault_down(victim)
+            json_record("fleet_backend_down_injected", backend=victim,
+                        at_forward=nth)
+            self._close_relays(victim)
+
+    def dispatch(self, states: List[dict]) -> None:
+        """Place every state on a backend and spawn one relay per
+        (backend, batch). States that cannot be placed anywhere get a
+        terminal rejection record delivered locally."""
+        batches: Dict[str, List[dict]] = {}
+        addr: Dict[str, str] = {}
+        for st in states:
+            with self._lock:
+                tried = set(st["tried"])
+            b, decision = self._choose(st["n"], tried)
+            if b is None:
+                self._reject_unroutable(st, decision.get("reason",
+                                                         "no-backend"))
+                continue
+            self._chaos_forward(b.name)
+            if b.fault_down:   # the chaos drill just dropped OUR target
+                b2, _ = self._choose(st["n"], tried | {b.name})
+                if b2 is None:
+                    self._reject_unroutable(st, "no-backend-after-fault")
+                    continue
+                b = b2
+            with self._lock:
+                st["backend"] = b.name
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "placed", self.tracer.track("fleet router", "placement"),
+                    cat="fleet", args={"id": st["id"], **decision})
+            batches.setdefault(b.name, []).append(st)
+            addr[b.name] = b.address
+        for name, sts in batches.items():
+            self.registry.note_routed(name, len(sts),
+                                      sum(s["steps"] for s in sts))
+            threading.Thread(
+                target=self._relay, args=(name, addr[name], sts),
+                daemon=True, name=f"heat-tpu-fleet-relay-{name}").start()
+
+    def _reject_unroutable(self, st: dict, why: str) -> None:
+        rec = {"id": st["id"], "status": "rejected",
+               "error": f"unroutable: no eligible backend ({why}); "
+                        f"the fleet is down or nothing can serve "
+                        f"n={st['n']}"}
+        self._deliver(st["id"], rec, backend=None)
+
+    # --- relays -----------------------------------------------------------
+    def _relay(self, name: str, address: str, sts: List[dict]) -> None:
+        """Forward one batch as a streaming POST /v1/solve and pump the
+        backend's chunked record lines into delivery. A failure BEFORE
+        admission (connect error, 503, 429, non-200) retries the batch
+        on an alternate backend; a break MID-stream hands the
+        undelivered rows to checkpoint recovery."""
+        b = self.registry.get(name)
+        if b is None:
+            for st in sts:
+                self._reject_unroutable(st, f"backend {name} vanished")
+            return
+        body = ("\n".join(json.dumps(st["line"], sort_keys=True)
+                          for st in sts) + "\n").encode()
+        tr = self.tracer
+        fwd_track = (tr.track(f"backend {name}", "forward")
+                     if tr.enabled else None)
+        t0 = time.perf_counter()
+        try:
+            conn = self._conn(b, self.fcfg.stream_timeout_s)
+            conn.request("POST", "/v1/solve", body=body,
+                         headers={"Content-Type": "application/x-ndjson",
+                                  "X-Trace-Id": sts[0]["trace_id"]})
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            self._retry_batch(name, sts, f"connect: {type(e).__name__}: {e}")
+            return
+        if resp.status != 200:
+            reason = f"http {resp.status}"
+            try:
+                resp.read()
+            except (OSError, http.client.HTTPException):
+                pass
+            conn.close()
+            # 503 = draining, 429 = every line shed, anything else =
+            # it never streamed: none of these admitted the work
+            self._retry_batch(name, sts, reason,
+                              overloaded=(resp.status == 429))
+            return
+        if tr.enabled:
+            tr.complete(f"forward x{len(sts)}", fwd_track, t0, cat="rpc",
+                        args={"backend": name, "requests": len(sts)})
+        with self._lock:
+            self._live_relays.setdefault(name, set()).add(resp)
+        broke = False
+        try:
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                rid = rec.get("id")
+                if rid is not None:
+                    self._deliver(rid, rec, backend=name)
+        except (OSError, ValueError, http.client.HTTPException,
+                AttributeError):
+            # AttributeError: http.client's buffered reader races
+            # resp.close() from _close_relays (fp goes None mid-peek) —
+            # that IS the mid-stream break the steal path engineers
+            broke = True
+        finally:
+            with self._lock:
+                live = self._live_relays.get(name)
+                if live is not None:
+                    live.discard(resp)
+            try:
+                conn.close()
+            except OSError:
+                pass
+        with self._lock:
+            missing = [st for st in sts
+                       if not st["delivered"] and st["backend"] == name]
+            recovering = name in self._recovering
+        if missing and not recovering:
+            # stream ended without every record: the backend died (or
+            # was dropped by chaos) mid-batch — checkpoint recovery
+            self._recover_backend(
+                name, "relay-" + ("broke" if broke else "eof"))
+
+    def _retry_batch(self, name: str, sts: List[dict], why: str,
+                     overloaded: bool = False) -> None:
+        """Never-admitted rows: re-place on alternates (the retry
+        counter is per batch hop, so statusz shows the churn)."""
+        self.registry.note_retry(name)
+        self.registry.note_unrouted(name, len(sts),
+                                    sum(s["steps"] for s in sts))
+        with self._lock:
+            self._retries += 1
+            for st in sts:
+                st["tried"].append(name)
+                st["backend"] = None
+        json_record("fleet_retry", backend=name, requests=len(sts),
+                    why=why)
+        # registry snapshot BEFORE taking the router lock: both locks
+        # rank "fleet" and same-rank locks must never nest
+        alive = {b.name for b in self.registry.snapshot()
+                 if not b.lost and not b.fault_down}
+        remaining = []
+        for st in sts:
+            with self._lock:
+                exhausted = alive <= set(st["tried"])
+            if exhausted:
+                err = ("overloaded: every backend shed this request; "
+                       "retry later" if overloaded else
+                       f"unroutable: every backend refused ({why})")
+                self._deliver(st["id"],
+                              {"id": st["id"], "status": "rejected",
+                               "error": err}, backend=None)
+            else:
+                remaining.append(st)
+        if remaining:
+            self.dispatch(remaining)
+
+    def _close_relays(self, name: str) -> None:
+        """Break every live relay stream to ``name`` (steal or injected
+        drop): closing the response unblocks the relay thread's read,
+        which then routes its undelivered rows into recovery."""
+        with self._lock:
+            live = list(self._live_relays.get(name, ()))
+        for resp in live:
+            try:
+                resp.close()
+            except OSError:
+                pass
+
+    # --- delivery (exactly-once) ------------------------------------------
+    def _deliver(self, rid: str, rec: dict,
+                 backend: Optional[str]) -> bool:
+        """The single exactly-once chokepoint: the first terminal record
+        for a request id wins; every later one (re-driven work finishing
+        twice, a poller racing a relay) is dropped and counted."""
+        with self._lock:
+            st = self._requests.get(rid)
+            if st is None:
+                return False   # not router-tracked (direct-to-backend)
+            if st["delivered"]:
+                self._duplicates += 1
+                return False
+            st["delivered"] = True
+            st["rec"] = rec
+            q = st["q"]
+            steps = st["steps"]
+        if backend is not None:
+            self.registry.note_done(backend, steps)
+        tr = self.tracer
+        if tr.enabled and backend is not None:
+            t1 = tr.now()
+            solve_s = rec.get("solve_s") or 0.0
+            tid = rec.get("trace_id")
+            track = tr.track(f"backend {backend}", "solve")
+            tr.complete(str(rid), track, t1 - float(solve_s), t1,
+                        cat="serve", trace_id=tid,
+                        args={"status": rec.get("status")})
+            if tid:
+                tr.flow("f", track, tid)
+        if q is not None:
+            q.put(rec)
+        return True
+
+    # --- health + imbalance ----------------------------------------------
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.fcfg.health_interval_s):
+            self._health_tick()
+
+    def _health_tick(self) -> None:
+        self.registry.refresh_file()
+        now = time.monotonic()
+        for b in self.registry.snapshot():
+            if b.lost:
+                continue
+            ok, draining, status = False, False, None
+            if not b.fault_down:
+                try:
+                    code, _ = self._http(b, "GET", "/healthz")
+                    draining = code == 503
+                    ok = code == 200
+                    if ok:
+                        scode, sbody = self._http(b, "GET", "/v1/status")
+                        if scode == 200:
+                            status = json.loads(sbody)
+                except (OSError, ValueError,
+                        http.client.HTTPException):
+                    ok = False
+            was, is_now = self.registry.note_probe(
+                b.name, ok, draining=draining, status=status, now=now)
+            if was and not is_now and not draining:
+                # hard down transition (connect failure / 500 / chaos):
+                # recover its orphans; a 503-draining backend still
+                # finishes its in-flight work, so only placement stops
+                threading.Thread(
+                    target=self._recover_backend,
+                    args=(b.name, "health-probe"), daemon=True,
+                    name=f"heat-tpu-fleet-recover-{b.name}").start()
+        if self.fcfg.steal_threshold_s > 0:
+            self._maybe_steal(now)
+
+    def _maybe_steal(self, now: float) -> None:
+        with self._lock:
+            if (self._recovering
+                    or now - self._last_steal_t
+                    < self.fcfg.steal_cooldown_s):
+                return
+        cands = [b for b in self.registry.snapshot()
+                 if b.healthy and not b.lost and not b.fault_down]
+        if len(cands) < 2:
+            return
+        scores = {b.name: placement.predicted_backlog_s(b) for b in cands}
+        victim = max(cands, key=lambda b: scores[b.name])
+        thief = min(cands, key=lambda b: scores[b.name])
+        if (victim.name == thief.name
+                or scores[victim.name] - scores[thief.name]
+                < self.fcfg.steal_threshold_s
+                or placement.backlog_steps(victim) <= 0):
+            return
+        with self._lock:
+            self._last_steal_t = now
+        threading.Thread(
+            target=self.steal, args=(victim.name, thief.name),
+            kwargs={"reason": "imbalance"}, daemon=True,
+            name="heat-tpu-fleet-steal").start()
+
+    # --- checkpoint recovery + work stealing ------------------------------
+    def _ckpt_dir(self, b) -> Optional[Path]:
+        st = b.status or {}
+        d = ((st.get("engine_ckpt") or {}).get("dir")
+             or (Path(self.fcfg.ckpt_root) / b.name
+                 if self.fcfg.ckpt_root else None))
+        if d is None:
+            return None
+        d = Path(d)
+        return d if d.is_dir() else None
+
+    def _orphans_of(self, name: str) -> List[dict]:
+        with self._lock:
+            return [st for st in self._requests.values()
+                    if st["backend"] == name and not st["delivered"]]
+
+    def _adopt(self, victim: str, thief_b, detail: dict,
+               orphans: List[dict]) -> Tuple[List[dict], List[dict]]:
+        """Split a victim's orphans after a resume on ``thief_b``:
+        manifest-covered ids are reassigned and polled there;
+        everything else (including manifest-``done`` ids whose records
+        died with the victim) re-drives fresh — the solver is
+        deterministic, so either path produces identical bytes."""
+        recovered = set(detail.get("recovered") or ())
+        polled, redrive = [], []
+        for st in orphans:
+            if st["id"] in recovered:
+                polled.append(st)
+            else:
+                redrive.append(st)
+        moved_steps = sum(s["steps"] for s in polled + redrive)
+        self.registry.note_unrouted(victim, len(polled) + len(redrive),
+                                    moved_steps)
+        with self._lock:
+            for st in polled:
+                st["tried"].append(victim)
+                st["backend"] = thief_b.name
+            for st in redrive:
+                st["tried"].append(victim)
+                st["backend"] = None
+        if polled:
+            self.registry.note_routed(thief_b.name, len(polled),
+                                      sum(s["steps"] for s in polled))
+            threading.Thread(
+                target=self._poll_recovered,
+                args=(thief_b.name, [st["id"] for st in polled]),
+                daemon=True,
+                name=f"heat-tpu-fleet-poll-{thief_b.name}").start()
+        if redrive:
+            self.dispatch(redrive)
+        return polled, redrive
+
+    def _recover_backend(self, name: str, reason: str) -> None:
+        """A backend is gone (probe failure, relay break, chaos drop):
+        flight-dump the fleet timeline, resume its newest checkpoint
+        manifest onto the least-loaded survivor, poll the resumed ids
+        there, and re-drive whatever the manifest does not cover."""
+        with self._lock:
+            if name in self._recovering:
+                return
+            self._recovering.add(name)
+            self._lost += 1
+        try:
+            self.registry.mark_lost(name)
+            b = self.registry.get(name)
+            master_print(f"fleet: backend {name} lost ({reason}) — "
+                         f"recovering")
+            json_record("fleet_backend_lost", backend=name, reason=reason)
+            self.tracer.flight_dump(self.fcfg.flightrec_dir,
+                                    f"backend {name} lost ({reason})")
+            self._close_relays(name)
+            orphans = self._orphans_of(name)
+            detail: dict = {}
+            d = self._ckpt_dir(b) if b is not None else None
+            thief, _ = self._choose(None, {name})
+            if d is not None and thief is not None:
+                try:
+                    code, data = self._http(
+                        thief, "POST", "/v1/resume",
+                        body=json.dumps({"dir": str(d)}).encode(),
+                        headers={"Content-Type": "application/json"},
+                        timeout=self.fcfg.steal_timeout_s)
+                    if code == 200:
+                        detail = json.loads(data)
+                except (OSError, ValueError,
+                        http.client.HTTPException) as e:
+                    master_print(f"fleet: resume of {name}'s checkpoint "
+                                 f"on {thief.name} failed ({e}) — "
+                                 f"re-driving fresh")
+            polled, redrive = self._adopt(
+                name, thief, detail, orphans) if thief is not None \
+                else ([], orphans)
+            if thief is None:
+                for st in redrive:
+                    self._reject_unroutable(st, "fleet-exhausted")
+            json_record("fleet_recovery", backend=name, reason=reason,
+                        generation=detail.get("generation", 0),
+                        recovered=len(polled), redriven=len(redrive))
+        finally:
+            with self._lock:
+                self._recovering.discard(name)
+
+    def steal(self, victim: str, thief: Optional[str] = None,
+              reason: str = "forced") -> Optional[dict]:
+        """Work stealing as checkpoint handoff: drain the victim to a
+        checkpoint (``/drainz?handoff=1``), pick up the manifest
+        generation from its checkpoint dir, resume it on the thief, and
+        re-point the orphans. Returns the steal event dict (also on
+        /statusz) or None if a recovery already owns the victim."""
+        t0 = time.monotonic()
+        with self._lock:
+            if victim in self._recovering:
+                return None
+            self._recovering.add(victim)
+        try:
+            vb = self.registry.get(victim)
+            if vb is None:
+                return None
+            gen_before = int(((vb.status or {}).get("engine_ckpt")
+                              or {}).get("generation") or 0)
+            d = self._ckpt_dir(vb)
+            self.registry.mark_lost(victim)   # placement stops NOW; the
+            # probe loop must not start a second, competing recovery
+            try:
+                self._http(vb, "POST", "/drainz?handoff=1",
+                           timeout=self.fcfg.connect_timeout_s)
+            except (OSError, http.client.HTTPException) as e:
+                master_print(f"fleet: steal drain of {victim} failed "
+                             f"({e}) — falling back to loss recovery")
+            self._close_relays(victim)
+            t_drain = time.monotonic()
+            generation = 0
+            if d is not None:
+                deadline = t0 + self.fcfg.steal_timeout_s
+                while time.monotonic() < deadline:
+                    manifest, _ = ckpt_mod.latest_engine_manifest(d)
+                    if (manifest is not None
+                            and int(manifest["generation"]) > gen_before):
+                        generation = int(manifest["generation"])
+                        break
+                    if self._stop.wait(0.1):
+                        break
+            tb = (self.registry.get(thief) if thief
+                  else self._choose(None, {victim})[0])
+            detail: dict = {}
+            if generation and tb is not None:
+                try:
+                    code, data = self._http(
+                        tb, "POST", "/v1/resume",
+                        body=json.dumps({"dir": str(d)}).encode(),
+                        headers={"Content-Type": "application/json"},
+                        timeout=self.fcfg.steal_timeout_s)
+                    if code == 200:
+                        detail = json.loads(data)
+                except (OSError, ValueError,
+                        http.client.HTTPException) as e:
+                    master_print(f"fleet: steal resume on "
+                                 f"{tb.name} failed ({e})")
+            t_resume = time.monotonic()
+            orphans = self._orphans_of(victim)
+            polled, redrive = self._adopt(
+                victim, tb, detail, orphans) if tb is not None \
+                else ([], orphans)
+            if tb is None:
+                for st in redrive:
+                    self._reject_unroutable(st, "fleet-exhausted")
+            self.registry.note_steal(victim, tb.name if tb else "")
+            event = {"victim": victim,
+                     "thief": tb.name if tb is not None else None,
+                     "reason": reason, "generation": generation,
+                     "recovered": len(polled), "redriven": len(redrive),
+                     "drain_s": round(t_drain - t0, 3),
+                     "resume_s": round(t_resume - t_drain, 3),
+                     "wall_s": round(time.monotonic() - t0, 3)}
+            with self._lock:
+                self._steals.append(event)
+            json_record("fleet_steal", **event)
+            master_print(f"fleet: stole {len(polled) + len(redrive)} "
+                         f"request(s) from {victim} -> "
+                         f"{event['thief']} (gen {generation}, "
+                         f"{event['wall_s']}s)")
+            return event
+        finally:
+            with self._lock:
+                self._recovering.discard(victim)
+
+    def _poll_recovered(self, thief_name: str, rids: List[str]) -> None:
+        """Relay terminal records for resumed orphans by polling the
+        thief's ``GET /v1/requests/<id>`` (a resumed request has no
+        streaming response anywhere — the victim's stream died with
+        it)."""
+        pending = set(rids)
+        deadline = time.monotonic() + self.fcfg.stream_timeout_s
+        while pending and time.monotonic() < deadline:
+            tb = self.registry.get(thief_name)
+            if tb is None or tb.lost:
+                break    # thief died too; its own recovery re-drives
+            for rid in sorted(pending):
+                try:
+                    code, data = self._http(tb, "GET",
+                                            f"/v1/requests/{rid}")
+                except (OSError, http.client.HTTPException):
+                    break
+                if code != 200:
+                    continue
+                try:
+                    rec = json.loads(data)
+                except ValueError:
+                    continue
+                if rec.get("status") in TERMINAL_STATUSES:
+                    pending.discard(rid)
+                    self._deliver(rid, rec, backend=thief_name)
+            if self._stop.wait(0.15):
+                break
+        for rid in sorted(pending):
+            self._deliver(rid, {"id": rid, "status": "error",
+                                "error": "steal: resumed request did "
+                                         "not finish within the stream "
+                                         "timeout"},
+                          backend=thief_name)
+
+    # --- observability snapshots ------------------------------------------
+    def snapshot(self) -> dict:
+        """Router + per-backend state for /metrics, /statusz and
+        /v1/status — one consistent read of the router tables, then the
+        registry (the two locks never nest)."""
+        with self._lock:
+            router = {"pending": sum(1 for st in self._requests.values()
+                                     if not st["delivered"]),
+                      "requests": len(self._requests),
+                      "duplicates": self._duplicates,
+                      "edge_rejected": self._edge_rejected,
+                      "retries": self._retries,
+                      "lost": self._lost,
+                      "forwards": self._forwards,
+                      "draining": self._draining,
+                      "steals": list(self._steals)}
+        backends = {}
+        for b in self.registry.snapshot():
+            backends[b.name] = {
+                "address": b.address,
+                "healthy": b.healthy, "draining": b.draining,
+                "lost": b.lost, "fault_down": b.fault_down,
+                "demoted": placement.burn_demoted(b.status),
+                "backlog_s": round(placement.predicted_backlog_s(b), 6),
+                "backlog_steps": placement.backlog_steps(b),
+                "pending_requests": b.pending_requests,
+                "routed": b.routed, "delivered": b.delivered,
+                "retried": b.retried,
+                "stolen_from": b.stolen_from, "stolen_to": b.stolen_to,
+                "probe_passes": b.probe_passes,
+                "probe_fails": b.probe_fails,
+                "consecutive_failures": b.consecutive_failures,
+                "mega_capable": bool(((b.status or {}).get("mega")
+                                      or {}).get("capable")),
+                "engine_ckpt_generation": int(
+                    ((b.status or {}).get("engine_ckpt")
+                     or {}).get("generation") or 0),
+                "serve_resumed": (b.status or {}).get("serve_resumed", 0),
+                "queued_now": (b.status or {}).get("queued_now", 0),
+            }
+        return {"kind": "heat-tpu-fleet-status",
+                "policy": self.fcfg.policy,
+                "steal_threshold_s": self.fcfg.steal_threshold_s,
+                "uptime_s": round(trace_mod.process_uptime_s(), 3),
+                "router": router, "backends": backends}
+
+    def fleet_usage(self) -> dict:
+        """Fleet-wide ``/v1/usage``: every reachable backend's ledger,
+        merged (exact reconciliation — the sums are the per-engine sums)
+        plus the raw per-backend payloads."""
+        per_backend = {}
+        for b in self.registry.snapshot():
+            if b.lost or b.fault_down:
+                continue
+            try:
+                code, data = self._http(b, "GET", "/v1/usage")
+                if code == 200:
+                    per_backend[b.name] = json.loads(data)
+            except (OSError, ValueError, http.client.HTTPException):
+                continue
+        return merge_usage(per_backend)
+
+
+def merge_usage(per_backend: Dict[str, dict]) -> dict:
+    """Pure merge of per-engine ``/v1/usage`` ledgers: per-(tenant,
+    class) fields and engine totals are summed across backends, and the
+    raw payloads ride along under ``per_backend`` so the reconciliation
+    is auditable — fleet totals equal the sum of per-engine ledgers by
+    construction."""
+    fields = ("lane_s", "steps", "chunks", "bytes_written",
+              "steps_saved", "requests")
+    tenants: Dict[str, dict] = {}
+    totals = {f: 0 for f in fields}
+    for payload in per_backend.values():
+        for tname, t in (payload.get("tenants") or {}).items():
+            tdst = tenants.setdefault(tname, {"classes": {}})
+            for cname, c in (t.get("classes") or {}).items():
+                cdst = tdst["classes"].setdefault(
+                    cname, {f: 0 for f in fields})
+                for f in fields:
+                    cdst[f] = round(cdst[f] + c.get(f, 0), 9)
+        for f in fields:
+            totals[f] = round(totals[f]
+                              + (payload.get("totals") or {}).get(f, 0), 9)
+    return {"kind": "heat-tpu-fleet-usage",
+            "backends": sorted(per_backend),
+            "tenants": tenants, "totals": totals,
+            "per_backend": per_backend}
+
+
+def render_fleet_metrics(router: Router) -> str:
+    """The router's ``/metrics`` (Prometheus text format): router-native
+    series with per-backend labels. Pure function of the router so tests
+    assert without a socket."""
+    from ..serve.gateway import escape_label_value
+
+    s = router.snapshot()
+    out = []
+
+    def metric(name, mtype, help_text, samples):
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            lbl = ("{" + ",".join(
+                f'{k}="{escape_label_value(v)}"' for k, v in labels) + "}"
+                   if labels else "")
+            out.append(f"{name}{lbl} {value}")
+
+    metric("heat_tpu_fleet_info", "gauge",
+           "Router identity/config (value is always 1).",
+           [([("policy", s["policy"]),
+              ("steal_threshold_s", s["steal_threshold_s"])], 1)])
+    metric("heat_tpu_fleet_uptime_seconds", "gauge",
+           "Seconds since this router process started.",
+           [([], s["uptime_s"])])
+    metric("heat_tpu_fleet_draining", "gauge",
+           "1 once the router's /drainz has been called.",
+           [([], int(s["router"]["draining"]))])
+    bk = sorted(s["backends"].items())
+    metric("heat_tpu_fleet_backend_up", "gauge",
+           "1 while the backend passes health probes and accepts "
+           "placements.",
+           [([("backend", n)], int(b["healthy"])) for n, b in bk]
+           or [([], 0)])
+    metric("heat_tpu_fleet_backend_demoted", "gauge",
+           "1 while burn-aware placement demotes the backend (fast AND "
+           "slow SLO burn windows over threshold for some class).",
+           [([("backend", n)], int(b["demoted"])) for n, b in bk]
+           or [([], 0)])
+    metric("heat_tpu_fleet_backend_backlog_seconds", "gauge",
+           "Predicted backlog seconds per backend (cost model x queue "
+           "work + router-pending) — the least-loaded placement score.",
+           [([("backend", n)], b["backlog_s"]) for n, b in bk]
+           or [([], 0)])
+    metric("heat_tpu_fleet_routed_total", "counter",
+           "Requests forwarded, per backend.",
+           [([("backend", n)], b["routed"]) for n, b in bk] or [([], 0)])
+    metric("heat_tpu_fleet_delivered_total", "counter",
+           "Terminal records delivered to clients, per serving backend.",
+           [([("backend", n)], b["delivered"]) for n, b in bk]
+           or [([], 0)])
+    metric("heat_tpu_fleet_retried_total", "counter",
+           "Batch forwards retried on an alternate backend (the "
+           "never-reached-admission path), per refused backend.",
+           [([("backend", n)], b["retried"]) for n, b in bk]
+           or [([], 0)])
+    metric("heat_tpu_fleet_probe_failures_total", "counter",
+           "Health-probe failures, per backend.",
+           [([("backend", n)], b["probe_fails"]) for n, b in bk]
+           or [([], 0)])
+    metric("heat_tpu_fleet_backends_lost_total", "counter",
+           "Backends transitioned to lost (recovery ran).",
+           [([], s["router"]["lost"])])
+    metric("heat_tpu_fleet_steals_total", "counter",
+           "Checkpoint-handoff work steals, per victim backend.",
+           [([("backend", n)], b["stolen_from"]) for n, b in bk]
+           or [([], 0)])
+    metric("heat_tpu_fleet_requests_pending", "gauge",
+           "Router-tracked requests awaiting a terminal record.",
+           [([], s["router"]["pending"])])
+    metric("heat_tpu_fleet_duplicates_dropped_total", "counter",
+           "Terminal records dropped by the exactly-once delivery "
+           "chokepoint (a re-driven request finishing twice).",
+           [([], s["router"]["duplicates"])])
+    metric("heat_tpu_fleet_edge_rejected_total", "counter",
+           "Request lines rejected at the router edge (parse/validate/"
+           "duplicate) without ever reaching a backend.",
+           [([], s["router"]["edge_rejected"])])
+    metric("heat_tpu_fleet_flightrec_dumps_total", "counter",
+           "Fleet-timeline flight dumps written on backend loss.",
+           [([], router.tracer.dumps)])
+    return "\n".join(out) + "\n"
+
+
+def render_fleet_statusz(router: Router) -> str:
+    """The router's ``/statusz``: the fleet at a glance for an operator
+    mid-incident — per-backend health/backlog/burn table, the steal
+    log, and where the flight dumps went."""
+    s = router.snapshot()
+    r = s["router"]
+    lines = [f"heat-tpu fleet router — statusz "
+             f"(uptime {s['uptime_s']:.0f}s, policy {s['policy']}, "
+             f"steal threshold "
+             f"{s['steal_threshold_s'] or 'off'}"
+             f"{'s' if s['steal_threshold_s'] else ''}, "
+             f"{'DRAINING' if r['draining'] else 'admitting'})", ""]
+    lines.append(
+        f"requests: {r['requests']} routed total, {r['pending']} "
+        f"pending, {r['edge_rejected']} rejected at the edge, "
+        f"{r['retries']} batch retr{'y' if r['retries'] == 1 else 'ies'}, "
+        f"{r['duplicates']} duplicate record(s) dropped")
+    lines.append(f"backends ({len(s['backends'])}; "
+                 f"{r['lost']} lost so far):")
+    for name, b in sorted(s["backends"].items()):
+        state = ("FAULT-DOWN" if b["fault_down"] else
+                 "LOST" if b["lost"] else
+                 "draining" if b["draining"] else
+                 "up" if b["healthy"] else "DOWN")
+        lines.append(
+            f"  {name} @ {b['address']}: {state}"
+            f"{' DEMOTED(burn)' if b['demoted'] else ''} — backlog "
+            f"{b['backlog_s']:.3f}s ({b['backlog_steps']} steps, "
+            f"{b['pending_requests']} router-pending), routed "
+            f"{b['routed']}, delivered {b['delivered']}, retried "
+            f"{b['retried']}, probes {b['probe_passes']}/"
+            f"{b['probe_fails']} fail, ckpt gen "
+            f"{b['engine_ckpt_generation']}, resumed "
+            f"{b['serve_resumed']}, stolen {b['stolen_from']}x from / "
+            f"{b['stolen_to']}x to"
+            f"{', mega' if b['mega_capable'] else ''}")
+    steals = r["steals"]
+    lines.append("")
+    lines.append(f"steals ({len(steals)}):")
+    if not steals:
+        lines.append("  (none)")
+    for ev in steals[-10:]:
+        lines.append(
+            f"  {ev['victim']} -> {ev['thief']} [{ev['reason']}]: gen "
+            f"{ev['generation']}, {ev['recovered']} resumed + "
+            f"{ev['redriven']} re-driven, drain {ev['drain_s']}s + "
+            f"resume {ev['resume_s']}s = {ev['wall_s']}s")
+    if router.tracer.dumps:
+        lines.append("")
+        lines.append(f"flight-recorder dumps ({router.tracer.dumps}):")
+        for p in router.tracer.dump_paths:
+            lines.append(f"  {p}")
+    return "\n".join(lines) + "\n"
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def rt(self) -> Router:
+        return self.server.router
+
+    def log_message(self, fmt, *args):  # noqa: D102
+        if not self.rt.fcfg.quiet:
+            master_print(f"fleet: {self.address_string()} {fmt % args}")
+
+    @property
+    def trace_id(self) -> str:
+        tid = getattr(self, "_trace_id", None)
+        if tid is None:
+            inbound = (self.headers.get("X-Trace-Id") or "").strip()
+            tid = (inbound if _TRACE_ID_RE.match(inbound)
+                   else self.rt.tracer.mint_trace_id())
+            self._trace_id = tid
+        return tid
+
+    def _send_headers(self, code: int, body_len: int, ctype: str,
+                      headers=()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(body_len))
+        has_tid = False
+        for k, v in headers:
+            self.send_header(k, str(v))
+            has_tid = has_tid or k == "X-Trace-Id"
+        if not has_tid:
+            self.send_header("X-Trace-Id", self.trace_id)
+        self.end_headers()
+
+    def _json(self, code: int, obj, headers=()) -> None:
+        body = (json.dumps(obj, sort_keys=True) + "\n").encode()
+        self._send_headers(code, len(body), "application/json", headers)
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _text(self, code: int, text: str, ctype: str) -> None:
+        body = text.encode()
+        self._send_headers(code, len(body), ctype)
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    # --- routes -----------------------------------------------------------
+    def do_GET(self):  # noqa: N802
+        parts = urlsplit(self.path)
+        path = parts.path
+        rt = self.rt
+        if path == "/healthz":
+            ups = [b for b in rt.registry.snapshot() if b.healthy]
+            if rt.draining:
+                self._json(503, {"status": "draining",
+                                 "backends_up": len(ups)},
+                           headers=[("Retry-After",
+                                     int(rt.fcfg.retry_after_s))])
+            elif ups:
+                self._json(200, {"status": "ok",
+                                 "backends_up": len(ups)})
+            else:
+                self._json(503, {"status": "no-backends"},
+                           headers=[("Retry-After",
+                                     int(rt.fcfg.retry_after_s))])
+        elif path == "/metrics":
+            self._text(200, render_fleet_metrics(rt),
+                       "text/plain; version=0.0.4")
+        elif path == "/statusz":
+            self._text(200, render_fleet_statusz(rt),
+                       "text/plain; charset=utf-8")
+        elif path == "/v1/status":
+            payload = rt.snapshot()
+            payload["address"] = rt.address
+            self._json(200, payload)
+        elif path == "/v1/usage":
+            self._json(200, rt.fleet_usage())
+        elif path == "/tracez":
+            self._text(200, json.dumps(rt.tracer.to_chrome()),
+                       "application/json")
+        elif path == "/drainz":
+            self._drainz()
+        elif path.startswith("/v1/requests/"):
+            self._request_status(path[len("/v1/requests/"):])
+        else:
+            self._json(404, {"error": f"no route for GET {path}"})
+
+    def do_POST(self):  # noqa: N802
+        parts = urlsplit(self.path)
+        if parts.path == "/drainz":
+            self._drainz()
+        elif parts.path == "/v1/solve":
+            self._solve(parts)
+        else:
+            self._json(404, {"error": f"no route for POST {parts.path}"})
+
+    def _drainz(self) -> None:
+        self.rt.request_drain()
+        self._json(200, {"draining": True,
+                         "pending": self.rt.pending_count()})
+
+    def _request_status(self, rid: str) -> None:
+        """Record lookup: answered locally once delivered, proxied to
+        the owning backend while in flight."""
+        rt = self.rt
+        with rt._lock:
+            st = rt._requests.get(rid)
+            rec = st["rec"] if st else None
+            owner = st["backend"] if st else None
+        if rec is not None:
+            self._json(200, rec)
+            return
+        if owner is None:
+            self._json(404, {"error": f"unknown request id {rid!r}"})
+            return
+        b = rt.registry.get(owner)
+        if b is None:
+            self._json(404, {"error": f"backend {owner!r} vanished"})
+            return
+        try:
+            code, data = rt._http(b, "GET", f"/v1/requests/{rid}")
+            self._json(code, json.loads(data))
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            self._json(502, {"error": f"backend {owner} unreachable: "
+                                      f"{type(e).__name__}: {e}"})
+
+    def _read_body(self) -> Optional[bytes]:
+        n = self.headers.get("Content-Length")
+        if n is None:
+            self._json(411, {"error": "Content-Length required"})
+            return None
+        n = int(n)
+        if n > MAX_BODY_BYTES:
+            self._json(413, {"error": f"body exceeds {MAX_BODY_BYTES} "
+                                      f"bytes"})
+            return None
+        return self.rfile.read(n)
+
+    def _solve(self, parts) -> None:
+        rt = self.rt
+        tr = rt.tracer
+        if not tr.enabled:
+            return self._solve_inner(parts)
+        t0 = tr.now()
+        try:
+            self._solve_inner(parts)
+        finally:
+            tr.complete("POST /v1/solve", tr.thread_track("fleet router"),
+                        t0, cat="http")
+
+    def _solve_inner(self, parts) -> None:
+        rt = self.rt
+        if rt.draining:
+            self._json(503, {"error": "draining: fleet admission "
+                                      "stopped (/drainz)"},
+                       headers=[("Retry-After",
+                                 int(rt.fcfg.retry_after_s))])
+            return
+        body = self._read_body()
+        if body is None:
+            return
+        wait = parse_qs(parts.query).get("wait", ["1"])[0] not in ("0",
+                                                                   "false")
+        results: Optional[queue_lib.Queue] = (queue_lib.Queue() if wait
+                                              else None)
+        immediate, states = rt.admit_lines(body, results, self.trace_id)
+        if not immediate and not states:
+            self._json(400, {"error": "empty body: expected one JSON "
+                                      "request object per line"})
+            return
+        if not wait:
+            rt.dispatch(states)
+            self._json(202, {"accepted": [st["id"] for st in states],
+                             "records": immediate})
+            return
+        self._stream(immediate, states, results)
+
+    def _stream(self, immediate, states, results) -> None:
+        """Chunked NDJSON back to the client: rejection records first,
+        then each request's terminal record as its backend (original,
+        retried, or stolen-to) produces it."""
+        rt = self.rt
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("X-Trace-Id", self.trace_id)
+        self.end_headers()
+
+        def chunk(obj) -> bool:
+            data = (json.dumps(obj, sort_keys=True, default=str)
+                    + "\n").encode()
+            try:
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                return True
+            except (BrokenPipeError, ConnectionResetError):
+                return False
+
+        alive = True
+        for rec in immediate:
+            alive = alive and chunk(rec)
+        rt.dispatch(states)
+        pending = {st["id"] for st in states}
+        deadline = time.monotonic() + rt.fcfg.stream_timeout_s
+        while pending and alive:
+            try:
+                rec = results.get(timeout=max(0.05,
+                                              deadline - time.monotonic()))
+            except queue_lib.Empty:
+                chunk({"error": f"stream timeout after "
+                                f"{rt.fcfg.stream_timeout_s:g}s; poll "
+                                f"GET /v1/requests/<id> for the rest",
+                       "pending": sorted(pending)})
+                break
+            rid = rec.get("id")
+            if rid in pending:
+                pending.discard(rid)
+                alive = alive and chunk(rec)
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass

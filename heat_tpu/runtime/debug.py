@@ -58,8 +58,13 @@ from typing import List, Optional
 # carry their own lock precisely so they never have to), and the reverse
 # order (observatory -> engine) is the deadlock the PR-8 contract rules
 # out. Rank names are the prefix before ":" in a make_lock name, so
-# "observatory:ledger" and "observatory:burn" share a rank.
-LOCK_RANKS = {"gateway": 0, "engine": 10, "writer": 20, "observatory": 30}
+# "observatory:ledger" and "observatory:burn" share a rank. "fleet" is
+# the router in front of many gateways (heat_tpu/fleet): outermost in
+# every request path, so it ranks below gateway — router threads may
+# call into a (same-process, in tests) gateway/engine surface while
+# holding a fleet lock, never the reverse.
+LOCK_RANKS = {"fleet": -10, "gateway": 0, "engine": 10, "writer": 20,
+              "observatory": 30}
 
 
 class LockOrderError(RuntimeError):

@@ -49,6 +49,24 @@ serve/scheduler.py — ignored by the solo drive loop):
                                the first one). The resume loader must
                                quarantine it and fall back one
                                generation loudly.
+Fleet-scoped kinds (the router's chaos drills, heat_tpu/fleet/router.py
+— ignored by the solo drive loop and the serving engine):
+
+- ``backend-down@N[:backend=K]`` — router-side: once the router has
+                               forwarded N requests, drop the TCP
+                               target (the router treats the backend as
+                               connection-refused from then on, the
+                               shape of a host vanishing mid-fleet).
+                               ``backend=K`` names the victim; without
+                               it the backend the Nth forward chose is
+                               dropped. Fire-once; exercises the
+                               retry-on-alternate + checkpoint-recovery
+                               path without killing a real process.
+- ``backend-slow:ms=M``      — every router->backend forward sleeps M ms
+                               first (a congested/distant backend; the
+                               placement policy and imbalance estimator
+                               see realistic skew).
+
 - ``perturb@N[:req=ID][:eps=E]`` — add a bounded (finite!) perturbation
                                ``eps`` (default 1e3) to one cell of a
                                serving lane's field once that lane's
@@ -99,7 +117,8 @@ CRASH_RC = 43
 
 _KINDS = ("crash", "nan", "ckpt-corrupt", "ckpt-truncate",
           "sink-error", "sink-slow", "lane-nan", "fetch-hang", "perturb",
-          "engine-kill", "ckpt-manifest-corrupt")
+          "engine-kill", "ckpt-manifest-corrupt",
+          "backend-down", "backend-slow")
 
 
 @dataclasses.dataclass
@@ -114,6 +133,8 @@ class Fault:
                                 # (None = all)
     eps: float = 1e3            # perturb: added to one cell (finite, big
                                 # enough to escape any envelope tolerance)
+    backend: Optional[str] = None  # backend-down: named victim (None =
+                                # whichever backend the Nth forward chose)
     fired: bool = False
 
 
@@ -165,17 +186,18 @@ def parse_spec(spec: str) -> List[Fault]:
         for kv in filter(None, tail.split(":")):
             key, eq, val = kv.partition("=")
             if not eq or key not in ("proc", "times", "ms", "restart",
-                                     "req", "eps"):
+                                     "req", "eps", "backend"):
                 raise ValueError(
                     f"bad fault param {kv!r} in {entry!r}; keys are "
-                    f"proc=, times=, ms=, restart=, req=, eps=")
+                    f"proc=, times=, ms=, restart=, req=, eps=, backend=")
             try:
-                setattr(f, key, val if key == "req"
+                setattr(f, key, val if key in ("req", "backend")
                         else float(val) if key in ("ms", "eps")
                         else int(val))
             except ValueError:
                 raise ValueError(f"bad value {val!r} for {key} in {entry!r}")
-        if (f.kind in ("crash", "nan", "lane-nan", "perturb", "engine-kill")
+        if (f.kind in ("crash", "nan", "lane-nan", "perturb", "engine-kill",
+                       "backend-down")
                 and f.step is None):
             raise ValueError(f"fault {entry!r} needs a step: '{f.kind}@N'")
         faults.append(f)
@@ -272,6 +294,31 @@ class FaultPlan:
                       file=sys.stderr, flush=True)
                 os.kill(os.getpid(), signal.SIGKILL)
 
+    # --- fleet faults (heat_tpu/fleet/router.py chaos drills) -------------
+    def backend_slow(self) -> None:
+        """Called before every router->backend forward: each live
+        backend-slow fault sleeps its ``ms`` (a congested or distant
+        backend — placement skew the imbalance estimator must see)."""
+        for f in self._live("backend-slow"):
+            if f.ms > 0:
+                time.sleep(f.ms / 1000.0)
+
+    def backend_down_target(self, nth: int) -> Optional[str]:
+        """Called once per forwarded request with the router-wide
+        forward counter: the first live backend-down fault whose ``@N``
+        threshold ``nth`` reaches is spent (fire-once) and answers which
+        TCP target to drop — its ``backend=`` selector, or ``""``
+        meaning 'whichever backend this Nth forward chose'. ``None`` =
+        no fault fires here (the overwhelmingly common answer)."""
+        for f in self._live("backend-down"):
+            if not f.fired and nth >= f.step:
+                f.fired = True
+                print(f"fault: injected backend-down at forward {nth} "
+                      f"(target {f.backend or '<routed>'}, "
+                      f"spec {self.spec!r})", file=sys.stderr, flush=True)
+                return f.backend or ""
+        return None
+
     # --- checkpoint-sink faults (runtime.checkpoint.save/save_shards) ----
     def sink_fault(self, step: int) -> None:
         """Called at the top of a checkpoint write: transient-error and
@@ -353,7 +400,16 @@ def plan_for(cfg=None) -> Optional[FaultPlan]:
     ``HEAT_TPU_FAULTS``. Plans cache per spec so firing state (fire-once,
     sink-error budgets) is shared across the driver and the checkpoint
     module within a process."""
-    spec = (getattr(cfg, "inject", "") or os.environ.get(ENV_VAR, "")).strip()
+    spec = getattr(cfg, "inject", "") or os.environ.get(ENV_VAR, "")
+    return plan_for_spec(spec)
+
+
+def plan_for_spec(spec: str) -> Optional[FaultPlan]:
+    """A plan for a raw spec string — the fleet router's ``--inject``
+    flag has no HeatConfig to hang the spec on. Same cache and firing
+    state as ``plan_for``; same strictly-opt-in contract (empty spec ->
+    None, one falsy test on the forward path)."""
+    spec = (spec or "").strip()
     if not spec:
         return None
     plan = _PLANS.get(spec)

@@ -393,6 +393,53 @@ def usage_payload(engine: Engine) -> dict:
     return payload
 
 
+def status_payload(engine: Engine) -> dict:
+    """The ``GET /v1/status`` body: the machine-readable twin of
+    ``/statusz``, shaped for a fleet router's placement policy
+    (heat_tpu/fleet/placement.py) — per-tenant queue depths, backlog
+    step sums, the online cost-model rows (so the router can convert
+    queue work into predicted backlog seconds), SLO burn gauges (the
+    burn-aware demotion signal), mega capability (oversized-request
+    routing), checkpoint generation (the steal handshake), and the
+    prober counters the health checker folds in. Pure function of the
+    engine so placement tests can assert on it without a socket; the
+    handler adds the gateway-scoped fields (address, drained)."""
+    s = engine.summary()
+    pr = engine.prober.stats() if engine.prober is not None else None
+    mega_lanes = int(s.get("mega_lanes", 0) or 0)
+    return {
+        "kind": "heat-tpu-engine-status",
+        "uptime_s": round(trace_mod.process_uptime_s(), 3),
+        "online": bool(engine.online),
+        "draining": bool(engine.draining),
+        "loop_error": (f"{type(engine.loop_error).__name__}: "
+                       f"{engine.loop_error}"
+                       if engine.loop_error is not None else None),
+        "policy": s["policy"],
+        "dispatch_depth": s["dispatch_depth"],
+        "requests": {st: s.get(st, 0)
+                     for st in (*TERMINAL_STATUSES, "queued", "running")},
+        "queued_now": s.get("queued_now", 0),
+        "queue_depths": engine.queue_depths(),
+        "backlog": engine.backlog_snapshot(),
+        "cost_model": s.get("cost_model") or [],
+        "slo_burn": s.get("slo_burn") or {},
+        "shed": s.get("shed", 0),
+        "watchdog_fired": s.get("watchdog_fired", 0),
+        "mega": {"lanes": mega_lanes,
+                 "capable": mega_lanes > 0,
+                 "buckets": [int(b) for b in engine.scfg.buckets],
+                 "max_bucket": max((int(b) for b in engine.scfg.buckets),
+                                   default=0)},
+        "engine_ckpt": {"generation": s.get("engine_ckpt_generation", 0),
+                        "interval": s.get("engine_ckpt_interval", 0),
+                        "dir": engine.engine_ckpt_dir()},
+        "serve_resumed": s.get("serve_resumed", 0),
+        "probe": pr,
+        "flightrec_dumps": engine.tracer.dumps,
+    }
+
+
 def render_statusz(engine: Engine) -> str:
     """The ``GET /statusz`` page: one human-readable snapshot of the
     serving process for an operator mid-incident — counters, the online
@@ -705,6 +752,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._text(200, render_statusz(eng), "text/plain; charset=utf-8")
         elif path == "/v1/usage":
             self._json(200, usage_payload(eng))
+        elif path == "/v1/status":
+            payload = status_payload(eng)
+            payload["address"] = self.gw.address
+            payload["drained"] = self.gw.wait_drained(0)
+            self._json(200, payload)
         elif path == "/tracez":
             # the flight recorder's ring, on demand: a Chrome trace JSON
             # snapshot of the engine as it runs (loadable in Perfetto —
@@ -744,6 +796,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._drainz(parts)
         elif parts.path == "/v1/solve":
             self._solve(parts)
+        elif parts.path == "/v1/resume":
+            self._resume()
         else:
             self._json(404, {"error": f"no route for POST {parts.path}"})
 
@@ -760,6 +814,46 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(200, {"draining": True, "drained": drained,
                          "handoff": handoff,
                          "queued": sum(eng.queue_depths().values())})
+
+    def _resume(self) -> None:
+        """``POST /v1/resume`` body ``{"dir": PATH}``: re-admit the work
+        a sibling engine checkpointed under ``PATH`` into THIS (live)
+        engine through ``resume_engine``'s skip-set front door — the
+        receiving half of the fleet router's checkpoint-handoff work
+        steal (`/drainz?handoff=1` on the victim is the sending half).
+        Returns the manifest generation plus the recovered/done id
+        lists so the router knows exactly which orphans to poll here
+        and which to re-drive fresh."""
+        from . import resume as resume_mod
+
+        eng = self.gw.engine
+        if eng.draining:
+            self._json(503, {"error": "draining: this backend cannot "
+                                      "adopt work (/drainz)"},
+                       headers=[("Retry-After",
+                                 int(self.gw.retry_after_s))])
+            return
+        body = self._read_body()
+        if body is None:
+            return
+        try:
+            obj = json.loads(body.decode("utf-8", "replace") or "{}")
+            resume_dir = obj["dir"]
+        except (ValueError, KeyError, TypeError):
+            self._json(400, {"error": "expected a JSON body "
+                                      "{\"dir\": PATH}"})
+            return
+        try:
+            # skip_known: the router's re-drive can race the manifest —
+            # ids this engine already holds are skipped, not a conflict
+            detail = resume_mod.resume_engine_detail(eng, resume_dir,
+                                                     skip_known=True)
+        except ValueError as e:
+            # fingerprint mismatch: the manifest does not belong on
+            # this backend — a structured conflict, not a 500
+            self._json(409, {"error": str(e)})
+            return
+        self._json(200, detail)
 
     # --- /v1/solve --------------------------------------------------------
     def _read_body(self) -> Optional[bytes]:

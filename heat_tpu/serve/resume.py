@@ -32,7 +32,7 @@ Recovery contract (tests/test_serve_resume.py):
 
 from __future__ import annotations
 
-from typing import Set
+from typing import Dict, Set
 
 from ..config import HeatConfig
 from ..runtime import checkpoint as ckpt_mod
@@ -50,24 +50,48 @@ def config_from_manifest(d: dict) -> HeatConfig:
 
 def resume_engine(eng, resume_dir) -> Set[str]:
     """Re-admit every request recovered from the newest valid engine
-    manifest in ``resume_dir`` into ``eng`` (a fresh, not-yet-running
-    Engine). Returns the set of request ids the manifest accounts for
-    (in-flight + queued + done) so callers can skip re-submitting them.
+    manifest in ``resume_dir`` into ``eng``. Returns the set of request
+    ids the manifest accounts for (in-flight + queued + done) so callers
+    can skip re-submitting them. See :func:`resume_engine_detail` for
+    the structured form (the fleet router's steal path needs to know
+    which ids were re-admitted vs already done)."""
+    d = resume_engine_detail(eng, resume_dir)
+    return set(d["recovered"]) | set(d["done"])
+
+
+def resume_engine_detail(eng, resume_dir, skip_known: bool = False) -> Dict:
+    """Re-admit every request recovered from the newest valid engine
+    manifest in ``resume_dir`` into ``eng`` — a fresh not-yet-running
+    Engine (``serve --resume``) or a LIVE one (the fleet router's
+    checkpoint-handoff steal, POST /v1/resume): ``Engine.submit`` is the
+    one admission door either way and it is thread-safe. Returns
+    ``{"generation", "recovered", "done"}`` where ``recovered`` lists
+    the in-flight + queued ids re-admitted (replay order) and ``done``
+    the ids the manifest says already finished.
 
     No restorable generation (empty/missing dir, or every candidate
     quarantined) is a loud fresh start, not an error — the service must
     come up even when the checkpoint state is gone.
+
+    ``skip_known=True`` (the live ``POST /v1/resume`` door) tolerates
+    manifest entries whose ids this engine already knows: the router's
+    retry/re-drive can race the manifest landing, and the raced rows
+    must not poison the rest of the replay. The strict default stays for
+    ``serve --resume`` — a fresh engine with colliding ids is a caller
+    bug, not a race.
     """
     manifest, path = ckpt_mod.latest_engine_manifest(resume_dir)
     if manifest is None:
         master_print(f"engine resume: no restorable generation under "
                      f"{resume_dir} — starting fresh")
-        return set()
+        return {"generation": 0, "recovered": [], "done": []}
     gen = int(manifest["generation"])
     with eng._lock:
         # never re-publish a generation number this lineage already used
         eng._engine_ckpt_next = max(eng._engine_ckpt_next, gen + 1)
         eng._engine_ckpt_gen = gen
+    recovered = []
+    skipped = 0
     rows = ([("inflight", e) for e in manifest["inflight"]]
             + [("queued", e) for e in manifest["queued"]])
     # original submit order: the policy queues' deterministic tiebreak
@@ -90,11 +114,19 @@ def resume_engine(eng, resume_dir) -> Set[str]:
                        "chunks": int(e.get("chunks", 0)),
                        "lane_s": float(e.get("lane_s", 0.0)),
                        "numerics": e.get("numerics")}
-        rid = eng.submit(cfg, request_id=e["id"],
-                         deadline_ms=e.get("deadline_ms"),
-                         tenant=e.get("tenant"), slo_class=e.get("class"),
-                         until=e.get("until"), tol=e.get("tol"),
-                         _restore=restore)
+        try:
+            rid = eng.submit(cfg, request_id=e["id"],
+                             deadline_ms=e.get("deadline_ms"),
+                             tenant=e.get("tenant"),
+                             slo_class=e.get("class"),
+                             until=e.get("until"), tol=e.get("tol"),
+                             _restore=restore)
+        except ValueError as ex:
+            if skip_known and "duplicate request id" in str(ex):
+                skipped += 1
+                continue
+            raise
+        recovered.append(rid)
         json_record("serve_resumed", id=rid, generation=gen, state=state,
                     steps_done=int(e.get("steps_done", 0)),
                     remaining=int(e.get("remaining", cfg.ntime)),
@@ -103,5 +135,8 @@ def resume_engine(eng, resume_dir) -> Set[str]:
     master_print(f"engine resume: generation {gen} ({path.name}) — "
                  f"{len(manifest['inflight'])} in-flight re-admitted at "
                  f"their last boundary, {len(manifest['queued'])} queued "
-                 f"re-queued in policy order, {len(done)} already done")
-    return {e["id"] for _, e in rows} | set(done)
+                 f"re-queued in policy order, {len(done)} already done"
+                 + (f", {skipped} already known here (skipped)"
+                    if skipped else ""))
+    return {"generation": gen, "recovered": recovered, "done": done,
+            "skipped": skipped}

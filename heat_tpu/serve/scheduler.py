@@ -2523,6 +2523,31 @@ class Engine:
         with self._lock:
             return {t: n for t, n in self._queued_by_tenant.items() if n}
 
+    def backlog_snapshot(self) -> Dict[str, int]:
+        """Queued/running work totals for fleet placement (the
+        ``GET /v1/status`` control endpoint): integer step sums a router
+        converts to predicted backlog seconds via the cost-model rows it
+        already scrapes. Reads records only — never runner state, which
+        is scheduler-thread-confined — so any HTTP handler thread may
+        call this under the engine lock. ``running_steps_bound`` counts
+        each resident request at its full ``ntime`` (an upper bound: the
+        device-side remaining count is not mirrored here), which is the
+        conservative side for load balancing."""
+        queued_req = queued_steps = running_req = running_steps = 0
+        with self._lock:
+            for rec in self._by_id.values():
+                st = rec.get("status")
+                if st == "queued":
+                    queued_req += 1
+                    queued_steps += int(rec.get("ntime") or 0)
+                elif st == "running":
+                    running_req += 1
+                    running_steps += int(rec.get("ntime") or 0)
+        return {"queued_requests": queued_req,
+                "queued_steps": queued_steps,
+                "running_requests": running_req,
+                "running_steps_bound": running_steps}
+
     # --- engine-state checkpointing (ISSUE 17) ----------------------------
     def engine_ckpt_dir(self) -> str:
         """Resolved manifest directory: explicit --engine-ckpt-dir, else

@@ -1,0 +1,377 @@
+"""Live fleet-router e2e over real localhost sockets (heat_tpu/fleet).
+
+Two in-process gateways behind one router; every socket op and wait is
+bounded so the suite cannot wedge tier-1. The load-bearing contracts:
+
+- concurrent POSTs through the router come back byte-identical to
+  direct-to-engine solves of the same configs (the router adds routing,
+  never arithmetic);
+- edge admission: malformed/duplicate lines are rejected AT the router
+  with structured records and never reach a backend;
+- ``backend-down`` chaos: a dropped backend's never-admitted batch
+  retries on the alternate backend, the loss flight-dumps the router's
+  fleet timeline, and every request still finishes ok;
+- checkpoint-handoff work stealing: ``Router.steal`` drains the victim
+  to its engine manifest, resumes it on the thief (mid-flight lanes
+  continue at their checkpointed boundary), and the final npz bytes are
+  identical to an unmigrated run.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig
+from heat_tpu.fleet.registry import BackendRegistry, parse_backends
+from heat_tpu.fleet.router import FleetConfig, Router, render_fleet_metrics
+from heat_tpu.runtime import faults
+from heat_tpu.serve import Engine, ServeConfig
+from heat_tpu.serve.gateway import Gateway
+
+TIMEOUT = 60
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def make_backend(tmp_path, name, **scfg_kw):
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    scfg_kw.setdefault("emit_records", False)
+    scfg_kw.setdefault("lanes", 2)
+    scfg_kw.setdefault("chunk", 8)
+    scfg_kw.setdefault("buckets", (32,))
+    scfg_kw.setdefault("out_dir", str(d))
+    scfg_kw.setdefault("engine_ckpt_interval", 2)
+    scfg_kw.setdefault("engine_ckpt_dir", str(d / "ckpt"))
+    eng = Engine(ServeConfig(**scfg_kw))
+    return Gateway(eng, "127.0.0.1", 0).start()
+
+
+def make_fleet(tmp_path, n_backends=2, fcfg=None, **scfg_kw):
+    gws = [make_backend(tmp_path, f"g{i}", **scfg_kw)
+           for i in range(n_backends)]
+    spec = ",".join(f"b{i}={gw.address}" for i, gw in enumerate(gws))
+    reg = BackendRegistry(parse_backends(spec))
+    rt = Router(reg, "127.0.0.1", 0,
+                fcfg or FleetConfig(health_interval_s=0.3)).start()
+    return rt, gws
+
+
+def close_fleet(rt, gws):
+    rt.close()
+    for gw in gws:
+        try:
+            gw.request_drain()
+            gw.wait_drained(TIMEOUT)
+        finally:
+            gw.close()
+
+
+def post_solve(rt, body, headers=(), query="", timeout=TIMEOUT):
+    """Streaming POST through the router; returns (status, records,
+    response-headers)."""
+    import http.client
+
+    conn = http.client.HTTPConnection(rt.host, rt.port, timeout=timeout)
+    conn.request("POST", f"/v1/solve{query}", body=body.encode(),
+                 headers=dict(headers))
+    resp = conn.getresponse()
+    recs = []
+    while True:
+        raw = resp.readline()
+        if not raw:
+            break
+        raw = raw.strip()
+        if raw:
+            recs.append(json.loads(raw))
+    status, hdrs = resp.status, resp.headers
+    conn.close()
+    return status, recs, hdrs
+
+
+def get_json(rt, path, timeout=TIMEOUT):
+    import http.client
+
+    conn = http.client.HTTPConnection(rt.host, rt.port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    out = (resp.status, json.loads(resp.read()))
+    conn.close()
+    return out
+
+
+def line(**kw):
+    return json.dumps(kw) + "\n"
+
+
+def wait_until(pred, timeout=TIMEOUT, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# --- routing + bit-identity --------------------------------------------------
+
+
+def test_fleet_routes_concurrent_posts_bit_identical(tmp_path):
+    """Acceptance e2e: concurrent client POSTs through the router are
+    spread across both backends and the npz outputs are bit-identical
+    to direct solo solves; fleet metrics/status/usage reconcile."""
+    rt, gws = make_fleet(tmp_path)
+    try:
+        time.sleep(0.5)   # one probe round -> status payloads exist
+        cfgs = {f"r{i}": dict(n=24, ntime=48 + 16 * (i % 2),
+                              dtype="float64", ic="hat", bc="edges",
+                              nu=0.05 + 0.05 * (i % 2))
+                for i in range(6)}
+        results = {}
+
+        def post(ids):
+            body = "".join(line(id=i, **cfgs[i]) for i in ids)
+            st, recs, hdrs = post_solve(rt, body)
+            for r in recs:
+                results[r["id"]] = (st, r, hdrs)
+
+        threads = [threading.Thread(target=post, args=(ids,))
+                   for ids in (["r0", "r1", "r2"], ["r3", "r4", "r5"])]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(TIMEOUT)
+            assert not t.is_alive()
+        assert set(results) == set(cfgs)
+        for rid, (st, rec, _) in results.items():
+            assert st == 200 and rec["status"] == "ok", rec
+            assert "T" not in rec
+        snap = rt.snapshot()
+        per_backend = {n: b["delivered"]
+                       for n, b in snap["backends"].items()}
+        assert sum(per_backend.values()) == 6
+        assert all(v > 0 for v in per_backend.values()), \
+            f"least-loaded starved a backend: {per_backend}"
+        # fleet usage reconciles exactly with the per-engine ledgers
+        _, usage = get_json(rt, "/v1/usage")
+        assert usage["totals"]["requests"] == 6
+        assert usage["totals"]["steps"] == sum(
+            p["totals"]["steps"] for p in usage["per_backend"].values())
+        # metrics render with per-backend labels
+        metrics = render_fleet_metrics(rt)
+        assert 'heat_tpu_fleet_backend_up{backend="b0"} 1' in metrics
+        assert 'heat_tpu_fleet_delivered_total{backend=' in metrics
+        assert "heat_tpu_fleet_duplicates_dropped_total 0" in metrics
+        # GET /v1/requests/<id> serves the delivered record at the edge
+        st, rec = get_json(rt, "/v1/requests/r0")
+        assert st == 200 and rec["status"] == "ok"
+        st, _ = get_json(rt, "/v1/requests/nope")
+        assert st == 404
+    finally:
+        close_fleet(rt, gws)
+    # byte-identity: whichever backend served each request, its npz is
+    # the direct solve's bytes
+    for rid, kw in cfgs.items():
+        paths = [p for p in (tmp_path / "g0" / f"{rid}.npz",
+                             tmp_path / "g1" / f"{rid}.npz") if p.exists()]
+        assert len(paths) == 1, f"{rid}: expected exactly one npz"
+        with np.load(paths[0]) as z:
+            np.testing.assert_array_equal(
+                z["T"], solve(HeatConfig(**kw)).T)
+
+
+def test_edge_admission_and_trace_propagation(tmp_path):
+    """Malformed and duplicate lines die at the router edge with
+    structured records; the inbound X-Trace-Id is echoed and the
+    router's own tracer carries backend tracks."""
+    rt, gws = make_fleet(tmp_path)
+    try:
+        body = ('this is not json\n'
+                + line(id="ok1", n=24, ntime=16, dtype="float64")
+                + line(id="dup", n=24, ntime=16, dtype="float64")
+                + line(id="dup", n=24, ntime=16, dtype="float64")
+                + line(id="bad", n=-5, ntime=16))
+        st, recs, hdrs = post_solve(rt, body,
+                                    headers=[("X-Trace-Id", "fleet.e2e")])
+        assert st == 200
+        assert hdrs["X-Trace-Id"] == "fleet.e2e"
+        by_status = {}
+        for r in recs:
+            by_status.setdefault(r["status"], []).append(r)
+        assert len(by_status["rejected"]) == 3   # parse, duplicate, bad
+        ids_ok = [r["id"] for r in by_status["ok"]]
+        assert sorted(ids_ok) == ["dup", "ok1"]  # first dup wins
+        assert any("duplicate request id" in r["error"]
+                   for r in by_status["rejected"])
+        # backends saw exactly the two valid requests, not the garbage
+        snap = rt.snapshot()
+        assert sum(b["routed"] for b in snap["backends"].values()) == 2
+        assert snap["router"]["edge_rejected"] >= 3
+        # the fleet timeline carries per-backend tracks + solve spans
+        chrome = rt.tracer.to_chrome()
+        names = {e.get("name") for e in chrome["traceEvents"]}
+        assert any(str(n).startswith("backend b") for n in
+                   {e["args"].get("name") for e in chrome["traceEvents"]
+                    if e.get("ph") == "M" and "name" in e.get("args", {})})
+        assert "ok1" in names   # synthesized backend solve span
+    finally:
+        close_fleet(rt, gws)
+
+
+# --- chaos: backend-down retry + flight dump ---------------------------------
+
+
+def test_backend_down_retries_on_alternate_and_flight_dumps(tmp_path):
+    """backend-down@N drops a backend's TCP target mid-dispatch: its
+    never-admitted batch retries on the alternate, every request still
+    comes back ok + byte-identical, the health probe notices the down
+    transition, and the router flight-dumps its fleet timeline."""
+    rt, gws = make_fleet(
+        tmp_path,
+        fcfg=FleetConfig(health_interval_s=0.3, inject="backend-down@4",
+                         flightrec_dir=str(tmp_path)))
+    try:
+        time.sleep(0.5)
+        body = "".join(line(id=f"k{i}", n=24, ntime=48, dtype="float64")
+                       for i in range(6))
+        st, recs, _ = post_solve(rt, body)
+        assert st == 200
+        statuses = {r["id"]: r["status"] for r in recs}
+        assert statuses == {f"k{i}": "ok" for i in range(6)}, statuses
+        snap = rt.snapshot()
+        downed = [n for n, b in snap["backends"].items()
+                  if b["fault_down"]]
+        assert len(downed) == 1
+        survivor = [n for n in snap["backends"] if n not in downed][0]
+        assert snap["backends"][survivor]["delivered"] == 6
+        assert snap["router"]["duplicates"] == 0
+        # the health loop sees the drop and recovery flight-dumps the
+        # fleet timeline exactly once for the lost backend
+        assert wait_until(lambda: rt.tracer.dumps >= 1)
+        assert wait_until(
+            lambda: rt.snapshot()["backends"][downed[0]]["lost"])
+        assert list(tmp_path.glob("flightrec-*.trace.json"))
+    finally:
+        close_fleet(rt, gws)
+    for i in range(6):
+        paths = [p for p in (tmp_path / "g0" / f"k{i}.npz",
+                             tmp_path / "g1" / f"k{i}.npz") if p.exists()]
+        assert len(paths) == 1
+        with np.load(paths[0]) as z:
+            np.testing.assert_array_equal(
+                z["T"],
+                solve(HeatConfig(n=24, ntime=48, dtype="float64")).T)
+
+
+# --- work stealing as checkpoint handoff -------------------------------------
+
+
+def test_steal_migrates_checkpointed_work_bit_identically(tmp_path):
+    """The headline: load one backend through the router, join an idle
+    one via the backends file (live registry refresh), then steal — the
+    victim drains to its engine manifest (/drainz?handoff=1), the thief
+    resumes it (mid-flight lanes continue at their last checkpointed
+    boundary, serve_resumed > 0 on /v1/status), and every npz is
+    byte-identical to an unmigrated solve."""
+    g0 = make_backend(tmp_path, "g0")
+    g1 = make_backend(tmp_path, "g1")
+    bfile = tmp_path / "backends.txt"
+    bfile.write_text(f"b0={g0.address}\n")
+    reg = BackendRegistry(backends_file=bfile)
+    rt = Router(reg, "127.0.0.1", 0,
+                FleetConfig(health_interval_s=0.25)).start()
+    try:
+        time.sleep(0.4)
+        # slow work: sink-slow serializes 400ms per record on the
+        # victim's writer thread, so the queue is still deep when the
+        # steal fires (per-request inject — engine-side fault kind)
+        body = "".join(line(id=f"s{i}", n=24, ntime=96, dtype="float64",
+                            inject="sink-slow:ms=400") for i in range(6))
+        st, accept, _ = post_solve(rt, body, query="?wait=0")
+        assert st == 202 and len(accept[0]["accepted"]) == 6
+        time.sleep(1.2)   # b0 mid-flight on the slow work
+        # the idle thief joins the fleet live via the backends file
+        bfile.write_text(f"b0={g0.address}\nb1={g1.address}\n")
+        assert wait_until(lambda: reg.get("b1") is not None, timeout=10)
+        ev = rt.steal("b0", "b1", reason="test")
+        assert ev is not None and ev["thief"] == "b1"
+        assert ev["generation"] >= 1
+        assert ev["recovered"] >= 1, ev   # manifest-covered work moved
+        assert ev["recovered"] + ev["redriven"] >= 1
+        assert ev["wall_s"] < TIMEOUT
+        # every request reaches a terminal ok record through the router
+        assert wait_until(lambda: rt.pending_count() == 0), \
+            rt.snapshot()
+        for i in range(6):
+            st, rec = get_json(rt, f"/v1/requests/s{i}")
+            assert st == 200 and rec["status"] == "ok", rec
+        # the thief's status payload proves a real resume happened
+        snap = rt.snapshot()
+        assert snap["backends"]["b1"]["serve_resumed"] >= 1
+        assert snap["backends"]["b0"]["lost"]
+        assert snap["router"]["duplicates"] == 0
+        assert rt.registry.get("b0").stolen_from == 1
+        assert rt.registry.get("b1").stolen_to == 1
+        from heat_tpu.fleet.router import render_fleet_statusz
+        assert "b0 -> b1 [test]" in render_fleet_statusz(rt)
+    finally:
+        rt.close()
+        g1.request_drain()
+        g1.wait_drained(TIMEOUT)
+        g0.close()
+        g1.close()
+    # byte-identity across the migration: same bytes as a solo solve,
+    # whether the request finished on the victim, resumed mid-flight on
+    # the thief, or was re-driven fresh
+    ref = solve(HeatConfig(n=24, ntime=96, dtype="float64")).T
+    for i in range(6):
+        paths = [p for p in (tmp_path / "g0" / f"s{i}.npz",
+                             tmp_path / "g1" / f"s{i}.npz") if p.exists()]
+        assert paths, f"s{i}: npz missing"
+        with np.load(paths[-1]) as z:
+            np.testing.assert_array_equal(z["T"], ref)
+
+
+def test_router_healthz_drain_and_empty_fleet(tmp_path):
+    """Router lifecycle plumbing: healthz reflects backend health,
+    /drainz stops admission with 503, an all-down fleet rejects with a
+    structured unroutable record."""
+    rt, gws = make_fleet(tmp_path, n_backends=1)
+    try:
+        st, h = get_json(rt, "/healthz")
+        assert st == 200 and h["backends_up"] == 1
+        # drain: admission stops, healthz flips 503
+        st, d = get_json(rt, "/drainz")
+        assert st == 200 and d["draining"]
+        st, _ = get_json(rt, "/healthz")
+        assert st == 503
+        st, recs, _ = post_solve(rt, line(id="late", n=24, ntime=16,
+                                          dtype="float64"))
+        assert st == 503
+    finally:
+        close_fleet(rt, gws)
+
+
+def test_unroutable_when_every_backend_is_down(tmp_path):
+    """No eligible backend -> terminal rejection records at the edge
+    (router-502 flavor: error says 'unroutable', never silence)."""
+    rt, gws = make_fleet(tmp_path, n_backends=1)
+    try:
+        rt.registry.set_fault_down("b0")
+        st, recs, _ = post_solve(rt, line(id="x", n=24, ntime=16,
+                                          dtype="float64"))
+        assert st == 200
+        (rec,) = recs
+        assert rec["status"] == "rejected"
+        assert "unroutable" in rec["error"]
+    finally:
+        close_fleet(rt, gws)

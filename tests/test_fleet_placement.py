@@ -1,0 +1,269 @@
+"""Socket-free fleet unit tests: placement policy math over fake
+``GET /v1/status`` payloads, backend-spec parsing, the fleet fault
+kinds, and the pure usage merge (heat_tpu/fleet — ISSUE 18).
+
+Everything here is a pure function of Backend snapshots + dicts; the
+live router (sockets, steals, chaos) is tests/test_fleet.py.
+"""
+
+import json
+
+import pytest
+
+from heat_tpu.fleet import placement
+from heat_tpu.fleet.registry import (Backend, BackendRegistry,
+                                     load_backends_file, parse_backends)
+from heat_tpu.fleet.router import merge_usage
+from heat_tpu.runtime import faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def status(queued_steps=0, running_steps=0, s_per_lane_step=None,
+           fast_burn=0.0, slow_burn=0.0, max_bucket=32, mega=False):
+    """A fake /v1/status payload with just the fields placement reads."""
+    cost = ([{"bucket": "2d/n32/l2", "ewma_s_per_lane_step":
+              s_per_lane_step, "chunks": 100}]
+            if s_per_lane_step is not None else [])
+    return {"backlog": {"queued_steps": queued_steps,
+                        "running_steps_bound": running_steps},
+            "cost_model": cost,
+            "slo_burn": {"standard": {"fast_burn": fast_burn,
+                                      "slow_burn": slow_burn}},
+            "mega": {"capable": mega, "max_bucket": max_bucket}}
+
+
+def backend(name, st=None, pending_steps=0, healthy=True):
+    b = Backend(name, f"127.0.0.1:{8000 + abs(hash(name)) % 1000}")
+    b.status = st
+    b.pending_steps = pending_steps
+    b.healthy = healthy
+    return b
+
+
+# --- backend spec parsing ----------------------------------------------------
+
+
+def test_parse_backends_names_and_defaults():
+    got = parse_backends("10.0.0.1:8080, east=10.0.0.2:9090 ,10.0.0.3:70")
+    assert got == [("b0", "10.0.0.1:8080"), ("east", "10.0.0.2:9090"),
+                   ("b2", "10.0.0.3:70")]
+
+
+@pytest.mark.parametrize("spec", ["nohost", "host:", ":123", "h:12x",
+                                  "a=1.2.3.4:80,a=4.3.2.1:80",
+                                  "x=1.1.1.1:1,y=1.1.1.1:1"])
+def test_parse_backends_rejects_bad_and_duplicate(spec):
+    with pytest.raises(ValueError):
+        parse_backends(spec)
+
+
+def test_backends_file_grammar_and_live_join(tmp_path):
+    f = tmp_path / "backends.txt"
+    f.write_text("# fleet members\none=127.0.0.1:7001\n\n127.0.0.1:7002  "
+                 "# unnamed -> positional\n")
+    assert load_backends_file(f) == [("one", "127.0.0.1:7001"),
+                                     ("b1", "127.0.0.1:7002")]
+    reg = BackendRegistry(backends_file=f)
+    assert [b.name for b in reg.snapshot()] == ["one", "b1"]
+    # same mtime -> no re-read; touched file with a new line -> live join
+    assert reg.refresh_file() == []
+    f.write_text(f.read_text() + "late=127.0.0.1:7003\n")
+    import os
+    os.utime(f, (0, 2**31 - 1))   # force an mtime move
+    assert reg.refresh_file() == ["late"]
+    # removing every line never evicts live members
+    f.write_text("")
+    os.utime(f, (0, 2**31 - 2))
+    assert reg.refresh_file() == []
+    assert len(reg.snapshot()) == 3
+
+
+# --- least-loaded math -------------------------------------------------------
+
+
+def test_least_loaded_picks_smallest_predicted_backlog():
+    # same cost model, different queue work: 1000 steps vs 100 steps
+    a = backend("a", status(queued_steps=1000, s_per_lane_step=1e-3))
+    b = backend("b", status(queued_steps=100, s_per_lane_step=1e-3))
+    chosen, decision = placement.choose("least-loaded", [a, b], 16, 0)
+    assert chosen is b
+    assert decision["backlog_s"]["a"] == pytest.approx(1.0)
+    assert decision["backlog_s"]["b"] == pytest.approx(0.1)
+
+
+def test_least_loaded_weighs_cost_model_not_just_steps():
+    # fewer steps on a 10x slower backend is MORE predicted seconds
+    slow = backend("slow", status(queued_steps=200, s_per_lane_step=1e-2))
+    fast = backend("fast", status(queued_steps=1000, s_per_lane_step=1e-4))
+    chosen, _ = placement.choose("least-loaded", [slow, fast], 16, 0)
+    assert chosen is fast
+
+
+def test_router_pending_counts_toward_backlog():
+    # equal payloads; the router just routed 500 steps to `a` that the
+    # backend's own status cannot know about yet
+    a = backend("a", status(s_per_lane_step=1e-3), pending_steps=500)
+    b = backend("b", status(s_per_lane_step=1e-3))
+    chosen, _ = placement.choose("least-loaded", [a, b], 16, 1)
+    assert chosen is b
+    assert placement.predicted_backlog_s(a) == pytest.approx(0.5)
+
+
+def test_cold_fleet_tiebreak_is_starvation_free():
+    # no status payloads at all: every backend ties at the prior; the
+    # round-robin tiebreak must rotate through ALL of them
+    fleet = [backend(n) for n in ("a", "b", "c")]
+    seen = {placement.choose("least-loaded", fleet, 16, rr)[0].name
+            for rr in range(6)}
+    assert seen == {"a", "b", "c"}
+
+
+# --- burn-aware demotion -----------------------------------------------------
+
+
+def test_burn_demotion_needs_both_windows():
+    only_fast = status(fast_burn=5.0, slow_burn=0.2)
+    only_slow = status(fast_burn=0.2, slow_burn=5.0)
+    both = status(fast_burn=2.0, slow_burn=1.5)
+    assert not placement.burn_demoted(only_fast)
+    assert not placement.burn_demoted(only_slow)
+    assert placement.burn_demoted(both)
+    assert not placement.burn_demoted(None)
+
+
+def test_burning_backend_demoted_unless_everyone_burns():
+    burning = backend("burning", status(fast_burn=3.0, slow_burn=2.0,
+                                        s_per_lane_step=1e-4))
+    healthy = backend("healthy", status(queued_steps=10_000,
+                                        s_per_lane_step=1e-3))
+    # burning backend is empty and fast — but demoted, so the loaded
+    # healthy one still wins
+    chosen, decision = placement.choose("least-loaded",
+                                        [burning, healthy], 16, 0)
+    assert chosen is healthy
+    assert decision["demoted"] == ["burning"]
+    # when EVERY candidate burns, demotion is moot — work must land
+    all_burn = [backend("x", status(fast_burn=2, slow_burn=2)),
+                backend("y", status(fast_burn=2, slow_burn=2))]
+    chosen, _ = placement.choose("least-loaded", all_burn, 16, 0)
+    assert chosen is not None
+
+
+# --- mega-capability routing -------------------------------------------------
+
+
+def test_oversized_requests_only_go_to_mega_backends():
+    small = backend("small", status(max_bucket=32, mega=False))
+    mega = backend("mega", status(queued_steps=100_000, max_bucket=32,
+                                  mega=True, s_per_lane_step=1e-3))
+    # n=48 overflows max_bucket=32: only the (loaded!) mega backend
+    chosen, _ = placement.choose("least-loaded", [small, mega], 48, 0)
+    assert chosen is mega
+    # n=32 fits: the empty non-mega backend wins on backlog
+    chosen, _ = placement.choose("least-loaded", [small, mega], 32, 0)
+    assert chosen is small
+    # nothing mega-capable -> unroutable, reason says so
+    chosen, decision = placement.choose("least-loaded", [small], 48, 0)
+    assert chosen is None
+    assert decision["reason"] == "no-eligible-backend"
+    # a backend with NO status yet is assumed capable (cold fleet; the
+    # engine itself rejects what it structurally cannot serve)
+    cold = backend("cold")
+    assert placement.choose("least-loaded", [cold], 48, 0)[0] is cold
+
+
+def test_unhealthy_fault_down_lost_are_ineligible():
+    down = backend("down", healthy=False)
+    faulted = backend("faulted")
+    faulted.fault_down = True
+    lost = backend("lost")
+    lost.lost = True
+    ok = backend("ok")
+    chosen, _ = placement.choose("least-loaded",
+                                 [down, faulted, lost, ok], 16, 0)
+    assert chosen is ok
+    assert placement.choose("round-robin", [down, faulted, lost], 16,
+                            0)[0] is None
+
+
+# --- round-robin + policy plumbing ------------------------------------------
+
+
+def test_round_robin_rotates_in_registration_order():
+    fleet = [backend(n) for n in ("a", "b", "c")]
+    picks = [placement.choose("round-robin", fleet, 16, rr)[0].name
+             for rr in range(1, 7)]
+    assert picks == ["b", "c", "a", "b", "c", "a"]
+
+
+def test_unknown_policy_raises():
+    with pytest.raises(ValueError, match="unknown placement policy"):
+        placement.choose("best-effort", [backend("a")], 16, 0)
+
+
+# --- fleet fault kinds (runtime/faults.py satellite) -------------------------
+
+
+def test_backend_down_spec_parses_and_fires_once():
+    plan = faults.plan_for_spec("backend-down@3:backend=b1")
+    assert plan is not None
+    assert plan.backend_down_target(1) is None
+    assert plan.backend_down_target(2) is None
+    assert plan.backend_down_target(3) == "b1"
+    # fire-once: the Nth forward drops the target, later forwards don't
+    assert plan.backend_down_target(4) is None
+
+
+def test_backend_down_without_name_targets_the_routed_backend():
+    plan = faults.plan_for_spec("backend-down@1")
+    assert plan.backend_down_target(1) == ""   # "" = whichever was chosen
+
+
+def test_backend_down_requires_step():
+    with pytest.raises(ValueError, match="@N"):
+        faults.parse_spec("backend-down")
+
+
+def test_backend_slow_sleeps_per_forward(monkeypatch):
+    plan = faults.plan_for_spec("backend-slow:ms=25")
+    slept = []
+    monkeypatch.setattr(faults.time, "sleep", slept.append)
+    plan.backend_slow()
+    plan.backend_slow()
+    assert slept == [0.025, 0.025]
+
+
+def test_empty_spec_stays_none_on_hot_path():
+    assert faults.plan_for_spec("") is None
+    assert faults.plan_for_spec(None) is None
+
+
+# --- usage merge -------------------------------------------------------------
+
+
+def test_merge_usage_reconciles_exactly():
+    def ledger(lane_s, steps, requests):
+        c = {"lane_s": lane_s, "steps": steps, "chunks": steps // 8,
+             "bytes_written": steps * 10, "steps_saved": 0,
+             "requests": requests}
+        return {"tenants": {"acme": {"classes": {"standard": dict(c)}}},
+                "totals": dict(c)}
+
+    merged = merge_usage({"a": ledger(1.5, 800, 4),
+                          "b": ledger(0.5, 200, 2)})
+    assert merged["totals"]["lane_s"] == pytest.approx(2.0)
+    assert merged["totals"]["steps"] == 1000
+    assert merged["totals"]["requests"] == 6
+    cls = merged["tenants"]["acme"]["classes"]["standard"]
+    assert cls["steps"] == 1000 and cls["requests"] == 6
+    # the raw per-backend ledgers ride along, so the reconciliation is
+    # auditable: fleet totals == sum of per-engine totals, exactly
+    assert sum(p["totals"]["steps"]
+               for p in merged["per_backend"].values()) == 1000
+    assert json.dumps(merged)   # JSON-serializable end to end
