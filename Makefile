@@ -10,7 +10,7 @@ SHELL := /bin/bash
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
         numerics-lab steady-lab lane-lab mega-lab resume-lab fleet-lab \
-        perfcheck native run viz clean
+        cache-lab perfcheck native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -37,7 +37,7 @@ race:           # the dynamic race sanitizer over the chaos + serving
                 # raises RaceError and fails the suite
 	env JAX_PLATFORMS=cpu HEAT_TPU_RACECHECK=1 $(PY) -m pytest \
 	  tests/test_chaos.py tests/test_serve.py tests/test_gateway.py \
-	  tests/test_fleet.py -q -p no:cacheprovider
+	  tests/test_fleet.py tests/test_solvecache.py -q -p no:cacheprovider
 
 lint:           # ruff when installed; syntax-level fallback otherwise
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
@@ -159,6 +159,12 @@ fleet-lab:             # pod-scale fleet: 1/2/4 serve subprocesses behind
                        # requests, forced checkpoint-handoff steal with
                        # recovery overhead recorded
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_lab.py
+
+cache-lab:             # solve-cache A/B: repeat-heavy wave cold vs warm
+                       # (warm >= 5x, full hits byte-identical + zero
+                       # device dispatch, prefix steps exactly the delta,
+                       # --cache off bit-identical)
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/serve_cache_lab.py
 
 perfcheck:             # CI perf gate: fresh prof-lab vs committed baseline
                        # (tolerance band) + every committed lab's internal

@@ -177,6 +177,13 @@ phase serve_resume_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_resume
 # /drainz?handoff=1 steal with its recovery wall recorded. CPU-world:
 # runs with the tunnel down.
 phase fleet_lab        1200 env JAX_PLATFORMS=cpu python benchmarks/fleet_lab.py
+# Solve-cache A/B (ISSUE 19): a repeat-heavy 32-request wave cold vs
+# warm against one shared cache dir — warm wave >= 5x cold with every
+# request a full hit (zero device chunk programs, zero billed steps,
+# npz byte-identical to the cold run), a 33%-deeper request stepping
+# exactly the prefix delta, and --cache off byte-identical to cached.
+# CPU-world: runs with the tunnel down.
+phase serve_cache_lab  1200 env JAX_PLATFORMS=cpu python benchmarks/serve_cache_lab.py
 # Invariant guard (ISSUE 11 + 14): lint + the project-native
 # static-analysis suite (hot-path purity, lock discipline, traced-code
 # determinism, Mosaic kernel safety, race lockset inference) + the

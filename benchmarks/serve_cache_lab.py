@@ -1,0 +1,181 @@
+"""Solve-cache A/B: content-addressed result reuse under repeat load.
+
+The ISSUE-19 claim, measured: a repeat-heavy wave against a warm cache
+must cost file copies, not device steps. One wave of ``--requests``
+requests spanning ``--distinct`` distinct physics configs runs twice
+through the dispatch-ahead engine sharing one ``--cache-dir``:
+
+- **cold**: empty cache — every distinct config computes (intra-wave
+  repeats may hit entries published mid-drain; that is the production
+  behavior and is measured as such);
+- **warm**: a fresh engine over the SAME wave and the now-populated
+  cache — every request must be a full hit: zero device chunk programs
+  dispatched, zero billed steps, npz bytes identical to the cold run's.
+
+Three acceptance gates ride in the artifact (perfcheck-enforced):
+
+- ``warm_speedup`` >= 5: the warm wave's wall clock at least 5x under
+  the cold wave's (replay is a byte copy; on a real accelerator the
+  ratio is the solve cost itself);
+- ``full_hit_bit_identical``: every warm npz byte-identical to its
+  cold twin (replay is ``copyfile``, never re-serialization);
+- ``prefix_delta_exact`` + ``prefix_bit_identical``: a request 33%
+  deeper than a cached entry steps exactly the delta
+  (``usage.steps == ntime - cached_step``, the prefix credited as
+  ``steps_saved``) and finishes byte-identical to a cold solo solve
+  of the same config.
+
+``cache_off_bit_identical`` also rides along: ``--cache off`` (the
+default) produces the same bytes as the cold cached run — the cache
+can be disabled without perturbing results.
+
+    JAX_PLATFORMS=cpu python benchmarks/serve_cache_lab.py [--requests 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+from _util import write_atomic
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def build_wave(count: int, distinct: int):
+    from heat_tpu.config import HeatConfig
+
+    sizes = (24, 32, 48)
+    cfgs = [HeatConfig(n=sizes[k % len(sizes)], ntime=96 + 16 * (k % 2),
+                       dtype="float64", ic=("hat", "sine")[k % 2],
+                       bc="edges", nu=0.05 + 0.01 * k)
+            for k in range(distinct)]
+    return [cfgs[i % distinct] for i in range(count)]
+
+
+def run_wave(reqs, out_dir: Path, cache_dir: Path, lanes: int,
+             chunk: int, depth: int, cache: bool = True):
+    from heat_tpu.serve import Engine, ServeConfig
+
+    out_dir.mkdir(parents=True, exist_ok=True)
+    eng = Engine(ServeConfig(lanes=lanes, chunk=chunk, buckets=(32, 48),
+                             dispatch_depth=depth, emit_records=False,
+                             out_dir=str(out_dir), cache=cache,
+                             cache_dir=str(cache_dir)))
+    t0 = time.perf_counter()
+    ids = [eng.submit(cfg) for cfg in reqs]
+    records = eng.results()
+    wall = time.perf_counter() - t0
+    by_id = {r["id"]: r for r in records}
+    return wall, eng, [by_id[i] for i in ids]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--distinct", type=int, default=8)
+    ap.add_argument("--lanes", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--out", default=str(Path(__file__).parent
+                                         / "serve_cache_lab.json"))
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from heat_tpu.serve import Engine, ServeConfig
+
+    reqs = build_wave(args.requests, args.distinct)
+    work = tempfile.mkdtemp(prefix="serve_cache_lab_")
+    cache_dir = Path(work) / "solve-cache"
+
+    cold_wall, cold_eng, cold_recs = run_wave(
+        reqs, Path(work) / "cold", cache_dir, args.lanes, args.chunk,
+        args.depth)
+    warm_wall, warm_eng, warm_recs = run_wave(
+        reqs, Path(work) / "warm", cache_dir, args.lanes, args.chunk,
+        args.depth)
+
+    warm_all_cached = all(r.get("cached") for r in warm_recs)
+    warm_zero_steps = all(r["usage"]["steps"] == 0 for r in warm_recs)
+    bit_identical = all(
+        (Path(work) / "warm" / f"{w['id']}.npz").read_bytes()
+        == (Path(work) / "cold" / f"{c['id']}.npz").read_bytes()
+        for c, w in zip(cold_recs, warm_recs))
+    speedup = cold_wall / warm_wall if warm_wall else float("inf")
+
+    # prefix reuse: one config 33% deeper than its cached entry must
+    # step exactly the delta and finish byte-identical to a cold solo
+    base = reqs[0]
+    deep = base.with_(ntime=base.ntime + base.ntime // 3)
+    delta = deep.ntime - base.ntime
+    _, _, (prefix_rec,) = run_wave(
+        [deep], Path(work) / "prefix", cache_dir, args.lanes,
+        args.chunk, args.depth)
+    solo_eng = Engine(ServeConfig(lanes=args.lanes, chunk=args.chunk,
+                                  buckets=(32, 48),
+                                  dispatch_depth=args.depth,
+                                  emit_records=False))
+    solo_id = solo_eng.submit(deep)
+    solo_rec = {r["id"]: r for r in solo_eng.results()}[solo_id]
+    prefix_delta_exact = (prefix_rec["usage"]["steps"] == delta
+                          and prefix_rec["usage"]["steps_saved"]
+                          == base.ntime)
+    with np.load(Path(work) / "prefix" / f"{prefix_rec['id']}.npz") as z:
+        prefix_bit_identical = np.array_equal(z["T"], solo_rec["T"])
+
+    # --cache off must be byte-identical to the cached cold run
+    off_wall, _, off_recs = run_wave(
+        reqs[:args.distinct], Path(work) / "off", cache_dir, args.lanes,
+        args.chunk, args.depth, cache=False)
+    off_identical = all(
+        (Path(work) / "off" / f"{o['id']}.npz").read_bytes()
+        == (Path(work) / "cold" / f"{c['id']}.npz").read_bytes()
+        for o, c in zip(off_recs, cold_recs[:args.distinct]))
+
+    cold_stats = cold_eng.summary()["cache"]
+    warm_stats = warm_eng.summary()["cache"]
+    rec = {
+        "bench": "serve_cache_lab",
+        "config": {"requests": args.requests, "distinct": args.distinct,
+                   "lanes": args.lanes, "chunk": args.chunk,
+                   "dispatch_depth": args.depth},
+        "cold": {"wall_s": round(cold_wall, 3),
+                 "ok": sum(r["status"] == "ok" for r in cold_recs),
+                 "cache": cold_stats},
+        "warm": {"wall_s": round(warm_wall, 3),
+                 "ok": sum(r["status"] == "ok" for r in warm_recs),
+                 "all_cached": warm_all_cached,
+                 "zero_billed_steps": warm_zero_steps,
+                 "cache": warm_stats},
+        "prefix": {"cached_step": base.ntime, "ntime": deep.ntime,
+                   "stepped": prefix_rec["usage"]["steps"],
+                   "steps_saved": prefix_rec["usage"]["steps_saved"]},
+        "warm_speedup": round(speedup, 2),
+        "warm_speedup_ge_5": speedup >= 5.0,
+        "full_hit_bit_identical": bit_identical,
+        "prefix_delta_exact": prefix_delta_exact,
+        "prefix_bit_identical": prefix_bit_identical,
+        "cache_off_bit_identical": off_identical,
+    }
+    write_atomic(Path(args.out), rec)
+    print(json.dumps(rec, indent=2))
+    passed = (speedup >= 5.0 and bit_identical and warm_all_cached
+              and warm_zero_steps and prefix_delta_exact
+              and prefix_bit_identical and off_identical)
+    print(f"serve_cache_lab: {'OK' if passed else 'FAILED'} — warm wave "
+          f"{speedup:.1f}x cold ({warm_wall:.3f}s vs {cold_wall:.3f}s), "
+          f"{warm_stats['hits_full']} full hit(s), prefix stepped "
+          f"{prefix_rec['usage']['steps']}/{deep.ntime} "
+          f"(saved {prefix_rec['usage']['steps_saved']}), "
+          f"bit-identical={bit_identical}")
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -368,6 +368,29 @@ def build_parser() -> argparse.ArgumentParser:
                        help="where engine-state generations live "
                             "(default: <--out-dir>/engine-ckpt, else "
                             "./engine-ckpt)")
+    serve.add_argument("--cache", default="off", choices=["on", "off"],
+                       help="two-level solve cache (serve/solvecache.py): "
+                            "a request whose canonical physics "
+                            "fingerprint matches a finished result is "
+                            "served from disk byte-identically without "
+                            "occupying a lane (billed cached, zero "
+                            "lane-seconds/steps); a match at a smaller "
+                            "step count seeds the lane from the cached "
+                            "frontier and steps only the delta "
+                            "(steps_saved). Default off — off is "
+                            "bit-identical to builds without the cache")
+    serve.add_argument("--cache-dir", dest="cache_dir", metavar="DIR",
+                       help="where cache entries live (default: "
+                            "<--out-dir>/solve-cache, else ./solve-cache); "
+                            "share one DIR across gateways to let the "
+                            "fleet router serve fleet-wide hits at the "
+                            "edge")
+    serve.add_argument("--cache-max-bytes", dest="cache_max_bytes",
+                       type=int, default=0, metavar="B",
+                       help="LRU budget for the cache dir: after each "
+                            "store, least-recently-hit entries are "
+                            "evicted until total bytes <= B "
+                            "(0 = unbounded, the default)")
     serve.add_argument("--resume", metavar="DIR",
                        help="crash-safe resume: before serving, rebuild "
                             "the engine from the newest valid engine "
@@ -435,6 +458,15 @@ def build_parser() -> argparse.ArgumentParser:
                        type=float, default=10.0, metavar="S",
                        help="minimum seconds between automatic steals "
                             "(thrash guard; default 10)")
+    fleet.add_argument("--cache-dir", dest="fleet_cache_dir",
+                       metavar="DIR",
+                       help="shared solve-cache dir (point it at the "
+                            "same --cache-dir the backends publish "
+                            "into): the router consults it read-only "
+                            "before placement — a fleet-wide full hit "
+                            "is served at the edge without touching any "
+                            "backend, a prefix hit steers placement to "
+                            "a cache-enabled backend")
     fleet.add_argument("--ckpt-root", dest="ckpt_root", metavar="DIR",
                        help="fallback checkpoint root: backend NAME's "
                             "engine manifests under DIR/NAME when its "
@@ -863,6 +895,16 @@ def _serve_report(summary, ok: int, args) -> None:
         master_print(f"semantic scheduling: {summary['steady_exits']} "
                      f"steady exit(s), {summary.get('steps_saved', 0)} "
                      f"step(s) saved")
+    cache = summary.get("cache")
+    if cache:
+        master_print(f"solve cache: {cache['hits_full']} full hit(s), "
+                     f"{cache['hits_prefix']} prefix hit(s), "
+                     f"{cache['misses']} miss(es), "
+                     f"{cache['entries']} entr(ies) / "
+                     f"{cache['bytes'] / 2**20:.2f} MiB on disk, "
+                     f"{cache['evictions']} evicted, "
+                     f"{cache['quarantined']} quarantined "
+                     f"({cache['dir']})")
     cm = summary.get("cost_model") or []
     if cm:
         tops = sorted(cm, key=lambda e: -e["wall_s"])[:3]
@@ -941,6 +983,9 @@ def cmd_serve(args) -> int:
                            numerics_guard=args.numerics_guard,
                            engine_ckpt_interval=args.engine_ckpt_interval,
                            engine_ckpt_dir=args.engine_ckpt_dir,
+                           cache=parse_on_off(args.cache, "--cache"),
+                           cache_dir=args.cache_dir,
+                           cache_max_bytes=args.cache_max_bytes,
                            **({"mem_poll_every": args.mem_poll}
                               if args.mem_poll is not None else {}))
         if args.probe_interval < 0:
@@ -1075,6 +1120,7 @@ def cmd_fleet(args) -> int:
                            steal_threshold_s=args.steal_threshold,
                            steal_cooldown_s=args.steal_cooldown,
                            ckpt_root=args.ckpt_root,
+                           cache_dir=args.fleet_cache_dir,
                            inject=args.inject or "",
                            trace_buffer=trace_cap)
         registry = BackendRegistry(backends,
@@ -1115,6 +1161,10 @@ def cmd_fleet(args) -> int:
                  f"{r['edge_rejected']} rejected at the edge, "
                  f"{r['retries']} batch retries, {len(r['steals'])} "
                  f"steal(s), {r['lost']} backend(s) lost")
+    if snap.get("cache") is not None:
+        master_print(f"fleet: solve cache — {r['cache_edge_hits']} edge "
+                     f"hit(s), {r['cache_prefix_hints']} prefix "
+                     f"placement hint(s)")
     if args.json:
         print(json.dumps({"event": "fleet_summary", **r}, sort_keys=True))
     rt.close()
@@ -1178,15 +1228,16 @@ def cmd_usage(args) -> int:
         print(_json.dumps(payload, sort_keys=True))
         return 0
     hdr = (f"{'tenant':<20} {'class':<12} {'requests':>8} {'lane_s':>10} "
-           f"{'steps':>10} {'saved':>8} {'chunks':>8} {'MiB':>8}")
+           f"{'steps':>10} {'saved':>8} {'cached':>7} {'chunks':>8} "
+           f"{'MiB':>8}")
     print(hdr)
     print("-" * len(hdr))
 
     def row(name, cls, c):
         print(f"{name:<20} {cls:<12} {c['requests']:>8} "
               f"{c['lane_s']:>10.3f} {c['steps']:>10} "
-              f"{c.get('steps_saved', 0):>8} {c['chunks']:>8} "
-              f"{c['bytes_written'] / 2**20:>8.2f}")
+              f"{c.get('steps_saved', 0):>8} {c.get('cached', 0):>7} "
+              f"{c['chunks']:>8} {c['bytes_written'] / 2**20:>8.2f}")
 
     for tenant, t in sorted(payload["tenants"].items()):
         for cls, c in sorted(t["classes"].items()):
@@ -1291,6 +1342,12 @@ def cmd_perfcheck(args) -> int:
              (("resumed_bit_identical", lambda v: v is True),
               ("zero_resteps", lambda v: v is True),
               ("resumed_requests_recovered", lambda v: v is True))),
+            ("serve_cache_lab.json",
+             (("warm_speedup", lambda v: (v or 0) >= 5.0),
+              ("full_hit_bit_identical", lambda v: v is True),
+              ("prefix_delta_exact", lambda v: v is True),
+              ("prefix_bit_identical", lambda v: v is True),
+              ("cache_off_bit_identical", lambda v: v is True))),
             ("fleet_lab.json",
              (("speedup_2_backends", lambda v: (v or 0) >= 1.7),
               ("monotone_at_4", lambda v: v is True),

@@ -114,10 +114,16 @@ def eligible(backends: List, n: Optional[int]) -> List:
 
 
 def choose(policy: str, backends: List, n: Optional[int],
-           rr_index: int) -> Tuple[Optional[object], Dict]:
+           rr_index: int, prefer=None) -> Tuple[Optional[object], Dict]:
     """Pick a backend for one side-``n`` request. Returns
     ``(backend | None, decision)`` where ``decision`` is a small dict
-    for tracing/statusz (scores, who was demoted, why None)."""
+    for tracing/statusz (scores, who was demoted, why None).
+
+    ``prefer`` is an optional set of backend names that should win when
+    any of them is eligible — the solve-cache placement hint (a prefix
+    hit wants the backend that can actually consume the cached
+    frontier). A preference never overrides health/capability: when no
+    preferred backend is eligible the full pool competes as usual."""
     if policy not in POLICIES:
         raise ValueError(f"unknown placement policy {policy!r}; "
                          f"known: {POLICIES}")
@@ -125,9 +131,15 @@ def choose(policy: str, backends: List, n: Optional[int],
     if not cands:
         return None, {"policy": policy, "reason": "no-eligible-backend",
                       "n": n}
+    preferred = False
+    if prefer:
+        narrowed = [b for b in cands if b.name in prefer]
+        if narrowed:
+            cands, preferred = narrowed, True
     if policy == "round-robin":
         b = cands[rr_index % len(cands)]
-        return b, {"policy": policy, "backend": b.name}
+        return b, {"policy": policy, "backend": b.name,
+                   **({"preferred": True} if preferred else {})}
     demoted = [b.name for b in cands if burn_demoted(b.status)]
     pool = [b for b in cands if b.name not in demoted] or cands
     scores = {b.name: predicted_backlog_s(b) for b in pool}
@@ -137,4 +149,5 @@ def choose(policy: str, backends: List, n: Optional[int],
     b = tied[rr_index % len(tied)]
     return b, {"policy": policy, "backend": b.name,
                "backlog_s": {k: round(v, 6) for k, v in scores.items()},
-               "demoted": demoted}
+               "demoted": demoted,
+               **({"preferred": True} if preferred else {})}

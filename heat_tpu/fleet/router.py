@@ -66,6 +66,7 @@ from urllib.parse import parse_qs, urlsplit
 from ..runtime import checkpoint as ckpt_mod
 from ..runtime import debug
 from ..runtime import faults
+from ..runtime import prof as prof_mod
 from ..runtime import trace as trace_mod
 from ..runtime.logging import json_record, master_print
 from ..serve.api import parse_request_obj
@@ -93,6 +94,15 @@ class FleetConfig:
     ckpt_root: Optional[str] = None  # fallback checkpoint root: backend
                                     # K's manifests under <root>/<K> when
                                     # its status payload names no dir
+    cache_dir: Optional[str] = None  # shared solve-cache dir (the same
+                                    # --cache-dir the backends serve
+                                    # from): the router consults it
+                                    # read-only BEFORE placement — a
+                                    # fleet-wide full hit is served at
+                                    # the edge and never touches a
+                                    # backend; a prefix hit prefers
+                                    # cache-enabled backends so the
+                                    # frontier is actually consumed
     inject: str = ""                # fleet fault spec (backend-down /
                                     # backend-slow; runtime/faults.py)
     retry_after_s: float = 1.0
@@ -122,6 +132,16 @@ class Router:
                              f"{placement.POLICIES}")
         self.tracer = trace_mod.Tracer(capacity=self.fcfg.trace_buffer)
         self._plan = faults.plan_for_spec(self.fcfg.inject)
+        # fleet-tier solve cache: READ-ONLY over the shared --cache-dir
+        # the backends publish into (the router never writes entries;
+        # ownership of publish/evict/quarantine stays with the engines)
+        self.solvecache = None
+        self._edge_ledger = prof_mod.UsageLedger()
+        if self.fcfg.cache_dir:
+            from ..serve.solvecache import SolveCache
+
+            self.solvecache = SolveCache(self.fcfg.cache_dir,
+                                         readonly=True)
         self._lock = debug.make_lock("fleet:router")
         # --- under self._lock -------------------------------------------
         self._requests: Dict[str, dict] = {}   # rid -> routing state
@@ -132,6 +152,8 @@ class Router:
         self._rr = 0                           # round-robin tiebreak clock
         self._duplicates = 0
         self._edge_rejected = 0
+        self._cache_edge_hits = 0
+        self._cache_prefix_hints = 0
         self._retries = 0
         self._lost = 0
         self._draining = False
@@ -146,7 +168,8 @@ class Router:
         self._stop = threading.Event()
         debug.instrument_races(
             self, label="Router",
-            exempt=frozenset({"registry", "httpd", "tracer", "fcfg"}))
+            exempt=frozenset({"registry", "httpd", "tracer", "fcfg",
+                              "solvecache", "_edge_ledger"}))
 
     @property
     def address(self) -> str:
@@ -233,7 +256,10 @@ class Router:
             st = {"id": row.id, "line": obj, "n": int(row.cfg.n),
                   "steps": int(row.cfg.ntime), "backend": None,
                   "tried": [], "delivered": False, "rec": None,
-                  "q": client_q, "t0": now, "trace_id": trace_id}
+                  "q": client_q, "t0": now, "trace_id": trace_id,
+                  "cfg": row.cfg, "until": row.until,
+                  "tenant": row.tenant or "default",
+                  "class": row.slo_class or "standard"}
             with self._lock:
                 if row.id in self._requests:
                     self._edge_rejected += 1
@@ -249,13 +275,83 @@ class Router:
                 [r for r in immediate if r["status"] == "rejected"])
         return immediate, states
 
-    def _choose(self, n: Optional[int], exclude: Set[str]):
+    def _choose(self, n: Optional[int], exclude: Set[str], prefer=None):
         backends = [b for b in self.registry.snapshot()
                     if b.name not in exclude]
         with self._lock:
             self._rr += 1
             rr = self._rr
-        return placement.choose(self.fcfg.policy, backends, n, rr)
+        return placement.choose(self.fcfg.policy, backends, n, rr,
+                                prefer=prefer)
+
+    # --- fleet-tier solve cache -------------------------------------------
+    def _cache_backends(self) -> Set[str]:
+        """Backends whose status payload says the solve cache is on —
+        the only ones that can consume a cached frontier."""
+        return {b.name for b in self.registry.snapshot()
+                if (b.status or {}).get("cache") is not None}
+
+    def _consult_cache(self, states: List[dict]) -> List[dict]:
+        """Consult the shared solve cache BEFORE placement. A fleet-wide
+        full hit is served right here at the edge (zero backends
+        touched, billed cached in the router's edge ledger); a prefix
+        hit tags the state so placement prefers a cache-enabled backend
+        (the one holding the snapshot). Returns the states that still
+        need a backend."""
+        if self.solvecache is None:
+            return states
+        remaining = []
+        for st in states:
+            cfg = st.get("cfg")
+            if cfg is None or st.get("until", "steps") != "steps":
+                remaining.append(st)
+                continue
+            try:
+                hit = self.solvecache.lookup(cfg)
+            except OSError:
+                hit = None   # a flaky shared mount must not stop routing
+            if hit is not None and hit["kind"] == "full":
+                if self._serve_edge_hit(st, cfg, hit):
+                    continue
+                remaining.append(st)
+            else:
+                if hit is not None:
+                    with self._lock:
+                        st["prefer_cached"] = True
+                        self._cache_prefix_hints += 1
+                remaining.append(st)
+        return remaining
+
+    def _serve_edge_hit(self, st: dict, cfg, hit: dict) -> bool:
+        """Deliver a fleet-wide full hit at the edge: a synthesized
+        terminal record pointing at the validated cache entry, billed
+        cached (zero lane-seconds/steps) in the router's edge ledger so
+        ``/v1/usage`` reconciles fleet-wide."""
+        rec = {"event": "serve_request", "id": st["id"], "status": "ok",
+               "exit": "cached", "cached": True,
+               "tenant": st["tenant"], "class": st["class"],
+               "n": int(cfg.n), "ndim": int(cfg.ndim),
+               "ntime": int(cfg.ntime), "until": "steps", "error": None,
+               "solve_s": 0.0, "steps_done": int(cfg.ntime),
+               "steps_per_s": None, "path": hit["path"],
+               "placement": "fleet-cache", "trace_id": st["trace_id"],
+               "usage": {"lane_s": 0.0, "steps": 0, "chunks": 0,
+                         "bytes_written": int(hit["nbytes"]),
+                         "steps_saved": int(cfg.ntime), "cached": True}}
+        if not self._deliver(st["id"], rec, backend=None):
+            return False
+        self._edge_ledger.add(st["tenant"], st["class"], "ok",
+                              rec["usage"], placement="fleet-cache")
+        with self._lock:
+            self._cache_edge_hits += 1
+        json_record("fleet_cache_hit", id=st["id"], step=hit["step"],
+                    path=hit["path"])
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "cache-hit", self.tracer.track("fleet router",
+                                               "placement"),
+                cat="fleet", args={"id": st["id"], "step": hit["step"]})
+        return True
 
     def _chaos_forward(self, chosen_name: str) -> None:
         """backend-down@N / backend-slow chaos, one call per forwarded
@@ -280,10 +376,14 @@ class Router:
         terminal rejection record delivered locally."""
         batches: Dict[str, List[dict]] = {}
         addr: Dict[str, str] = {}
+        states = self._consult_cache(states)
         for st in states:
             with self._lock:
                 tried = set(st["tried"])
-            b, decision = self._choose(st["n"], tried)
+                prefer_cached = st.get("prefer_cached", False)
+            b, decision = self._choose(
+                st["n"], tried,
+                prefer=self._cache_backends() if prefer_cached else None)
             if b is None:
                 self._reject_unroutable(st, decision.get("reason",
                                                          "no-backend"))
@@ -776,6 +876,8 @@ class Router:
                       "requests": len(self._requests),
                       "duplicates": self._duplicates,
                       "edge_rejected": self._edge_rejected,
+                      "cache_edge_hits": self._cache_edge_hits,
+                      "cache_prefix_hints": self._cache_prefix_hints,
                       "retries": self._retries,
                       "lost": self._lost,
                       "forwards": self._forwards,
@@ -804,17 +906,24 @@ class Router:
                      or {}).get("generation") or 0),
                 "serve_resumed": (b.status or {}).get("serve_resumed", 0),
                 "queued_now": (b.status or {}).get("queued_now", 0),
+                "cache_enabled": (b.status or {}).get("cache")
+                is not None,
             }
         return {"kind": "heat-tpu-fleet-status",
                 "policy": self.fcfg.policy,
                 "steal_threshold_s": self.fcfg.steal_threshold_s,
                 "uptime_s": round(trace_mod.process_uptime_s(), 3),
+                "cache": (self.solvecache.stats()
+                          if self.solvecache is not None else None),
                 "router": router, "backends": backends}
 
     def fleet_usage(self) -> dict:
         """Fleet-wide ``/v1/usage``: every reachable backend's ledger,
         merged (exact reconciliation — the sums are the per-engine sums)
-        plus the raw per-backend payloads."""
+        plus the raw per-backend payloads. Edge-served cache hits never
+        touched a backend, so their ledger rides along as the pseudo-
+        backend ``_edge`` — fleet totals still equal the sum of the
+        parts."""
         per_backend = {}
         for b in self.registry.snapshot():
             if b.lost or b.fault_down:
@@ -825,6 +934,9 @@ class Router:
                     per_backend[b.name] = json.loads(data)
             except (OSError, ValueError, http.client.HTTPException):
                 continue
+        edge = self._edge_ledger.snapshot()
+        if edge["totals"]["requests"]:
+            per_backend["_edge"] = edge
         return merge_usage(per_backend)
 
 
@@ -835,7 +947,7 @@ def merge_usage(per_backend: Dict[str, dict]) -> dict:
     is auditable — fleet totals equal the sum of per-engine ledgers by
     construction."""
     fields = ("lane_s", "steps", "chunks", "bytes_written",
-              "steps_saved", "requests")
+              "steps_saved", "cached", "requests")
     tenants: Dict[str, dict] = {}
     totals = {f: 0 for f in fields}
     for payload in per_backend.values():
@@ -933,6 +1045,21 @@ def render_fleet_metrics(router: Router) -> str:
            "Request lines rejected at the router edge (parse/validate/"
            "duplicate) without ever reaching a backend.",
            [([], s["router"]["edge_rejected"])])
+    metric("heat_tpu_fleet_cache_edge_hits_total", "counter",
+           "Requests served entirely at the edge from the shared solve "
+           "cache (zero backends touched).",
+           [([], s["router"]["cache_edge_hits"])])
+    metric("heat_tpu_fleet_cache_prefix_hints_total", "counter",
+           "Placements steered toward a cache-enabled backend by a "
+           "prefix hit in the shared solve cache.",
+           [([], s["router"]["cache_prefix_hints"])])
+    cache = s.get("cache") or {}
+    metric("heat_tpu_fleet_cache_entries", "gauge",
+           "Entries in the shared solve-cache dir as the router sees "
+           "it (read-only).", [([], cache.get("entries", 0))])
+    metric("heat_tpu_fleet_cache_bytes", "gauge",
+           "Bytes the shared solve-cache dir holds as the router sees "
+           "it.", [([], cache.get("bytes", 0))])
     metric("heat_tpu_fleet_flightrec_dumps_total", "counter",
            "Fleet-timeline flight dumps written on backend loss.",
            [([], router.tracer.dumps)])
@@ -956,6 +1083,17 @@ def render_fleet_statusz(router: Router) -> str:
         f"pending, {r['edge_rejected']} rejected at the edge, "
         f"{r['retries']} batch retr{'y' if r['retries'] == 1 else 'ies'}, "
         f"{r['duplicates']} duplicate record(s) dropped")
+    cache = s.get("cache")
+    if cache is None:
+        lines.append("solve cache: not shared with this router "
+                     "(--cache-dir unset)")
+    else:
+        lines.append(
+            f"solve cache (read-only over {cache['dir']}): "
+            f"{r['cache_edge_hits']} edge hit(s), "
+            f"{r['cache_prefix_hints']} prefix placement hint(s), "
+            f"{cache['entries']} entr(ies) / "
+            f"{cache['bytes'] / 2**20:.2f} MiB on disk")
     lines.append(f"backends ({len(s['backends'])}; "
                  f"{r['lost']} lost so far):")
     for name, b in sorted(s["backends"].items()):

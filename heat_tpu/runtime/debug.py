@@ -62,9 +62,12 @@ from typing import List, Optional
 # the router in front of many gateways (heat_tpu/fleet): outermost in
 # every request path, so it ranks below gateway — router threads may
 # call into a (same-process, in tests) gateway/engine surface while
-# holding a fleet lock, never the reverse.
+# holding a fleet lock, never the reverse. "cache" (the solve cache,
+# serve/solvecache.py) sits between writer and observatory: the writer
+# thread publishes entries on its result path, and a cache consult may
+# feed observatory counters — never the reverse.
 LOCK_RANKS = {"fleet": -10, "gateway": 0, "engine": 10, "writer": 20,
-              "observatory": 30}
+              "cache": 25, "observatory": 30}
 
 
 class LockOrderError(RuntimeError):

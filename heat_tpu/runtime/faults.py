@@ -49,6 +49,21 @@ serve/scheduler.py — ignored by the solo drive loop):
                                the first one). The resume loader must
                                quarantine it and fall back one
                                generation loudly.
+Solve-cache kinds (the serving engine's content-addressed result cache,
+serve/solvecache.py — ignored everywhere a cache is off):
+
+- ``cache-corrupt[@N]``      — xor-scribble 64 bytes at the midpoint of
+                               the consulted cache entry's npz on the
+                               Nth cache consult (no ``@N`` = the
+                               first). The consult's sha256 check must
+                               quarantine it to ``*.corrupt`` and fall
+                               back to recompute — never serve it.
+- ``cache-stale``            — rewrite the consulted entry's sidecar
+                               fingerprint to a different physics hash
+                               (a mis-filed / stale entry analog). The
+                               consult's fingerprint check must
+                               quarantine and recompute (fire-once).
+
 Fleet-scoped kinds (the router's chaos drills, heat_tpu/fleet/router.py
 — ignored by the solo drive loop and the serving engine):
 
@@ -118,7 +133,7 @@ CRASH_RC = 43
 _KINDS = ("crash", "nan", "ckpt-corrupt", "ckpt-truncate",
           "sink-error", "sink-slow", "lane-nan", "fetch-hang", "perturb",
           "engine-kill", "ckpt-manifest-corrupt",
-          "backend-down", "backend-slow")
+          "backend-down", "backend-slow", "cache-corrupt", "cache-stale")
 
 
 @dataclasses.dataclass
@@ -355,6 +370,46 @@ class FaultPlan:
                 path.write_bytes(data[:len(data) // 2])
                 master_print(f"fault: truncated checkpoint {path.name} "
                              f"(spec {self.spec!r})")
+
+    def damage_cache(self, cache_dir, fingerprint: str,
+                     consult: int) -> None:
+        """Called at the top of every solve-cache consult
+        (serve/solvecache.py) with the consult counter: cache-corrupt
+        xor-scribbles the consulted fingerprint's npz entry (sha256
+        mismatch — bitrot analog), cache-stale rewrites its sidecar
+        fingerprint (a mis-filed entry analog). Both fire-once; the
+        consult's validation must quarantine the damage, never serve
+        it."""
+        d = Path(cache_dir)
+        for f in self._live("cache-corrupt"):
+            if f.fired or consult < (f.step or 1):
+                continue
+            for p in sorted(d.glob(f"{fingerprint}-*.npz")):
+                f.fired = True
+                data = bytearray(p.read_bytes())
+                mid = len(data) // 2
+                for i in range(mid, min(mid + 64, len(data))):
+                    data[i] ^= 0xFF
+                p.write_bytes(bytes(data))
+                master_print(f"fault: corrupted cache entry {p.name} "
+                             f"(spec {self.spec!r})")
+                break
+        for f in self._live("cache-stale"):
+            if f.fired or consult < (f.step or 1):
+                continue
+            for p in sorted(d.glob(f"{fingerprint}-*.json")):
+                f.fired = True
+                try:
+                    import json as _json
+
+                    meta = _json.loads(p.read_text())
+                except ValueError:
+                    meta = {}
+                meta["fingerprint"] = "0" * 16
+                p.write_text(_json.dumps(meta, sort_keys=True) + "\n")
+                master_print(f"fault: staled cache sidecar {p.name} "
+                             f"(spec {self.spec!r})")
+                break
 
     def damage_manifest(self, path: Path, generation: int) -> None:
         """Called after an engine-state manifest is published

@@ -419,19 +419,24 @@ class MemWatermark:
 # "steps" bills the steps a request ACTUALLY ran (below ntime for an
 # until=steady early exit); "steps_saved" credits the steps a steady
 # exit did not run — saved device time billed as saved (ISSUE 16).
-USAGE_FIELDS = ("lane_s", "steps", "chunks", "bytes_written", "steps_saved")
+# "cached" marks a solve-cache full hit (ISSUE 19): billed zero
+# lane_s/steps, hit counted — on records it is a bool, in ledger cells
+# it sums to the cell's hit count.
+USAGE_FIELDS = ("lane_s", "steps", "chunks", "bytes_written",
+                "steps_saved", "cached")
 
 
 def empty_usage() -> dict:
     """The usage stamp every terminal record carries (schema-stable:
     rejected requests carry zeros, not a missing key)."""
     return {"lane_s": 0.0, "steps": 0, "chunks": 0, "bytes_written": 0,
-            "steps_saved": 0}
+            "steps_saved": 0, "cached": False}
 
 
 class _LedgerCell:
     __slots__ = ("lane_s", "steps", "chunks", "bytes_written",
-                 "steps_saved", "requests", "by_status", "by_placement")
+                 "steps_saved", "cached", "requests", "by_status",
+                 "by_placement")
 
     def __init__(self):
         self.lane_s = 0.0
@@ -439,6 +444,7 @@ class _LedgerCell:
         self.chunks = 0
         self.bytes_written = 0
         self.steps_saved = 0
+        self.cached = 0
         self.requests = 0
         self.by_status: collections.Counter = collections.Counter()
         # placement dimension (ISSUE 10): how many of this cell's
@@ -451,7 +457,7 @@ class _LedgerCell:
     def asdict(self) -> dict:
         return {"lane_s": round(self.lane_s, 6), "steps": self.steps,
                 "chunks": self.chunks, "bytes_written": self.bytes_written,
-                "steps_saved": self.steps_saved,
+                "steps_saved": self.steps_saved, "cached": self.cached,
                 "requests": self.requests, "by_status": dict(self.by_status),
                 "by_placement": dict(self.by_placement)}
 
@@ -477,6 +483,7 @@ class UsageLedger:
             cell.chunks += int(usage.get("chunks") or 0)
             cell.bytes_written += int(usage.get("bytes_written") or 0)
             cell.steps_saved += int(usage.get("steps_saved") or 0)
+            cell.cached += int(bool(usage.get("cached")))
             cell.requests += 1
             cell.by_status[status] += 1
             cell.by_placement[placement or "none"] += 1
@@ -493,7 +500,7 @@ class UsageLedger:
             tdict = tenants.setdefault(
                 tenant, {"classes": {}, "lane_s": 0.0, "steps": 0,
                          "chunks": 0, "bytes_written": 0, "steps_saved": 0,
-                         "requests": 0})
+                         "cached": 0, "requests": 0})
             tdict["classes"][cls] = d
             for f in (*USAGE_FIELDS, "requests"):
                 tdict[f] = (round(tdict[f] + d[f], 6)
@@ -503,6 +510,7 @@ class UsageLedger:
             totals.chunks += d["chunks"]
             totals.bytes_written += d["bytes_written"]
             totals.steps_saved += d["steps_saved"]
+            totals.cached += d["cached"]
             totals.requests += d["requests"]
             totals.by_status.update(d["by_status"])
             totals.by_placement.update(d.get("by_placement") or {})

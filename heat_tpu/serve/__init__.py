@@ -36,6 +36,7 @@ from .engine import (BucketKey, LaneEngine, lane_buffer,  # noqa: F401
 from .resume import resume_engine  # noqa: F401
 from .scheduler import (TERMINAL_STATUSES, Engine,  # noqa: F401
                         Request, ServeConfig)
+from .solvecache import SolveCache  # noqa: F401
 
 
 def __getattr__(name):

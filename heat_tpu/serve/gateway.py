@@ -337,6 +337,29 @@ def render_metrics(engine: Engine) -> str:
            "Structured slo_alert records emitted, per class.",
            [([("class", cls)], b["alerts"])
             for cls, b in sorted(burn.items())] or [([], 0)])
+    cache = s.get("cache") or {}
+    metric("heat_tpu_cache_hits_total", "counter",
+           "Solve-cache hits by kind: 'full' short-circuits admission "
+           "(served byte-identically from disk, no lane), 'prefix' "
+           "seeds a lane from a cached frontier and steps the delta.",
+           [([("kind", "full")], cache.get("hits_full", 0)),
+            ([("kind", "prefix")], cache.get("hits_prefix", 0))])
+    metric("heat_tpu_cache_misses_total", "counter",
+           "Solve-cache consults that found no usable entry.",
+           [([], cache.get("misses", 0))])
+    metric("heat_tpu_cache_evictions_total", "counter",
+           "Entries LRU-evicted to honor --cache-max-bytes.",
+           [([], cache.get("evictions", 0))])
+    metric("heat_tpu_cache_quarantined_total", "counter",
+           "Entries that failed validation on consult and were renamed "
+           "to *.corrupt (cache_quarantined records carry the reason).",
+           [([], cache.get("quarantined", 0))])
+    metric("heat_tpu_cache_entries", "gauge",
+           "Published cache entries on disk right now.",
+           [([], cache.get("entries", 0))])
+    metric("heat_tpu_cache_bytes", "gauge",
+           "Bytes the cache directory holds right now.",
+           [([], cache.get("bytes", 0))])
     usage = engine.prof.ledger.snapshot()
     for name, field, help_text in (
             ("heat_tpu_usage_lane_seconds_total", "lane_s",
@@ -349,8 +372,12 @@ def render_metrics(engine: Engine) -> str:
             ("heat_tpu_usage_bytes_written_total", "bytes_written",
              "Result bytes produced, per tenant and class."),
             ("heat_tpu_usage_steps_saved_total", "steps_saved",
-             "Steps not run thanks to until=steady early exits, per "
-             "tenant and class (saved device time billed as saved)."),
+             "Steps not run thanks to until=steady early exits and "
+             "solve-cache hits, per tenant and class (saved device time "
+             "billed as saved)."),
+            ("heat_tpu_usage_cached_total", "cached",
+             "Requests served entirely from the solve cache (zero "
+             "lane-seconds/steps billed), per tenant and class."),
             ("heat_tpu_usage_requests_total", "requests",
              "Terminal requests accounted, per tenant and class.")):
         metric(name, "counter", help_text,
@@ -434,6 +461,7 @@ def status_payload(engine: Engine) -> dict:
         "engine_ckpt": {"generation": s.get("engine_ckpt_generation", 0),
                         "interval": s.get("engine_ckpt_interval", 0),
                         "dir": engine.engine_ckpt_dir()},
+        "cache": s.get("cache"),
         "serve_resumed": s.get("serve_resumed", 0),
         "probe": pr,
         "flightrec_dumps": engine.tracer.dumps,
@@ -482,6 +510,19 @@ def render_statusz(engine: Engine) -> str:
         f", last published generation {s.get('engine_ckpt_generation', 0)}, "
         f"{s.get('serve_resumed', 0)} request(s) re-admitted from a "
         f"checkpoint this incarnation")
+    cache = s.get("cache")
+    if cache is None:
+        lines.append("solve cache: OFF (--cache off)")
+    else:
+        lines.append(
+            f"solve cache: {cache['hits_full']} full / "
+            f"{cache['hits_prefix']} prefix hit(s), "
+            f"{cache['misses']} miss(es) of {cache['consults']} "
+            f"consult(s), {cache['entries']} entr(ies) / "
+            f"{cache['bytes'] / 2**20:.2f} MiB on disk "
+            f"(budget {cache['max_bytes'] or 'unbounded'}, "
+            f"{cache['evictions']} evicted, "
+            f"{cache['quarantined']} quarantined) — {cache['dir']}")
     if s.get("numerics"):
         lines.append(
             f"numerics: guard {s.get('numerics_guard', 'warn')}, "
@@ -560,7 +601,7 @@ def render_statusz(engine: Engine) -> str:
     lines.append(
         f"usage ledger: {tot['requests']} request(s), "
         f"{tot['lane_s']:.3f} lane-s, {tot['steps']} steps, "
-        f"{tot['chunks']} chunk-slots, "
+        f"{tot.get('cached', 0)} cached, {tot['chunks']} chunk-slots, "
         f"{tot['bytes_written'] / 2**20:.2f} MiB written "
         f"(full detail: GET /v1/usage or heat-tpu usage URL)")
     top = sorted(usage["tenants"].items(),
@@ -568,7 +609,8 @@ def render_statusz(engine: Engine) -> str:
     for tenant, t in top:
         lines.append(
             f"  {tenant}: {t['lane_s']:.3f} lane-s, {t['steps']} steps "
-            f"({t.get('steps_saved', 0)} saved), "
+            f"({t.get('steps_saved', 0)} saved, "
+            f"{t.get('cached', 0)} cached), "
             f"{t['requests']} request(s), "
             f"{t['bytes_written'] / 2**20:.2f} MiB")
     if engine.tracer.dumps:

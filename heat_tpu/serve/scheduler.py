@@ -131,6 +131,7 @@ from ..runtime import prof as prof_mod
 from ..runtime import trace as trace_mod
 from ..runtime.logging import json_record, master_print
 from . import policy as policy_mod
+from . import solvecache as solvecache_mod
 from .engine import (BucketKey, LaneEngine, MegaLaneEngine, lane_tier,
                      resolve_lane_kernel, unpack_boundary, wall_clock)
 from .engine import fetch_boundary as engine_fetch_boundary
@@ -300,6 +301,24 @@ class ServeConfig:
     engine_ckpt_dir: Optional[str] = None  # manifest + lane-field
                               # directory; None = <out_dir>/engine-ckpt,
                               # or ./engine-ckpt with no out_dir
+    cache: bool = False       # two-level solve cache (ISSUE 19): consult
+                              # the content-addressed result store at
+                              # submit — a full hit replays the stored
+                              # npz byte-identically without occupying a
+                              # lane (billed usage.cached, zero
+                              # lane_s/steps); a prefix hit seeds the
+                              # lane from the deepest shallower entry
+                              # and steps only the delta — and publish
+                              # every ok result + chunk-boundary lane
+                              # snapshot back into it. Off (default) is
+                              # bit-identical to pre-cache behavior:
+                              # no directory is ever touched
+    cache_dir: Optional[str] = None  # entry directory (shared across a
+                              # fleet on shared storage); None =
+                              # <out_dir>/solve-cache, or ./solve-cache
+                              # with no out_dir
+    cache_max_bytes: int = 0  # LRU-evict oldest entries once total
+                              # entry bytes exceed this (0 = unbounded)
 
     def __post_init__(self):
         if self.lanes < 1:
@@ -373,6 +392,9 @@ class ServeConfig:
         if self.engine_ckpt_interval < 0:
             raise ValueError(f"engine_ckpt_interval must be >= 0 (0 = "
                              f"off), got {self.engine_ckpt_interval}")
+        if self.cache_max_bytes < 0:
+            raise ValueError(f"cache_max_bytes must be >= 0 (0 = "
+                             f"unbounded), got {self.cache_max_bytes}")
         if self.inject:
             # fail at construction, not at a boundary mid-drain (same
             # parse-time contract as HeatConfig.inject)
@@ -837,8 +859,12 @@ class _GroupRunner:
                     if steps_done < req.cfg.ntime:
                         exit_mode = "steady"
                         outer.steady_exits += 1
-                        outer.steps_saved_total += (req.cfg.ntime
-                                                    - steps_done)
+                        # steps_saved_total is also bumped by the
+                        # client-thread cache consult (_cache_replay) —
+                        # cross-thread now, so every write takes the lock
+                        with outer._lock:
+                            outer.steps_saved_total += (req.cfg.ntime
+                                                        - steps_done)
                         if self.tracer.enabled:
                             self.tracer.instant(
                                 "steady-exit", self.lane_tracks[lane],
@@ -1612,7 +1638,8 @@ class MegaLaneRunner:
             if steps_done < req.cfg.ntime:
                 exit_mode = "steady"
                 outer.steady_exits += 1
-                outer.steps_saved_total += req.cfg.ntime - steps_done
+                with outer._lock:   # cross-thread with _cache_replay
+                    outer.steps_saved_total += req.cfg.ntime - steps_done
                 if self.tracer.enabled:
                     self.tracer.instant(
                         "steady-exit", self.lane_tracks[0],
@@ -1879,6 +1906,22 @@ class Engine:
         # engine-scoped fault plan (scfg.inject / HEAT_TPU_FAULTS); None on
         # every normal run — the hot loop then does no fault work at all
         self._plan = faults.plan_for(scfg)
+        # two-level solve cache (ISSUE 19): consulted at submit (the one
+        # admission door), fed by the writer thread's result publishes
+        # and by chunk-boundary engine-checkpoint snapshots. None when
+        # --cache off — every call site skips on one is-not-None test,
+        # so the cache-off engine is behavior-identical to pre-cache
+        # builds (regression-locked).
+        self.solvecache = None
+        if scfg.cache:
+            from pathlib import Path as _Path
+
+            cache_dir = scfg.cache_dir or (
+                str(_Path(scfg.out_dir) / "solve-cache") if scfg.out_dir
+                else "solve-cache")
+            self.solvecache = solvecache_mod.SolveCache(
+                cache_dir, max_bytes=scfg.cache_max_bytes,
+                plan=self._plan)
         self._has_lane_faults = False  # flips on when a poisoned request
                                        # is admitted (gates _maybe_poison)
         self._fetch_seq = 0            # boundary-fetch counter (fetch-hang
@@ -1893,7 +1936,8 @@ class Engine:
         debug_mod.instrument_races(
             self, label="Engine",
             exempt=frozenset({"_mega_lanes_resolved", "tracer", "prof",
-                              "numerics", "scfg", "prober"}))
+                              "numerics", "scfg", "prober",
+                              "solvecache"}))
 
     # --- mega-lane placement (ISSUE 10) -----------------------------------
     @property
@@ -2047,7 +2091,7 @@ class Engine:
                    "deadline_ms": deadline_ms, "trace_id": trace_id,
                    "until": until, "steps_done": None, "exit": None,
                    "predicted_steps": predicted, "predicted_wall_s": None,
-                   "resumed": _restore is not None,
+                   "resumed": _restore is not None, "cached": False,
                    "_submit_t": wall_clock()}
             if _restore is not None:
                 # usage partials from the checkpointed incarnation: the
@@ -2086,6 +2130,22 @@ class Engine:
             key = BucketKey(ndim=cfg.ndim, n=b, dtype=cfg.dtype, bc=cfg.bc)
         if predicted is not None and self.prof.enabled:
             rec["predicted_wall_s"] = self._forecast_wall(cfg, b, predicted)
+        # solve cache consult (ISSUE 19) — at the admission door, after
+        # every rejection gate, before a lane or queue slot is taken.
+        # Only fixed-step requests CONSUME the cache (an until=steady
+        # request's exit step is not knowable from the key — it only
+        # populates, under its actual frontier); keys are physics-only,
+        # so tenant/class/deadline/id never split entries. Checkpoint
+        # re-admissions (_restore) already carry their own field.
+        prefix_restore = None
+        if (self.solvecache is not None and _restore is None
+                and until == "steps"):
+            hit = self.solvecache.lookup(cfg)
+            if hit is not None and hit["kind"] == "full":
+                if self._cache_replay(rec, cfg, b, placement, hit):
+                    return rid
+            elif hit is not None:
+                prefix_restore = self._cache_prefix(rec, cfg, hit)
         with self._cond:
             queued = (sum(len(q) for q in self._queues.values())
                       + (len(self._mega_queue) if self._mega_queue else 0))
@@ -2124,7 +2184,7 @@ class Engine:
                     tenant=tenant, slo_class=slo_class, seq=seq,
                     trace_id=trace_id, until=until, tol=tol,
                     predicted_steps=predicted,
-                    restore=(_restore if _restore else None))
+                    restore=(_restore if _restore else prefix_restore))
                 q.push(req)
                 if self.tracer.enabled:
                     policy_mod.note_enqueue(self.tracer, self.scfg.policy,
@@ -2135,6 +2195,93 @@ class Engine:
         if shed_reason is not None:
             self._reject(rec, shed_reason)
         return rid
+
+    def _cache_replay(self, rec: dict, cfg: HeatConfig,
+                      bucket: Optional[int], placement: str,
+                      hit: dict) -> bool:
+        """Full cache hit at the admission door: replay the stored npz
+        through the normal record/listener path without ever occupying
+        a lane — zero chunk programs dispatch, and an out-dir publish is
+        a byte copy of the cached artifact (byte-identical to the
+        cold-miss npz by construction). Billed as cached: zero
+        lane_s/steps, the whole ``ntime`` credited as steps_saved, so
+        the hit reconciles across records/ledger//v1/usage like every
+        other terminal stamp. Returns False when the entry vanished
+        mid-replay (eviction race) — the caller proceeds as a miss."""
+        scfg = self.scfg
+        path: Optional[str] = None
+        T = None
+        try:
+            nbytes = int(hit["nbytes"])
+            if scfg.out_dir:
+                p = self.solvecache.replay(hit["path"], scfg.out_dir,
+                                           rec["id"])
+                path = str(p)
+                nbytes = p.stat().st_size
+            if scfg.keep_fields or not scfg.out_dir:
+                T, _ = solvecache_mod.SolveCache.load(hit["path"])
+        except Exception as e:  # noqa: BLE001 — entry evicted under us
+            master_print(f"solve cache: replay of {hit['path']} failed "
+                         f"({type(e).__name__}: {e}) — recomputing")
+            return False
+        now = wall_clock()
+        with self._lock:
+            rec["bucket"] = bucket
+            rec["placement"] = placement
+            rec["status"] = "ok"
+            rec["cached"] = True
+            rec["exit"] = "cached"
+            rec["queue_wait_s"] = round(now - rec["_submit_t"], 6)
+            rec["solve_s"] = 0.0
+            rec["steps_per_s"] = None
+            rec["steps_done"] = int(cfg.ntime)
+            if path is not None:
+                rec["path"] = path
+            if T is not None:
+                rec["T"] = T
+            rec["usage"] = {"lane_s": 0.0, "steps": 0, "chunks": 0,
+                            "bytes_written": int(nbytes),
+                            "steps_saved": int(cfg.ntime),
+                            "cached": True}
+            self.steps_saved_total += int(cfg.ntime)
+        if self.tracer.enabled:
+            self.tracer.instant("cache-hit", self.tracer.thread_track(),
+                                trace_id=rec["trace_id"],
+                                args={"id": rec["id"],
+                                      "step": int(hit["step"])})
+        self._emit(rec)
+        return True
+
+    def _cache_prefix(self, rec: dict, cfg: HeatConfig,
+                      hit: dict) -> Optional[dict]:
+        """Prefix hit: seed the admitting lane fill from the cached
+        field at ``hit['step']`` so the engine steps only the delta.
+        The returned payload is the engine-checkpoint resume shape the
+        lane fills already consume (``_fill``/mega ``_fill``);
+        ``_cache_prefix_steps`` on the record makes the terminal stamp
+        bill only the stepped delta, crediting the prefix as
+        steps_saved. Returns None when the entry vanished under us —
+        the request just runs from the IC."""
+        try:
+            T, step = solvecache_mod.SolveCache.load(hit["path"])
+        except Exception as e:  # noqa: BLE001 — entry evicted under us
+            master_print(f"solve cache: prefix read of {hit['path']} "
+                         f"failed ({type(e).__name__}: {e}) — "
+                         f"recomputing from the IC")
+            return None
+        remaining = int(cfg.ntime) - int(step)
+        if remaining <= 0:
+            return None
+        with self._lock:
+            rec["_cache_prefix_steps"] = int(step)
+        if self.tracer.enabled:
+            self.tracer.instant("cache-prefix",
+                                self.tracer.thread_track(),
+                                trace_id=rec["trace_id"],
+                                args={"id": rec["id"],
+                                      "step": int(step),
+                                      "delta": remaining})
+        return {"T": T, "remaining": remaining, "chunks": 0}
 
     def _forecast_wall(self, cfg: HeatConfig, b: Optional[int],
                        steps: int) -> Optional[float]:
@@ -2276,9 +2423,14 @@ class Engine:
             rec["status"] = status
             rec["error"] = reason
             rec["steps_done"] = int(steps_done)
+            # a cache-prefix admission never ran its prefix steps: bill
+            # only the stepped delta, credit the prefix as saved
+            prefix = int(rec.pop("_cache_prefix_steps", 0) or 0)
             rec["usage"] = {"lane_s": rec["solve_s"] or 0.0,
-                            "steps": int(steps_done), "chunks": int(chunks),
-                            "bytes_written": 0, "steps_saved": 0}
+                            "steps": max(0, int(steps_done) - prefix),
+                            "chunks": int(chunks),
+                            "bytes_written": 0, "steps_saved": prefix,
+                            "cached": False}
         if self.numerics is not None:
             self.numerics.forget(req.id)   # terminal: drop detector state
         self._emit(rec)
@@ -2633,13 +2785,26 @@ class Engine:
                     "seq": req.seq,
                     "numerics": numerics}
 
-        def _field_job(rid: str, fp: str, remaining: int, get_field):
+        def _field_job(rid: str, fp: str, remaining: int, get_field,
+                       cfg: Optional[HeatConfig] = None):
             def job():
                 try:
-                    ckpt_mod.save_engine_field(d, gen, rid, get_field(),
-                                               fp, remaining)
+                    T = get_field()
+                    ckpt_mod.save_engine_field(d, gen, rid, T, fp,
+                                               remaining)
                 except BaseException as e:  # noqa: BLE001 — abort the gen
                     failed.append(f"{rid}: {type(e).__name__}: {e}")
+                    return
+                # chunk-boundary snapshots double as the solve cache's
+                # prefix store (ISSUE 19): a later identical-physics
+                # request seeds a lane from this cut and steps only the
+                # delta. Best effort — put() swallows its own failures.
+                if (self.solvecache is not None and cfg is not None
+                        and remaining > 0):
+                    step = int(cfg.ntime) - int(remaining)
+                    if step > 0:
+                        self.solvecache.put(cfg, step, T=T,
+                                            kind="snapshot")
             job._trace = (f"engine-ckpt field {rid}", None)
             return job
 
@@ -2666,7 +2831,8 @@ class Engine:
                                  eng.extract(s, n))
                 inflight_entries.append(e)
                 field_jobs.append(_field_job(req.id, e["fingerprint"],
-                                             remaining, get_field))
+                                             remaining, get_field,
+                                             cfg=req.cfg))
         queued_entries: List[dict] = []
         with self._lock:
             queues = list(self._queues.values())
@@ -2686,7 +2852,7 @@ class Engine:
                 inflight_entries.append(e)
                 field_jobs.append(_field_job(
                     req.id, e["fingerprint"], int(rst["remaining"]),
-                    lambda rst=rst: rst["T"]))
+                    lambda rst=rst: rst["T"], cfg=req.cfg))
             else:
                 e = _entry(req, req.cfg.ntime, 0, 0.0, None)
                 e.pop("numerics")
@@ -3054,8 +3220,14 @@ class Engine:
             # too — fold the checkpointed partial in; steps_done already
             # spans both incarnations (ntime - final remaining)
             lane_s = (now - start) + rec.pop("_resumed_lane_s", 0.0)
+            # a cache-prefix admission (ISSUE 19) seeded the lane at
+            # _cache_prefix_steps: the lane only STEPPED the delta —
+            # bill that, credit the prefix as steps_saved (riding the
+            # same accounting as steady early exits)
+            prefix = int(rec.pop("_cache_prefix_steps", 0) or 0)
+            stepped = max(0, steps - prefix)
             rec["solve_s"] = round(lane_s, 6)
-            rec["steps_per_s"] = (round(steps / lane_s, 3)
+            rec["steps_per_s"] = (round(stepped / lane_s, 3)
                                   if lane_s > 0 else None)
             rec["steps_done"] = steps
             rec["exit"] = exit_mode
@@ -3063,11 +3235,15 @@ class Engine:
             # consumed — bytes_written is finalized by the writer thread
             # once the publish lands, before the record is emitted.
             # Semantic scheduling bills ACTUAL steps; the steps a steady
-            # exit did not run are credited as steps_saved.
+            # exit did not run (or a cache prefix made unnecessary) are
+            # credited as steps_saved.
             rec["usage"] = {"lane_s": rec["solve_s"],
-                            "steps": steps,
+                            "steps": stepped,
                             "chunks": int(chunks), "bytes_written": 0,
-                            "steps_saved": int(req.cfg.ntime) - steps}
+                            "steps_saved": int(req.cfg.ntime) - stepped,
+                            "cached": False}
+            if prefix:
+                self.steps_saved_total += prefix
         if self.numerics is not None:
             self.numerics.forget(req.id)   # terminal: drop detector state
         return rec
@@ -3115,6 +3291,18 @@ class Engine:
                         rec["path"] = path
                     rec["status"] = "ok"
                     rec["usage"]["bytes_written"] = int(nbytes)
+                # solve-cache population (ISSUE 19), on the writer
+                # thread after the publish landed: a byte copy of the
+                # published artifact (or the identical serialization
+                # when nothing hit disk), keyed under the ACTUAL step
+                # count — a steady early exit caches under its exit
+                # frontier so later fixed-step requests can prefix-hit
+                # it. Best effort: put() swallows its own failures.
+                if self.solvecache is not None:
+                    self.solvecache.put(
+                        cfg, int(cfg.ntime if steps_done is None
+                                 else steps_done),
+                        T=T, src_path=path, kind="result")
             except BaseException as e:  # noqa: BLE001 — per-request record
                 if async_io.is_transient(e) and attempts["n"] <= writer.retries:
                     raise
@@ -3202,6 +3390,8 @@ class Engine:
                 "steady_exits": self.steady_exits,
                 "steps_saved": self.steps_saved_total,
                 "serve_resumed": self.serve_resumed_total,
+                "cache": (self.solvecache.stats()
+                          if self.solvecache is not None else None),
                 "engine_ckpt_interval": self.scfg.engine_ckpt_interval,
                 "engine_ckpt_generation": self._engine_ckpt_gen,
                 "shed": self.shed,

@@ -259,3 +259,68 @@ def test_serve_chaos_under_racecheck_zero_findings(tmp_cwd, capsys,
     stats = debug.race_stats()
     assert stats["findings"] == [], stats["findings"]
     assert stats["instrumented"] >= 2
+
+
+def test_serve_cache_chaos_corrupt_and_stale_quarantine_recompute(tmp_cwd,
+                                                                  capsys):
+    """Solve-cache fault domains e2e (ISSUE 19): a warm cache whose
+    consulted entry is bit-rotted (``cache-corrupt``) or mis-filed
+    (``cache-stale``) must never be served — validation quarantines the
+    damage to ``*.corrupt`` with a structured ``cache_quarantined``
+    record, the request recomputes bit-identical to the clean run, and
+    the healthy rerun afterwards full-hits the republished entry."""
+    import json
+
+    from heat_tpu.runtime import faults
+
+    reqs = tmp_cwd / "reqs.jsonl"
+    lines = [{"id": f"r{i}", "n": 16, "ntime": 40, "dtype": "float64"}
+             for i in range(3)]
+    reqs.write_text("".join(json.dumps(d) + "\n" for d in lines))
+    base = ["serve", "--requests", "reqs.jsonl", "--buckets", "16",
+            "--chunk", "8", "--lanes", "2", "--cache", "on",
+            "--cache-dir", "cache"]
+
+    def records(out):
+        return {r["id"]: r for r in
+                (json.loads(l) for l in out.splitlines()
+                 if l.startswith("{") and '"serve_request"' in l)}
+
+    # cold run populates (all consults precede the writeback), warm run
+    # full-hits every request from the published entry
+    faults.reset()
+    assert main([*base, "--out-dir", "cold"]) == 0
+    capsys.readouterr()
+    faults.reset()
+    assert main([*base, "--out-dir", "warm"]) == 0
+    warm = records(capsys.readouterr().out)
+    assert all(r["status"] == "ok" for r in warm.values())
+    assert all(r["cached"] for r in warm.values())
+
+    for spec, reason_frag in (("cache-corrupt", "hash mismatch"),
+                              ("cache-stale", "stale")):
+        for f in tmp_cwd.glob("cache/*.corrupt"):
+            f.unlink()
+        # the damaged consult must recompute, not serve the bad entry
+        faults.reset()
+        out_dir = f"chaos-{spec}"
+        assert main([*base, "--out-dir", out_dir,
+                     "--inject", spec]) == 0
+        out = capsys.readouterr().out
+        chaos = records(out)
+        assert all(r["status"] == "ok" for r in chaos.values())
+        quarantines = [json.loads(l) for l in out.splitlines()
+                       if l.startswith("{")
+                       and '"cache_quarantined"' in l]
+        assert len(quarantines) == 1, out
+        assert reason_frag in quarantines[0]["reason"]
+        assert len(list(tmp_cwd.glob("cache/*.corrupt"))) == 2
+        for rid in chaos:
+            with np.load(tmp_cwd / out_dir / f"{rid}.npz") as zc, \
+                    np.load(tmp_cwd / "warm" / f"{rid}.npz") as zw:
+                np.testing.assert_array_equal(zc["T"], zw["T"])
+        # the recompute republished the entry: a healthy rerun full-hits
+        faults.reset()
+        assert main([*base, "--out-dir", f"heal-{spec}"]) == 0
+        healed = records(capsys.readouterr().out)
+        assert all(r["cached"] for r in healed.values()), healed

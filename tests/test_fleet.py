@@ -375,3 +375,59 @@ def test_unroutable_when_every_backend_is_down(tmp_path):
         assert "unroutable" in rec["error"]
     finally:
         close_fleet(rt, gws)
+
+
+def test_fleet_shared_cache_edge_hit_reconciles(tmp_path):
+    """Fleet solve-cache tier (ISSUE 19): with a shared ``--cache-dir``
+    the router serves a repeat request entirely at the edge — placement
+    ``fleet-cache``, zero backend dispatch — billed as the pseudo-
+    backend ``_edge`` so fleet ``/v1/usage`` totals remain an exact sum
+    of their parts, with the hit on metrics and the snapshot."""
+    cache_dir = tmp_path / "solve-cache"
+    rt, gws = make_fleet(
+        tmp_path, 2,
+        fcfg=FleetConfig(health_interval_s=0.3,
+                         cache_dir=str(cache_dir)),
+        cache=True, cache_dir=str(cache_dir))
+    try:
+        kw = dict(n=24, ntime=48, dtype="float64", ic="hat", bc="edges")
+        st, recs, _ = post_solve(rt, line(id="c0", **kw))
+        assert st == 200 and recs[-1]["status"] == "ok"
+        assert recs[-1]["cached"] is False
+        # the serving backend's async writeback publishes the entry
+        assert wait_until(lambda: list(cache_dir.glob("*.npz")))
+
+        st, recs, _ = post_solve(rt, line(id="c1", **kw))
+        (rec,) = [r for r in recs if r.get("id") == "c1"]
+        assert st == 200 and rec["status"] == "ok"
+        assert rec["cached"] is True
+        assert rec["placement"] == "fleet-cache"
+        assert rec["exit"] == "cached"
+        assert rec["usage"]["steps"] == 0
+        assert rec["usage"]["lane_s"] == 0.0
+        assert rec["usage"]["steps_saved"] == 48
+
+        # edge billing rides the pseudo-backend and the sums reconcile
+        _, usage = get_json(rt, "/v1/usage")
+        assert "_edge" in usage["per_backend"]
+        assert usage["per_backend"]["_edge"]["totals"]["cached"] == 1
+        assert usage["totals"]["requests"] == 2
+        assert usage["totals"]["cached"] == sum(
+            p["totals"].get("cached", 0)
+            for p in usage["per_backend"].values())
+        assert usage["totals"]["steps"] == sum(
+            p["totals"]["steps"]
+            for p in usage["per_backend"].values())
+
+        snap = rt.snapshot()
+        assert snap["router"]["cache_edge_hits"] == 1
+        assert snap["cache"] is not None
+        assert snap["cache"]["readonly"] is True
+        metrics = render_fleet_metrics(rt)
+        assert "heat_tpu_fleet_cache_edge_hits_total 1" in metrics
+        assert "heat_tpu_fleet_cache_entries" in metrics
+        # the edge hit is delivered exactly once and replayable by id
+        st, rec2 = get_json(rt, "/v1/requests/c1")
+        assert st == 200 and rec2["placement"] == "fleet-cache"
+    finally:
+        close_fleet(rt, gws)

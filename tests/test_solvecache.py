@@ -1,0 +1,634 @@
+"""Two-level solve cache (ISSUE 19): content-addressed result
+memoization at the admission door plus prefix reuse of deeper runs.
+
+The load-bearing contracts:
+
+- a **full hit** short-circuits ``Engine.submit`` — zero device chunk
+  programs dispatch, the published npz is byte-identical to the cold
+  solve's, and billing is ``cached`` (zero lane-seconds/steps, the full
+  ``ntime`` counted as ``steps_saved``) — reconciling exactly across
+  records, the per-tenant ledger, and the summary counters;
+- a **prefix hit** admits through the normal lane path seeded from the
+  cached frontier and steps exactly ``ntime - cached_step``, at
+  dispatch depths 0 and 2, byte-identical to the cold run;
+- the cache key is the canonical **physics fingerprint** only —
+  tenant / SLO class / deadline / request id / key order never split
+  entries (billing stays per-tenant regardless);
+- ``--cache off`` (the default) consults nothing, creates nothing, and
+  serves bit-identically to builds without the cache;
+- a corrupt or stale entry is quarantined to ``*.corrupt`` with a
+  structured record and NEVER served.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from heat_tpu.backends import solve
+from heat_tpu.config import HeatConfig, config_from_request
+from heat_tpu.runtime import faults
+from heat_tpu.runtime.checkpoint import config_fingerprint
+from heat_tpu.serve import Engine, ServeConfig, SolveCache
+from heat_tpu.serve import engine as engine_mod
+from heat_tpu.serve.engine import LaneEngine
+from heat_tpu.serve.gateway import render_metrics, render_statusz, \
+    status_payload
+from heat_tpu.serve.solvecache import _parse_entry, entry_name
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def quiet(**kw) -> ServeConfig:
+    kw.setdefault("emit_records", False)
+    return ServeConfig(**kw)
+
+
+def cached_cfg(tmp_path, **kw) -> ServeConfig:
+    kw.setdefault("lanes", 2)
+    kw.setdefault("chunk", 8)
+    kw.setdefault("buckets", (16, 32))
+    kw.setdefault("cache", True)
+    kw.setdefault("cache_dir", str(tmp_path / "solve-cache"))
+    kw.setdefault("out_dir", str(tmp_path / "out"))
+    return quiet(**kw)
+
+
+CFG = HeatConfig(n=16, ntime=40, dtype="float64", bc="edges", ic="hat")
+OTHER = HeatConfig(n=16, ntime=40, dtype="float64", bc="ghost", ic="hat")
+
+
+def drain(eng, *submits):
+    ids = [eng.submit(c) if isinstance(c, HeatConfig)
+           else eng.submit(**c) for c in submits]
+    recs = {r["id"]: r for r in eng.results()}
+    return ids, recs
+
+
+# --- SolveCache unit behavior ------------------------------------------------
+
+
+def test_entry_name_parse_roundtrip():
+    fp = "a" * 16
+    assert entry_name(fp, 40) == f"{fp}-00000040.npz"
+    from pathlib import Path
+    assert _parse_entry(Path(entry_name(fp, 40))) == (fp, 40)
+    assert _parse_entry(Path("garbage.npz")) is None
+    assert _parse_entry(Path(f"{fp}-notanum.npz")) is None
+
+
+def test_put_then_lookup_full_hit(tmp_path):
+    c = SolveCache(str(tmp_path / "c"))
+    T = solve(CFG).T
+    p = c.put(CFG, CFG.ntime, T=T)
+    assert p is not None and p.exists()
+    assert p.with_suffix(".json").exists()
+    hit = c.lookup(CFG)
+    assert hit is not None and hit["kind"] == "full"
+    assert hit["step"] == CFG.ntime
+    got, step = SolveCache.load(hit["path"])
+    assert step == CFG.ntime
+    np.testing.assert_array_equal(got, T)
+    s = c.stats()
+    assert s["hits_full"] == 1 and s["misses"] == 0 and s["puts"] == 1
+
+
+def test_lookup_prefers_deepest_usable_prefix(tmp_path):
+    c = SolveCache(str(tmp_path / "c"))
+    for step in (8, 24):
+        c.put(CFG, step, T=solve(CFG.with_(ntime=step)).T)
+    # an entry DEEPER than the request must never be offered as a prefix
+    c.put(CFG, 48, T=solve(CFG.with_(ntime=48)).T)
+    hit = c.lookup(CFG)   # ntime=40
+    assert hit["kind"] == "prefix" and hit["step"] == 24
+
+
+def test_lookup_miss_on_different_physics(tmp_path):
+    c = SolveCache(str(tmp_path / "c"))
+    c.put(CFG, CFG.ntime, T=solve(CFG).T)
+    assert c.lookup(OTHER) is None
+    assert c.stats()["misses"] == 1
+
+
+def test_put_first_write_wins(tmp_path):
+    c = SolveCache(str(tmp_path / "c"))
+    T = solve(CFG).T
+    p1 = c.put(CFG, CFG.ntime, T=T)
+    before = p1.read_bytes()
+    p2 = c.put(CFG, CFG.ntime, T=np.zeros_like(T))
+    assert p1 == p2 and p1.read_bytes() == before
+    assert c.stats()["puts"] == 1
+
+
+def test_corrupt_entry_quarantined_not_served(tmp_path, capfd):
+    c = SolveCache(str(tmp_path / "c"))
+    p = c.put(CFG, CFG.ntime, T=solve(CFG).T)
+    data = bytearray(p.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    p.write_bytes(bytes(data))
+    assert c.lookup(CFG) is None
+    assert not p.exists()
+    corrupts = list((tmp_path / "c").glob("*.corrupt"))
+    assert len(corrupts) == 2   # npz + sidecar, both renamed
+    assert c.stats()["quarantined"] == 1
+    out = capfd.readouterr().out
+    rec = next(json.loads(ln) for ln in out.splitlines()
+               if '"cache_quarantined"' in ln)
+    assert "hash mismatch" in rec["reason"]
+
+
+def test_stale_sidecar_fingerprint_quarantined(tmp_path, capfd):
+    c = SolveCache(str(tmp_path / "c"))
+    p = c.put(CFG, CFG.ntime, T=solve(CFG).T)
+    meta_p = p.with_suffix(".json")
+    meta = json.loads(meta_p.read_text())
+    meta["fingerprint"] = "0" * 16
+    meta_p.write_text(json.dumps(meta))
+    assert c.lookup(CFG) is None
+    assert c.stats()["quarantined"] == 1
+    out = capfd.readouterr().out
+    rec = next(json.loads(ln) for ln in out.splitlines()
+               if '"cache_quarantined"' in ln)
+    assert "fingerprint" in rec["reason"]
+
+
+def test_lru_eviction_honors_max_bytes_and_hit_recency(tmp_path):
+    c = SolveCache(str(tmp_path / "c"))
+    import os
+    import time
+    cfgs = [CFG.with_(sigma=0.1 + 0.05 * i) for i in range(3)]
+    paths = []
+    for i, cf in enumerate(cfgs):
+        paths.append(c.put(cf, cf.ntime, T=solve(cf).T))
+        # distinct mtimes so LRU order is deterministic on coarse clocks
+        t = time.time() - 100 + i
+        os.utime(paths[-1], (t, t))
+    one_entry = paths[0].stat().st_size + \
+        paths[0].with_suffix(".json").stat().st_size
+    # a hit on the OLDEST entry touches it; the budget then evicts the
+    # two least-recently-used (cfgs[1], cfgs[2]) — not the one just hit
+    assert c.lookup(cfgs[0])["kind"] == "full"
+    c.max_bytes = one_entry
+    c._evict()
+    assert c.lookup(cfgs[0]) is not None
+    assert c.lookup(cfgs[1]) is None and c.lookup(cfgs[2]) is None
+    assert c.stats()["evictions"] == 2
+    assert c.bytes_total() <= one_entry
+
+
+def test_readonly_cache_never_writes(tmp_path):
+    d = tmp_path / "never-created"
+    ro = SolveCache(str(d), readonly=True)
+    assert ro.put(CFG, CFG.ntime, T=solve(CFG).T) is None
+    assert ro.lookup(CFG) is None
+    assert not d.exists()
+    # a corrupt entry in a real dir is skipped WITHOUT renaming
+    rw = SolveCache(str(tmp_path / "c"))
+    p = rw.put(CFG, CFG.ntime, T=solve(CFG).T)
+    p.write_bytes(b"garbage")
+    ro2 = SolveCache(str(tmp_path / "c"), readonly=True)
+    assert ro2.lookup(CFG) is None
+    assert p.exists()   # untouched: quarantine is the owners' job
+
+
+def test_negative_cache_max_bytes_rejected():
+    with pytest.raises(ValueError, match="cache_max_bytes"):
+        ServeConfig(cache_max_bytes=-1)
+
+
+# --- fingerprint canonicalization (satellite: key invariance) ---------------
+
+
+def test_fingerprint_excludes_step_count():
+    assert config_fingerprint(CFG) == config_fingerprint(
+        CFG.with_(ntime=999))
+
+
+def test_fingerprint_key_order_invariant():
+    a = config_from_request({"n": 16, "ntime": 40, "sigma": 0.2,
+                             "bc": "edges", "ic": "hat",
+                             "dtype": "float64"})
+    b = config_from_request({"dtype": "float64", "ic": "hat",
+                             "bc": "edges", "sigma": 0.2, "ntime": 40,
+                             "n": 16})
+    assert config_fingerprint(a) == config_fingerprint(b)
+
+
+def test_fingerprint_splits_on_every_physics_field():
+    base = config_fingerprint(CFG)
+    for variant in (CFG.with_(n=17), CFG.with_(sigma=0.19),
+                    CFG.with_(nu=0.9), CFG.with_(bc="ghost"),
+                    CFG.with_(ic="uniform"), CFG.with_(dtype="float32")):
+        assert config_fingerprint(variant) != base
+
+
+def test_scheduler_keys_never_split_the_cache(tmp_path):
+    """tenant / class / deadline / request id are billing metadata, not
+    physics: a request from tenant B full-hits tenant A's entry — while
+    billing still lands per tenant."""
+    scfg = cached_cfg(tmp_path)
+    eng = Engine(scfg)
+    eng.submit(CFG, tenant="alice", slo_class="standard")
+    eng.results()
+    eng2 = Engine(scfg)
+    rid = eng2.submit(CFG, request_id="custom-id-7", tenant="bob",
+                      slo_class="batch", deadline_ms=60000.0)
+    rec = {r["id"]: r for r in eng2.results()}[rid]
+    assert rec["cached"] is True and rec["status"] == "ok"
+    snap = eng2.prof.ledger.snapshot()
+    assert snap["tenants"]["bob"]["classes"]["batch"]["cached"] == 1
+    assert "alice" not in snap["tenants"]
+
+
+# --- full hit: byte identity + zero dispatch --------------------------------
+
+
+def test_full_hit_byte_identical_zero_dispatch(tmp_path):
+    """Acceptance: the warm engine dispatches ZERO chunk programs for a
+    full hit and the replayed npz is byte-identical to the cold one."""
+    scfg = cached_cfg(tmp_path)
+    cold = Engine(scfg)
+    (cold_id,), cold_recs = drain(cold, CFG)
+    cold_bytes = (tmp_path / "out" / f"{cold_id}.npz").read_bytes()
+
+    events = []
+    real_fetch, real_dispatch = engine_mod.host_fetch, \
+        LaneEngine.dispatch_chunk
+
+    def spy_fetch(x):
+        events.append("fetch")
+        return real_fetch(x)
+
+    def spy_dispatch(self, k=None):
+        events.append("dispatch")
+        return real_dispatch(self, k)
+
+    warm = Engine(scfg)
+    try:
+        engine_mod.host_fetch = spy_fetch
+        LaneEngine.dispatch_chunk = spy_dispatch
+        (hit_id,), recs = drain(warm, CFG)
+    finally:
+        engine_mod.host_fetch = real_fetch
+        LaneEngine.dispatch_chunk = real_dispatch
+    rec = recs[hit_id]
+    assert rec["status"] == "ok" and rec["cached"] is True
+    assert rec["exit"] == "cached" and rec["steps_done"] == CFG.ntime
+    assert events == []   # no dispatch, no fetch: the device never ran
+    assert warm.chunks_dispatched == 0
+    warm_bytes = (tmp_path / "out" / f"{hit_id}.npz").read_bytes()
+    assert warm_bytes == cold_bytes
+    u = rec["usage"]
+    assert u == {"lane_s": 0.0, "steps": 0, "chunks": 0,
+                 "bytes_written": len(warm_bytes),
+                 "steps_saved": CFG.ntime, "cached": True}
+
+
+def test_full_hit_reconciles_records_ledger_summary(tmp_path):
+    scfg = cached_cfg(tmp_path)
+    e1 = Engine(scfg)
+    e1.submit(CFG)
+    e1.results()
+    e2 = Engine(scfg)
+    ids, recs = drain(e2, CFG, OTHER)
+    cached = [r for r in recs.values() if r["cached"]]
+    assert len(cached) == 1
+    snap = e2.prof.ledger.snapshot()
+    t = snap["totals"]
+    assert t["cached"] == 1 and t["requests"] == 2
+    # ledger sums == record sums, field by field
+    for f in ("lane_s", "steps", "chunks", "bytes_written",
+              "steps_saved"):
+        assert t[f] == round(sum(r["usage"][f] for r in recs.values()), 9)
+    s = e2.summary()
+    assert s["cache"]["hits_full"] == 1 and s["cache"]["misses"] == 1
+    assert s["steps_saved"] >= CFG.ntime
+
+
+# --- prefix hit: exact delta at depths 0 and 2 ------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_prefix_hit_steps_exact_delta_and_bytes(tmp_path, depth):
+    """A request whose fingerprint matches a cached entry at a smaller
+    step count steps exactly ``ntime - cached_step`` and lands
+    byte-identical to the cold run — at dispatch depths 0 and 2."""
+    out = tmp_path / f"d{depth}"
+    scfg = cached_cfg(out, dispatch_depth=depth)
+    short = CFG.with_(ntime=24)
+    e1 = Engine(scfg)
+    drain(e1, short)
+
+    cold = Engine(quiet(lanes=2, chunk=8, buckets=(16, 32),
+                        dispatch_depth=depth,
+                        out_dir=str(out / "cold")))
+    (cold_id,), _ = drain(cold, CFG)
+
+    e2 = Engine(scfg)
+    (rid,), recs = drain(e2, CFG)
+    rec = recs[rid]
+    assert rec["status"] == "ok" and rec["cached"] is False
+    assert rec["steps_done"] == CFG.ntime
+    u = rec["usage"]
+    assert u["steps"] == CFG.ntime - short.ntime
+    assert u["steps_saved"] == short.ntime and u["cached"] is False
+    assert e2.summary()["cache"]["hits_prefix"] == 1
+    assert ((out / "out" / f"{rid}.npz").read_bytes()
+            == (out / "cold" / f"{cold_id}.npz").read_bytes())
+
+
+def test_prefix_zero_delta_is_a_full_hit_not_a_restore(tmp_path):
+    """ntime == cached step is the degenerate prefix: it must take the
+    full-hit path (no lane at all), never a zero-step restore."""
+    scfg = cached_cfg(tmp_path)
+    e1 = Engine(scfg)
+    drain(e1, CFG)
+    e2 = Engine(scfg)
+    (rid,), recs = drain(e2, CFG)
+    assert recs[rid]["cached"] is True
+    assert e2.summary()["cache"]["hits_full"] == 1
+    assert e2.summary()["cache"]["hits_prefix"] == 0
+
+
+# --- placements: pallas packed + mega ---------------------------------------
+
+
+def test_cache_hits_across_lane_kernels(tmp_path):
+    """The cache key is physics, not placement: an entry populated by
+    the xla kernel full-hits under --serve-lane-kernel pallas (and the
+    replayed bytes are the xla run's — determinism makes them equal)."""
+    xla = Engine(cached_cfg(tmp_path, lane_kernel="xla"))
+    drain(xla, CFG)
+    pallas = Engine(cached_cfg(tmp_path, lane_kernel="pallas"))
+    (rid,), recs = drain(pallas, CFG)
+    assert recs[rid]["cached"] is True
+    assert pallas.chunks_dispatched == 0
+
+
+def test_mega_placement_full_hit_short_circuits(tmp_path):
+    """A bucket-overflow (mega) request is admitted at the same door:
+    the second identical mega request never compiles or dispatches."""
+    big = HeatConfig(n=24, ntime=16, dtype="float64", bc="edges",
+                     ic="hat")
+    scfg = cached_cfg(tmp_path, buckets=(16,), mega_lanes=1)
+    e1 = Engine(scfg)
+    (_,), recs1 = drain(e1, big)
+    assert next(iter(recs1.values()))["placement"] == "mega"
+    e2 = Engine(scfg)
+    (rid,), recs2 = drain(e2, big)
+    rec = recs2[rid]
+    assert rec["cached"] is True and rec["placement"] == "mega"
+    assert e2.mega_compiles == 0 and e2.chunks_dispatched == 0
+
+
+def test_mega_prefix_hit_steps_delta(tmp_path):
+    big_short = HeatConfig(n=24, ntime=8, dtype="float64", bc="edges",
+                           ic="hat")
+    scfg = cached_cfg(tmp_path, buckets=(16,), mega_lanes=1)
+    drain(Engine(scfg), big_short)
+    e2 = Engine(scfg)
+    (rid,), recs = drain(e2, big_short.with_(ntime=24))
+    rec = recs[rid]
+    assert rec["status"] == "ok" and rec["placement"] == "mega"
+    assert rec["usage"]["steps"] == 16
+    assert rec["usage"]["steps_saved"] == 8
+    np.testing.assert_array_equal(
+        SolveCache.load(e2.solvecache.lookup(
+            big_short.with_(ntime=24))["path"])[0],
+        solve(big_short.with_(ntime=24)).T)
+
+
+# --- co-lane independence ----------------------------------------------------
+
+
+def test_co_lane_hit_and_miss_are_independent(tmp_path):
+    """One batch, one cached physics and one cold: the hit never
+    occupies a lane, the miss solves normally, both come back right."""
+    scfg = cached_cfg(tmp_path, lanes=1)   # 1 lane: a hit that wrongly
+    # took a lane would serialize behind the miss and still pass — but
+    # chunks_dispatched pins the proof below
+    drain(Engine(scfg), CFG)
+    eng = Engine(scfg)
+    ids, recs = drain(eng, CFG, OTHER)
+    hit, miss = recs[ids[0]], recs[ids[1]]
+    assert hit["cached"] is True and hit["lane"] is None
+    assert miss["cached"] is False and miss["status"] == "ok"
+    with np.load(miss["path"]) as z:
+        np.testing.assert_array_equal(z["T"], solve(OTHER).T)
+    # the hit added zero chunks: every dispatched chunk was the miss's
+    assert eng.summary()["cache"]["hits_full"] == 1
+
+
+# --- cache off: bit-identical to pre-cache builds ---------------------------
+
+
+def test_cache_off_is_default_and_inert(tmp_path):
+    scfg = quiet(lanes=2, buckets=(16,), out_dir=str(tmp_path / "o"))
+    assert scfg.cache is False
+    eng = Engine(scfg)
+    (rid,), recs = drain(eng, CFG)
+    assert eng.solvecache is None
+    assert recs[rid]["cached"] is False
+    assert not (tmp_path / "o" / "solve-cache").exists()
+    assert eng.summary()["cache"] is None
+    # same request twice: BOTH solve (no memoization without --cache)
+    eng2 = Engine(scfg)
+    drain(eng2, CFG)
+    assert eng2.chunks_dispatched > 0
+
+
+def test_cache_off_bytes_match_cache_on_bytes(tmp_path):
+    """--cache on must not perturb the solve itself: cold-run bytes are
+    identical with and without the cache enabled."""
+    off = Engine(quiet(lanes=1, buckets=(16,),
+                       out_dir=str(tmp_path / "off")))
+    (a,), _ = drain(off, CFG)
+    on = Engine(cached_cfg(tmp_path / "on", lanes=1, buckets=(16,)))
+    (b,), _ = drain(on, CFG)
+    assert ((tmp_path / "off" / f"{a}.npz").read_bytes()
+            == (tmp_path / "on" / "out" / f"{b}.npz").read_bytes())
+
+
+# --- until=steady interplay --------------------------------------------------
+
+
+STEADY_CFG = HeatConfig(n=12, ntime=160, dtype="float64", bc="edges",
+                        ic="sine")
+
+
+def test_steady_exit_caches_under_actual_step(tmp_path):
+    """A steady early exit publishes its entry at the EXIT step, not the
+    requested ntime — so a later fixed-step request prefix-hits the real
+    frontier (and an ntime == exit-step request full-hits it)."""
+    scfg = cached_cfg(tmp_path, buckets=(16,))
+    eng = Engine(scfg)
+    sid = eng.submit(STEADY_CFG, until="steady", tol=2e-3)
+    rec = {r["id"]: r for r in eng.results()}[sid]
+    exit_step = rec["steps_done"]
+    assert 0 < exit_step < STEADY_CFG.ntime
+    hit = eng.solvecache.lookup(STEADY_CFG.with_(ntime=exit_step))
+    assert hit is not None and hit["kind"] == "full"
+    assert hit["step"] == exit_step
+    e2 = Engine(scfg)
+    rid = e2.submit(STEADY_CFG.with_(ntime=exit_step + 8))
+    rec2 = {r["id"]: r for r in e2.results()}[rid]
+    assert rec2["status"] == "ok" and rec2["usage"]["steps"] == 8
+
+
+def test_steady_requests_never_consume_the_cache(tmp_path):
+    """until=steady must re-run (its exit step depends on live
+    residuals): a cached fixed-step entry is not consulted for it."""
+    scfg = cached_cfg(tmp_path, buckets=(16,))
+    drain(Engine(scfg), STEADY_CFG)
+    e2 = Engine(scfg)
+    sid = e2.submit(STEADY_CFG, until="steady", tol=2e-3)
+    rec = {r["id"]: r for r in e2.results()}[sid]
+    assert rec["cached"] is False and rec["exit"] == "steady"
+    assert e2.summary()["cache"]["hits_full"] == 0
+    assert e2.summary()["cache"]["consults"] == 0
+
+
+# --- engine-checkpoint snapshots feed the prefix store ----------------------
+
+
+def test_engine_ckpt_snapshot_becomes_prefix_entry(tmp_path):
+    """Chunk-boundary lane snapshots written by --engine-ckpt-interval
+    double as cache entries: a shorter identical-physics request
+    full-hits the snapshot cut instead of recomputing."""
+    long_cfg = HeatConfig(n=16, ntime=40, dtype="float64", bc="edges",
+                          ic="hat", sigma=0.21)
+    scfg = cached_cfg(tmp_path, lanes=1, engine_ckpt_interval=1,
+                      engine_ckpt_dir=str(tmp_path / "ck"))
+    eng = Engine(scfg)
+    drain(eng, long_cfg)
+    fp = config_fingerprint(long_cfg)
+    entries = sorted(int(_parse_entry(p)[1]) for p in
+                     (tmp_path / "solve-cache").glob(f"{fp}-*.npz"))
+    # at least one mid-run snapshot landed below the final result
+    assert entries[-1] == long_cfg.ntime and len(entries) >= 2
+    snap_step = entries[0]
+    assert 0 < snap_step < long_cfg.ntime
+    e2 = Engine(scfg)
+    (rid,), recs = drain(e2, long_cfg.with_(ntime=snap_step))
+    rec = recs[rid]
+    assert rec["cached"] is True
+    np.testing.assert_array_equal(
+        SolveCache.load(tmp_path / "solve-cache"
+                        / entry_name(fp, snap_step))[0],
+        solve(long_cfg.with_(ntime=snap_step)).T)
+
+
+# --- observability surfaces --------------------------------------------------
+
+
+def test_metrics_statusz_status_payload_surfaces(tmp_path):
+    scfg = cached_cfg(tmp_path)
+    drain(Engine(scfg), CFG)
+    eng = Engine(scfg)
+    drain(eng, CFG)
+    m = render_metrics(eng)
+    assert 'heat_tpu_cache_hits_total{kind="full"} 1' in m
+    assert 'heat_tpu_cache_hits_total{kind="prefix"} 0' in m
+    assert "heat_tpu_cache_misses_total 0" in m
+    assert ('heat_tpu_usage_cached_total{tenant="default",'
+            'class="standard"} 1') in m
+    sz = render_statusz(eng)
+    assert "solve cache: 1 full / 0 prefix hit(s)" in sz
+    sp = status_payload(eng)
+    assert sp["cache"]["hits_full"] == 1
+    off = Engine(quiet(lanes=1))
+    assert status_payload(off)["cache"] is None
+    assert "heat_tpu_cache_hits_total" in render_metrics(off)
+
+
+def test_chaos_kinds_registered():
+    plan = faults.plan_for_spec("cache-corrupt@2")
+    assert plan is not None
+    plan2 = faults.plan_for_spec("cache-stale")
+    assert plan2 is not None
+    with pytest.raises(ValueError):
+        faults.plan_for_spec("cache-bogus")
+
+
+def test_injected_cache_corrupt_quarantines_and_recomputes(tmp_path,
+                                                           capfd):
+    """The cache-corrupt fault flips bytes in the entry at consult time:
+    the engine must quarantine it, recompute, and still serve ok."""
+    import dataclasses
+    scfg = cached_cfg(tmp_path)
+    drain(Engine(scfg), CFG)
+    bad = dataclasses.replace(scfg, inject="cache-corrupt")
+    eng = Engine(bad)
+    (rid,), recs = drain(eng, CFG)
+    rec = recs[rid]
+    assert rec["status"] == "ok" and rec["cached"] is False
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "out" / f"{rid}.npz")["T"], solve(CFG).T)
+    assert eng.summary()["cache"]["quarantined"] == 1
+    assert list((tmp_path / "solve-cache").glob("*.corrupt"))
+    out = capfd.readouterr().out
+    assert '"cache_quarantined"' in out
+
+
+def test_injected_cache_stale_never_serves_wrong_entry(tmp_path):
+    import dataclasses
+    scfg = cached_cfg(tmp_path)
+    drain(Engine(scfg), CFG)
+    bad = dataclasses.replace(scfg, inject="cache-stale")
+    eng = Engine(bad)
+    (rid,), recs = drain(eng, CFG)
+    assert recs[rid]["status"] == "ok" and recs[rid]["cached"] is False
+    assert eng.summary()["cache"]["quarantined"] == 1
+
+
+# --- fleet tier --------------------------------------------------------------
+
+
+def test_merge_usage_carries_cached_field():
+    from heat_tpu.fleet.router import merge_usage
+
+    a = {"tenants": {"t": {"classes": {"standard": {
+        "lane_s": 1.0, "steps": 10, "chunks": 2, "bytes_written": 100,
+        "steps_saved": 0, "cached": 0, "requests": 1}}}},
+        "totals": {"lane_s": 1.0, "steps": 10, "chunks": 2,
+                   "bytes_written": 100, "steps_saved": 0, "cached": 0,
+                   "requests": 1}}
+    b = {"tenants": {"t": {"classes": {"standard": {
+        "lane_s": 0.0, "steps": 0, "chunks": 0, "bytes_written": 100,
+        "steps_saved": 10, "cached": 1, "requests": 1}}}},
+        "totals": {"lane_s": 0.0, "steps": 0, "chunks": 0,
+                   "bytes_written": 100, "steps_saved": 10, "cached": 1,
+                   "requests": 1}}
+    merged = merge_usage({"b0": a, "_edge": b})
+    assert merged["totals"]["cached"] == 1
+    assert merged["totals"]["requests"] == 2
+    cls = merged["tenants"]["t"]["classes"]["standard"]
+    assert cls["cached"] == 1 and cls["steps_saved"] == 10
+
+
+def test_placement_prefer_narrows_only_when_eligible():
+    from heat_tpu.fleet import placement
+
+    class B:
+        def __init__(self, name, healthy=True):
+            self.name = name
+            self.healthy = healthy
+            self.fault_down = False
+            self.lost = False
+            self.status = None
+            self.pending_requests = 0
+
+    b0, b1 = B("b0"), B("b1")
+    chosen, d = placement.choose("round-robin", [b0, b1], None, 0,
+                                 prefer={"b1"})
+    assert chosen is b1 and d.get("preferred") is True
+    # an unhealthy preferred backend never wins on preference alone
+    b1.healthy = False
+    chosen, d = placement.choose("round-robin", [b0, b1], None, 0,
+                                 prefer={"b1"})
+    assert chosen is b0 and "preferred" not in d
