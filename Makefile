@@ -10,7 +10,7 @@ SHELL := /bin/bash
         overlap-ab compile-bisect topology-schedule topology-validate \
         serve-lab serve-chaos-lab frontend-lab trace-lab prof-lab \
         numerics-lab steady-lab lane-lab mega-lab resume-lab fleet-lab \
-        cache-lab perfcheck native run viz clean
+        resilience-lab cache-lab perfcheck native run viz clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -159,6 +159,13 @@ fleet-lab:             # pod-scale fleet: 1/2/4 serve subprocesses behind
                        # requests, forced checkpoint-handoff steal with
                        # recovery overhead recorded
 	env JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_lab.py
+
+resilience-lab:        # fleet resilience drills: flapping backend (breaker
+                       # + canary re-admission, availability >= 0.99, p99
+                       # <= 1.5x, zero steal thrash), mid-stream cut with
+                       # exactly-once re-drive, hedged interactive tail,
+                       # deadline shedding with zero billed device steps
+	env JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_resilience_lab.py
 
 cache-lab:             # solve-cache A/B: repeat-heavy wave cold vs warm
                        # (warm >= 5x, full hits byte-identical + zero

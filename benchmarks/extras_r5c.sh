@@ -177,6 +177,15 @@ phase serve_resume_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/serve_resume
 # /drainz?handoff=1 steal with its recovery wall recorded. CPU-world:
 # runs with the tunnel down.
 phase fleet_lab        1200 env JAX_PLATFORMS=cpu python benchmarks/fleet_lab.py
+# Fleet resilience lab (ISSUE 20): chaos drills against the router's
+# resilience layer — a flapping backend (circuit breaker opens, sine
+# canary re-admits through the router path, availability >= 0.99, p99
+# <= 1.5x healthy, zero flap-induced steal thrash), a mid-stream relay
+# cut re-driven exactly-once (zero lost / zero duplicated rows), a
+# hedged interactive row winning on the idle backend bit-identically,
+# and expired edge-minted deadlines shed with zero billed device
+# steps. CPU-world: runs with the tunnel down.
+phase fleet_resilience_lab 1200 env JAX_PLATFORMS=cpu python benchmarks/fleet_resilience_lab.py
 # Solve-cache A/B (ISSUE 19): a repeat-heavy 32-request wave cold vs
 # warm against one shared cache dir — warm wave >= 5x cold with every
 # request a full hit (zero device chunk programs, zero billed steps,

@@ -480,7 +480,42 @@ def build_parser() -> argparse.ArgumentParser:
                             "target at the Nth forwarded request "
                             "(K names a backend; default = whichever "
                             "was chosen); backend-slow:ms=M sleeps "
-                            "every forward M ms")
+                            "every forward M ms; "
+                            "backend-flap:period=MS[:backend=K] square-"
+                            "waves the target down/up per half-period; "
+                            "stream-cut@N[:backend=K] breaks the relay "
+                            "stream after N records while the backend "
+                            "stays alive; "
+                            "backend-partition[:ms=M][:backend=K] makes "
+                            "every connect hang M ms then time out")
+    fleet.add_argument("--breaker-trip", dest="breaker_trip", type=int,
+                       default=3, metavar="N",
+                       help="consecutive relay/probe errors that open a "
+                            "backend's circuit breaker (default 3); an "
+                            "open breaker excludes the backend from "
+                            "placement and stealing until the sine "
+                            "canary passes through the router path")
+    fleet.add_argument("--breaker-cooldown", dest="breaker_cooldown",
+                       type=float, default=5.0, metavar="S",
+                       help="seconds an open breaker waits before its "
+                            "half-open canary (default 5; doubles on "
+                            "every failed canary, capped at 120)")
+    fleet.add_argument("--retry-budget", dest="retry_budget",
+                       type=float, default=20.0, metavar="TOKENS",
+                       help="fleet-wide retry token bucket size "
+                            "(default 20): each batch re-placement "
+                            "spends one token, each delivered success "
+                            "refills 0.2 — a dry bucket sheds instead "
+                            "of amplifying overload")
+    fleet.add_argument("--hedge-factor", dest="hedge_factor",
+                       type=float, default=0.0, metavar="F",
+                       help="tail-latency hedging for the interactive "
+                            "class: duplicate a row onto a second "
+                            "breaker-closed backend once it has waited "
+                            "F x its predicted service time (+0.75s "
+                            "floor); first terminal record wins, the "
+                            "loser is cancelled at its next chunk "
+                            "boundary (default 0 = off)")
     fleet.add_argument("--trace", metavar="FILE",
                        help="export the ROUTER's event ring at drain: "
                             "forward spans + synthesized backend solve "
@@ -1122,6 +1157,10 @@ def cmd_fleet(args) -> int:
                            ckpt_root=args.ckpt_root,
                            cache_dir=args.fleet_cache_dir,
                            inject=args.inject or "",
+                           breaker_trip=args.breaker_trip,
+                           breaker_cooldown_s=args.breaker_cooldown,
+                           retry_budget_cap=args.retry_budget,
+                           hedge_factor=args.hedge_factor,
                            trace_buffer=trace_cap)
         registry = BackendRegistry(backends,
                                    backends_file=args.backends_file)
@@ -1165,6 +1204,16 @@ def cmd_fleet(args) -> int:
         master_print(f"fleet: solve cache — {r['cache_edge_hits']} edge "
                      f"hit(s), {r['cache_prefix_hints']} prefix "
                      f"placement hint(s)")
+    hd = r["hedges"]
+    if (r["deadline_shed"] or r["brownout_shed"] or r["stream_cuts"]
+            or hd["fired"] or r["retry_budget"]["denied"]):
+        master_print(f"fleet: resilience — {r['deadline_shed']} "
+                     f"deadline-shed, {r['brownout_shed']} brownout-"
+                     f"shed, {r['stream_cuts']} stream cut(s) "
+                     f"re-driven, {hd['fired']} hedge(s) fired "
+                     f"({hd['won']} won, {hd['cancelled']} cancelled), "
+                     f"{r['retry_budget']['denied']} retr(ies) denied "
+                     f"by the budget")
     if args.json:
         print(json.dumps({"event": "fleet_summary", **r}, sort_keys=True))
     rt.close()
@@ -1355,7 +1404,17 @@ def cmd_perfcheck(args) -> int:
               ("kill_zero_lost", lambda v: v is True),
               ("kill_zero_duplicates", lambda v: v is True),
               ("steal_recovered_requests", lambda v: (v or 0) >= 1),
-              ("steal_recovery_s", lambda v: v is not None)))):
+              ("steal_recovery_s", lambda v: v is not None))),
+            ("fleet_resilience_lab.json",
+             (("flap_availability", lambda v: (v or 0) >= 0.99),
+              ("flap_p99_ratio", lambda v: v is not None and v <= 1.5),
+              ("flap_bit_identical", lambda v: v is True),
+              ("cut_zero_lost", lambda v: v is True),
+              ("cut_zero_duplicates", lambda v: v is True),
+              ("hedges_won", lambda v: (v or 0) >= 1),
+              ("hedge_bit_identical", lambda v: v is True),
+              ("deadline_shed_exact", lambda v: v is True),
+              ("breaker_steals_suppressed", lambda v: v is True)))):
         p = bdir / fname
         if not p.exists():
             check(False, fname, "committed artifact missing")
@@ -2277,6 +2336,26 @@ def cmd_info(_args) -> int:
           f"(--steal-threshold S; /drainz?handoff=1 -> POST /v1/resume "
           f"on the idlest backend, bit-identical); gate "
           f"benchmarks/fleet_lab.json")
+
+    # fleet resilience (ISSUE 20): circuit breakers, deadline
+    # propagation, hedged relay, brownout shedding
+    from .fleet.resilience import Breaker as _Brk
+    from .fleet.router import FleetConfig as _FCfg
+
+    _fc = _FCfg()
+    print(f"fleet resilience: per-backend circuit breakers (trip after "
+          f"{_fc.breaker_trip} errors or {_fc.breaker_burn_ticks} burn "
+          f"ticks, cooldown {_fc.breaker_cooldown_s:g}s doubling to "
+          f"{_Brk.COOLDOWN_MAX_S:g}s; half-open re-admission via the "
+          f"sine canary through the router path), retry budget "
+          f"{_fc.retry_budget_cap:g} tokens +{_fc.retry_budget_ratio:g}"
+          f"/success with jittered backoff (base "
+          f"{_fc.retry_backoff_s:g}s), X-Deadline-Ms propagation "
+          f"(edge-minted, decremented per hop; expired rows shed with "
+          f"zero device steps), --hedge-factor F interactive hedging "
+          f"(floor {_fc.hedge_floor_s:g}s, loser cancelled via POST "
+          f"/v1/cancel), brownout sheds batch then standard when every "
+          f"backend burns; gate benchmarks/fleet_resilience_lab.json")
 
     # invariant guard (ISSUE 11): the static-analysis suite's static
     # half — rule families, committed schema registry population, and

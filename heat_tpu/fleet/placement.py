@@ -92,6 +92,32 @@ def burn_demoted(status: Optional[dict],
     return False
 
 
+def brownout_level(backends: List) -> int:
+    """Fleet-wide brownout level for edge shedding (router dispatch).
+
+    0 — some eligible backend is not burn-demoted: normal placement
+    (demotion steers work away from the burning replicas) handles it.
+    1 — EVERY eligible backend's fast AND slow burn windows fire: the
+    edge sheds ``batch`` rows with Retry-After instead of placing them
+    anyway (the old all-demoted passthrough behaviour for that class).
+    2 — additionally the worst fast burn is at double threshold: shed
+    ``standard`` too. ``interactive`` is never shed by brownout.
+
+    Pure function of the backend snapshots so tests feed fake status
+    payloads and assert the ladder directly."""
+    cands = [b for b in backends
+             if b.healthy and not b.fault_down and not b.lost]
+    if not cands or not all(burn_demoted(b.status) for b in cands):
+        return 0
+    worst = 0.0
+    for b in cands:
+        for w in ((b.status or {}).get("slo_burn") or {}).values():
+            fast = w.get("fast_burn")
+            if fast is not None:
+                worst = max(worst, float(fast))
+    return 2 if worst >= 2 * BURN_THRESHOLD else 1
+
+
 def can_serve(backend, n: Optional[int]) -> bool:
     """Capability filter: can this backend serve a side-``n`` request?
     Oversized-for-its-buckets requests need mega capability. A backend

@@ -176,18 +176,20 @@ class BackendRegistry:
                 b.status_t = now
             return (was, b.healthy)
 
-    def set_fault_down(self, name: str) -> Optional[Backend]:
-        """backend-down chaos: drop the TCP target — every future
-        connect to it fails as if the host vanished.  ``healthy`` is
-        left for the next probe round to flip: the router must DISCOVER
-        the loss the way it would a real one (probe fails -> was/is
-        transition -> flight dump + recovery), not be told by the drill.
-        Placement never routes here meanwhile — ``eligible`` checks
-        ``fault_down`` itself."""
+    def set_fault_down(self, name: str,
+                       down: bool = True) -> Optional[Backend]:
+        """backend-down / backend-flap chaos: drop the TCP target —
+        every future connect to it fails as if the host vanished
+        (``down=False`` restores it: the flap's up half-period).
+        ``healthy`` is left for the next probe round to flip: the
+        router must DISCOVER the loss the way it would a real one
+        (probe fails -> was/is transition -> flight dump + recovery),
+        not be told by the drill. Placement never routes here meanwhile
+        — ``eligible`` checks ``fault_down`` itself."""
         with self._lock:
             b = self._backends.get(name)
             if b is not None:
-                b.fault_down = True
+                b.fault_down = down
             return b
 
     def mark_lost(self, name: str) -> None:
@@ -196,6 +198,19 @@ class BackendRegistry:
             if b is not None:
                 b.lost = True
                 b.healthy = False
+
+    def mark_found(self, name: str) -> None:
+        """Re-admit a lost backend (half-open canary passed through the
+        router path): clear ``lost`` so placement and stealing see it
+        again. The next probe round re-establishes ``healthy``; we set
+        it optimistically here so the canary's verdict takes effect
+        before the next tick."""
+        with self._lock:
+            b = self._backends.get(name)
+            if b is not None:
+                b.lost = False
+                b.healthy = not b.fault_down and not b.draining
+                b.consecutive_failures = 0
 
     # --- router-local accounting -----------------------------------------
     def note_routed(self, name: str, requests: int, steps: int) -> None:

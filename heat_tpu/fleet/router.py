@@ -72,7 +72,7 @@ from ..runtime.logging import json_record, master_print
 from ..serve.api import parse_request_obj
 from ..serve.gateway import MAX_BODY_BYTES, _TRACE_ID_RE
 from ..serve.scheduler import TERMINAL_STATUSES
-from . import placement
+from . import placement, resilience
 from .registry import BackendRegistry
 
 
@@ -111,6 +111,29 @@ class FleetConfig:
     flightrec_dir: str = "."        # backend-loss flight dumps land here
     trace_buffer: int = trace_mod.DEFAULT_BUFFER
     quiet: bool = True
+    # --- resilience layer (fleet/resilience.py) ---------------------------
+    breaker_trip: int = 3           # consecutive errors that open the
+                                    # per-backend circuit breaker
+    breaker_cooldown_s: float = 5.0  # open -> half-open wait (doubles on
+                                    # every failed canary, capped)
+    breaker_burn_ticks: int = 8     # consecutive burn-demoted health
+                                    # ticks that open the breaker
+    retry_budget_cap: float = 20.0  # fleet retry-token bucket size
+    retry_budget_ratio: float = 0.2  # tokens refilled per delivered
+                                    # success (SRE retry budget: retries
+                                    # capped as a fraction of successes)
+    retry_backoff_s: float = 0.05   # base of the jittered exponential
+                                    # backoff between re-placements
+    hedge_factor: float = 0.0       # hedge an interactive row once it
+                                    # waited factor x predicted service
+                                    # time (0 = hedging off)
+    hedge_floor_s: float = 0.75     # minimum wait before any hedge (a
+                                    # cold predictor must not duplicate
+                                    # every row)
+    cut_redrive_wait_s: float = 3.0  # after a mid-stream cut against a
+                                    # LIVE backend: how long to poll it
+                                    # for terminal records before
+                                    # re-dispatching elsewhere
 
 
 class Router:
@@ -143,11 +166,18 @@ class Router:
             self.solvecache = SolveCache(self.fcfg.cache_dir,
                                          readonly=True)
         self._lock = debug.make_lock("fleet:router")
+        # retry budget + per-backend breakers are self-locked at the
+        # same fleet rank: their METHODS are only ever called while
+        # holding no other fleet lock (the dict get-or-create below is
+        # the one thing the router lock guards)
+        self._budget = resilience.RetryBudget(self.fcfg.retry_budget_cap,
+                                              self.fcfg.retry_budget_ratio)
         # --- under self._lock -------------------------------------------
         self._requests: Dict[str, dict] = {}   # rid -> routing state
         self._live_relays: Dict[str, set] = {}  # backend -> open responses
         self._recovering: Set[str] = set()     # backends mid-recovery/steal
         self._steals: List[dict] = []          # steal event log (statusz)
+        self._breakers: Dict[str, resilience.Breaker] = {}
         self._forwards = 0                     # chaos counter (backend-down@N)
         self._rr = 0                           # round-robin tiebreak clock
         self._duplicates = 0
@@ -156,8 +186,14 @@ class Router:
         self._cache_prefix_hints = 0
         self._retries = 0
         self._lost = 0
+        self._deadline_shed = 0
+        self._brownout_shed = 0
+        self._stream_cuts = 0
+        self._hedges = {"fired": 0, "won": 0, "lost": 0, "cancelled": 0}
+        self._canary_seq = 0
         self._draining = False
         self._last_steal_t = 0.0
+        self._last_breaker_transition_t = 0.0
         # -----------------------------------------------------------------
         self.httpd = ThreadingHTTPServer((host, port), _FleetHandler)
         self.httpd.daemon_threads = True
@@ -169,7 +205,7 @@ class Router:
         debug.instrument_races(
             self, label="Router",
             exempt=frozenset({"registry", "httpd", "tracer", "fcfg",
-                              "solvecache", "_edge_ledger"}))
+                              "solvecache", "_edge_ledger", "_budget"}))
 
     @property
     def address(self) -> str:
@@ -213,6 +249,15 @@ class Router:
         if backend.fault_down:
             raise ConnectionRefusedError(
                 f"injected backend-down: {backend.name}")
+        if self._plan is not None:
+            ms = self._plan.backend_partition_ms(backend.name)
+            if ms is not None:
+                # backend-partition chaos: the host is alive but the
+                # network to it black-holes — every connect hangs for
+                # the partition latency, then times out
+                time.sleep(ms / 1e3)
+                raise TimeoutError(
+                    f"injected backend-partition: {backend.name}")
         host, _, port = backend.address.rpartition(":")
         return http.client.HTTPConnection(host, int(port), timeout=timeout)
 
@@ -259,7 +304,14 @@ class Router:
                   "q": client_q, "t0": now, "trace_id": trace_id,
                   "cfg": row.cfg, "until": row.until,
                   "tenant": row.tenant or "default",
-                  "class": row.slo_class or "standard"}
+                  "class": row.slo_class or "standard",
+                  # edge-minted deadline: the monotonic instant this
+                  # row's budget expires; decremented per hop/retry via
+                  # X-Deadline-Ms so no backend starts expired work
+                  "deadline_t": (now + row.deadline_ms / 1e3
+                                 if row.deadline_ms else None),
+                  "hedged": False, "hedge_backend": None,
+                  "dispatch_t": None, "expect_s": None}
             with self._lock:
                 if row.id in self._requests:
                     self._edge_rejected += 1
@@ -276,8 +328,11 @@ class Router:
         return immediate, states
 
     def _choose(self, n: Optional[int], exclude: Set[str], prefer=None):
+        # an OPEN breaker excludes its backend from placement outright;
+        # half-open admits exactly the canary, which bypasses _choose
+        blocked = self._breaker_blocked()
         backends = [b for b in self.registry.snapshot()
-                    if b.name not in exclude]
+                    if b.name not in exclude and b.name not in blocked]
         with self._lock:
             self._rr += 1
             rr = self._rr
@@ -377,10 +432,18 @@ class Router:
         batches: Dict[str, List[dict]] = {}
         addr: Dict[str, str] = {}
         states = self._consult_cache(states)
+        now = time.monotonic()
+        level = placement.brownout_level(self.registry.snapshot())
         for st in states:
             with self._lock:
                 tried = set(st["tried"])
                 prefer_cached = st.get("prefer_cached", False)
+                dt = st["deadline_t"]
+            if dt is not None and now > dt:
+                self._shed_deadline(st, "placement")
+                continue
+            if level and self._shed_brownout(st, level):
+                continue
             b, decision = self._choose(
                 st["n"], tried,
                 prefer=self._cache_backends() if prefer_cached else None)
@@ -395,8 +458,16 @@ class Router:
                     self._reject_unroutable(st, "no-backend-after-fault")
                     continue
                 b = b2
+            # the hedge trigger's expectation: predicted queue wait plus
+            # this row's own service time on the chosen backend — an
+            # advisory read of registry-guarded fields, so it stays a
+            # bare read OUTSIDE the router lock (registry.snapshot doc)
+            expect = (placement.predicted_backlog_s(b)
+                      + st["steps"] * placement.s_per_lane_step(b.status))
             with self._lock:
                 st["backend"] = b.name
+                st["dispatch_t"] = time.monotonic()
+                st["expect_s"] = expect
             if self.tracer.enabled:
                 self.tracer.instant(
                     "placed", self.tracer.track("fleet router", "placement"),
@@ -429,17 +500,50 @@ class Router:
             for st in sts:
                 self._reject_unroutable(st, f"backend {name} vanished")
             return
+        # deadline propagation: rewrite each row's budget to what is
+        # LEFT of the edge-minted one (hops and retries ate the rest),
+        # shedding rows that arrive at this hop already spent
+        now = time.monotonic()
+        live, expired = [], []
+        min_remaining_ms: Optional[float] = None
+        with self._lock:
+            for st in sts:
+                dt = st["deadline_t"]
+                if dt is None:
+                    live.append(st)
+                    continue
+                remaining_ms = (dt - now) * 1e3
+                if remaining_ms < 1.0:
+                    expired.append(st)
+                    continue
+                st["line"] = dict(st["line"],
+                                  deadline_ms=round(remaining_ms, 3))
+                live.append(st)
+                min_remaining_ms = (remaining_ms
+                                    if min_remaining_ms is None
+                                    else min(min_remaining_ms,
+                                             remaining_ms))
+        if expired:
+            self.registry.note_unrouted(name, len(expired),
+                                        sum(s["steps"] for s in expired))
+            for st in expired:
+                self._shed_deadline(st, f"relay to {name}")
+        sts = live
+        if not sts:
+            return
         body = ("\n".join(json.dumps(st["line"], sort_keys=True)
                           for st in sts) + "\n").encode()
+        headers = {"Content-Type": "application/x-ndjson",
+                   "X-Trace-Id": sts[0]["trace_id"]}
+        if min_remaining_ms is not None:
+            headers["X-Deadline-Ms"] = f"{min_remaining_ms:.3f}"
         tr = self.tracer
         fwd_track = (tr.track(f"backend {name}", "forward")
                      if tr.enabled else None)
         t0 = time.perf_counter()
         try:
             conn = self._conn(b, self.fcfg.stream_timeout_s)
-            conn.request("POST", "/v1/solve", body=body,
-                         headers={"Content-Type": "application/x-ndjson",
-                                  "X-Trace-Id": sts[0]["trace_id"]})
+            conn.request("POST", "/v1/solve", body=body, headers=headers)
             resp = conn.getresponse()
         except (OSError, http.client.HTTPException) as e:
             self._retry_batch(name, sts, f"connect: {type(e).__name__}: {e}")
@@ -451,6 +555,16 @@ class Router:
             except (OSError, http.client.HTTPException):
                 pass
             conn.close()
+            if resp.status == 504:
+                # the backend judged the propagated deadline spent
+                # before admission: terminal, not retryable — more hops
+                # only burn more of a budget that is already gone
+                self.registry.note_unrouted(name, len(sts),
+                                            sum(s["steps"]
+                                                for s in sts))
+                for st in sts:
+                    self._shed_deadline(st, f"backend {name} admission")
+                return
             # 503 = draining, 429 = every line shed, anything else =
             # it never streamed: none of these admitted the work
             self._retry_batch(name, sts, reason,
@@ -462,6 +576,7 @@ class Router:
         with self._lock:
             self._live_relays.setdefault(name, set()).add(resp)
         broke = False
+        nrecords = 0
         try:
             while True:
                 line = resp.readline()
@@ -477,6 +592,16 @@ class Router:
                 rid = rec.get("id")
                 if rid is not None:
                     self._deliver(rid, rec, backend=name)
+                    nrecords += 1
+                if (self._plan is not None
+                        and self._plan.stream_cut_fire(name, nrecords)):
+                    # stream-cut chaos: the relay connection dies after
+                    # N records while the backend stays healthy — the
+                    # hardened exactly-once re-drive path below
+                    json_record("fleet_stream_cut", backend=name,
+                                after=nrecords)
+                    broke = True
+                    break
         except (OSError, ValueError, http.client.HTTPException,
                 AttributeError):
             # AttributeError: http.client's buffered reader races
@@ -497,10 +622,19 @@ class Router:
                        if not st["delivered"] and st["backend"] == name]
             recovering = name in self._recovering
         if missing and not recovering:
-            # stream ended without every record: the backend died (or
-            # was dropped by chaos) mid-batch — checkpoint recovery
-            self._recover_backend(
-                name, "relay-" + ("broke" if broke else "eof"))
+            # stream ended without every record. If the backend still
+            # answers /healthz the CONNECTION died, not the backend
+            # (stream-cut chaos, a proxy hiccup): its admitted rows are
+            # still computing there, so take the bounded re-drive path.
+            # Only a genuinely dead backend pays for checkpoint
+            # recovery.
+            why = "relay-" + ("broke" if broke else "eof")
+            if self._backend_alive(name):
+                with self._lock:
+                    self._stream_cuts += 1
+                self._redrive_after_cut(name, missing, why)
+            else:
+                self._recover_backend(name, why)
 
     def _retry_batch(self, name: str, sts: List[dict], why: str,
                      overloaded: bool = False) -> None:
@@ -509,13 +643,38 @@ class Router:
         self.registry.note_retry(name)
         self.registry.note_unrouted(name, len(sts),
                                     sum(s["steps"] for s in sts))
+        if not overloaded:
+            # a 429 is a LOAD signal, not a backend fault: the retry
+            # budget handles it; breakers only trip on real errors
+            self._breaker_event(
+                name, self._breaker(name).note_error(why,
+                                                     time.monotonic()),
+                why)
         with self._lock:
             self._retries += 1
             for st in sts:
                 st["tried"].append(name)
                 st["backend"] = None
+            hops = max(len(st["tried"]) for st in sts)
         json_record("fleet_retry", backend=name, requests=len(sts),
                     why=why)
+        if not self._budget.take():
+            # SRE retry budget: retries are capped as a fraction of
+            # successes — a dry bucket means the fleet is amplifying
+            # its own overload, so shed instead of re-dispatching
+            json_record("fleet_retry_budget_exhausted", backend=name,
+                        requests=len(sts))
+            for st in sts:
+                self._deliver(st["id"],
+                              {"id": st["id"], "status": "rejected",
+                               "error": "overloaded: fleet retry "
+                                        "budget exhausted; retry "
+                                        "later"}, backend=None)
+            return
+        # jittered exponential backoff before re-placement (full
+        # jitter decorrelates a retry herd without coordination)
+        time.sleep(resilience.backoff_s(hops - 1,
+                                        self.fcfg.retry_backoff_s))
         # registry snapshot BEFORE taking the router lock: both locks
         # rank "fleet" and same-rank locks must never nest
         alive = {b.name for b in self.registry.snapshot()
@@ -548,6 +707,349 @@ class Router:
             except OSError:
                 pass
 
+    # --- resilience: breakers, canaries, shedding, hedging ----------------
+    def _breaker(self, name: str) -> resilience.Breaker:
+        """Get-or-create the per-backend breaker. Only the dict op is
+        under the router lock — Breaker methods self-lock at the same
+        fleet rank, so callers invoke them after release."""
+        with self._lock:
+            br = self._breakers.get(name)
+            if br is None:
+                br = resilience.Breaker(
+                    name, trip_threshold=self.fcfg.breaker_trip,
+                    cooldown_s=self.fcfg.breaker_cooldown_s,
+                    burn_trip_ticks=self.fcfg.breaker_burn_ticks)
+                self._breakers[name] = br
+        return br
+
+    def _breaker_blocked(self) -> Set[str]:
+        """Backends whose breaker refuses new placements right now."""
+        with self._lock:
+            brs = list(self._breakers.values())
+        return {br.backend for br in brs if not br.allows()}
+
+    def _breaker_event(self, name: str, new_state: Optional[str],
+                       reason: str) -> None:
+        """Record a breaker transition (None = the feed didn't trip
+        anything): structured record, trace instant, and the timestamp
+        the steal loop's thrash guard keys on."""
+        if new_state is None:
+            return
+        with self._lock:
+            self._last_breaker_transition_t = time.monotonic()
+        json_record("fleet_breaker_transition", backend=name,
+                    state=new_state, reason=reason)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                f"breaker {new_state}",
+                self.tracer.track("fleet router", "resilience"),
+                cat="fleet", args={"backend": name, "reason": reason})
+        master_print(f"fleet: breaker[{name}] -> {new_state} ({reason})")
+
+    def _canary_sweep(self, now: float) -> None:
+        """Move cooled-down open breakers to half-open and launch one
+        router-path canary each (the breaker holds the single slot)."""
+        with self._lock:
+            brs = list(self._breakers.values())
+        for br in brs:
+            if br.try_half_open(now):
+                self._breaker_event(br.backend, resilience.HALF_OPEN,
+                                    "cooldown-elapsed")
+                threading.Thread(
+                    target=self._run_canary, args=(br.backend,),
+                    daemon=True,
+                    name=f"heat-tpu-fleet-canary-{br.backend}").start()
+
+    def _run_canary(self, name: str) -> None:
+        """Half-open re-admission: run the sine canary THROUGH the
+        router's forward path against the suspect backend and verify
+        the returned field against the closed-form answer. /healthz
+        alone is not enough — a backend that answers health checks but
+        serves wrong bytes stays out. A pass closes the breaker AND
+        clears ``lost`` (mark_found); a failure doubles the cooldown."""
+        b = self.registry.get(name)
+        ok = (b is not None and not b.fault_down and not b.draining
+              and self._canary_solve(b))
+        state = self._breaker(name).canary_result(ok, time.monotonic())
+        self._breaker_event(name, state,
+                            "canary-pass" if ok else "canary-fail")
+        if ok:
+            self.registry.mark_found(name)
+            json_record("fleet_breaker_readmit", backend=name)
+
+    def _canary_solve(self, b) -> bool:
+        """One end-to-end known-answer solve against backend ``b``
+        (serve/probe.py's contract: ``_probe`` tenant, batch class,
+        field fetched back and compared in f64 max-norm)."""
+        import numpy as np
+
+        from ..serve import probe as probe_mod
+
+        with self._lock:
+            self._canary_seq += 1
+            rid = f"_breaker-canary-{b.name}-{self._canary_seq:04d}"
+        req = dict(probe_mod.DEFAULT_PROBE_REQUEST, id=rid,
+                   tenant=probe_mod.PROBE_TENANT, **{"class": "batch"})
+        try:
+            code, data = self._http(
+                b, "POST", "/v1/solve",
+                body=(json.dumps(req) + "\n").encode(),
+                headers={"Content-Type": "application/x-ndjson"},
+                timeout=self.fcfg.stream_timeout_s)
+            if code != 200:
+                return False
+            rec = None
+            for line in data.decode("utf-8", "replace").splitlines():
+                if line.strip():
+                    cand = json.loads(line)
+                    if cand.get("id") == rid:
+                        rec = cand
+            if rec is None or rec.get("status") != "ok":
+                return False
+            code, data = self._http(b, "GET",
+                                    f"/v1/requests/{rid}?field=1")
+            if code != 200:
+                return False
+            T = json.loads(data).get("T")
+            if T is None:
+                return False
+            err = float(np.max(np.abs(
+                np.asarray(T, dtype=np.float64)
+                - probe_mod.expected_probe_field(req))))
+            return err <= probe_mod.PROBE_TOL[req["dtype"]]
+        except (OSError, ValueError, KeyError,
+                http.client.HTTPException):
+            return False
+
+    def _shed_deadline(self, st: dict, where: str) -> None:
+        """Terminal ``deadline`` record minted at the edge: the row's
+        propagated budget is spent, so it never starts (zero device
+        steps billed to the tenant)."""
+        rec = {"id": st["id"], "status": "deadline",
+               "tenant": st["tenant"], "class": st["class"],
+               "error": f"deadline: edge-minted budget exhausted at "
+                        f"{where}; the request never started there "
+                        f"(zero device steps billed)"}
+        with self._lock:
+            self._deadline_shed += 1
+        json_record("fleet_deadline_shed", id=st["id"],
+                    slo_class=st["class"], where=where)
+        self._deliver(st["id"], rec, backend=None)
+
+    def _shed_brownout(self, st: dict, level: int) -> bool:
+        """Brownout degradation: when EVERY eligible backend's fast AND
+        slow burn windows fire, shed by class at the edge — batch first
+        (level 1), then standard too (level 2); interactive is never
+        shed. Replaces the old all-burn behaviour for these classes
+        (demotion disabled, work placed anyway): shedding the deferrable
+        classes gives every replica headroom to recover."""
+        cls = st["class"]
+        if cls == "interactive" or (level < 2 and cls != "batch"):
+            return False
+        rec = {"id": st["id"], "status": "rejected",
+               "tenant": st["tenant"], "class": cls,
+               "error": f"brownout: every backend is burning SLO "
+                        f"budget in both windows; {cls} admission "
+                        f"shed at the edge (level {level})",
+               "retry_after_s": self.fcfg.retry_after_s}
+        with self._lock:
+            self._brownout_shed += 1
+        json_record("fleet_brownout_shed", id=st["id"], slo_class=cls,
+                    level=level)
+        self._deliver(st["id"], rec, backend=None)
+        return True
+
+    def _backend_alive(self, name: str) -> bool:
+        """Quick liveness check for the stream-cut path: is the backend
+        still answering /healthz after its relay stream broke?"""
+        b = self.registry.get(name)
+        if b is None or b.lost or b.fault_down:
+            return False
+        try:
+            code, _ = self._http(b, "GET", "/healthz")
+        except (OSError, http.client.HTTPException):
+            return False
+        return code == 200
+
+    def _redrive_after_cut(self, name: str, missing: List[dict],
+                           why: str) -> None:
+        """Mid-stream break against a LIVE backend (stream-cut chaos, a
+        proxy hiccup): the rows were already admitted there, so poll
+        that same backend for their terminal records first — recomputing
+        elsewhere would waste device steps. Rows still unfinished after
+        the bounded wait re-dispatch on a survivor; the exactly-once
+        chokepoint keeps the client stream duplicate-free either way,
+        reconciled against any manifest adoption racing this."""
+        json_record("fleet_stream_redrive", backend=name,
+                    rows=len(missing), why=why)
+        pending = {st["id"]: st for st in missing}
+        deadline = time.monotonic() + self.fcfg.cut_redrive_wait_s
+        while pending and time.monotonic() < deadline:
+            b = self.registry.get(name)
+            if b is None or b.lost or b.fault_down:
+                break
+            for rid in sorted(pending):
+                try:
+                    code, data = self._http(b, "GET",
+                                            f"/v1/requests/{rid}")
+                except (OSError, http.client.HTTPException):
+                    break
+                if code != 200:
+                    continue
+                try:
+                    rec = json.loads(data)
+                except ValueError:
+                    continue
+                if rec.get("status") in TERMINAL_STATUSES:
+                    pending.pop(rid)
+                    self._deliver(rid, rec, backend=name)
+            if self._stop.wait(0.1):
+                break
+        leftovers = [st for st in pending.values()]
+        if not leftovers:
+            return
+        self.registry.note_unrouted(name, len(leftovers),
+                                    sum(s["steps"] for s in leftovers))
+        with self._lock:
+            for st in leftovers:
+                st["tried"].append(name)
+                st["backend"] = None
+        self.dispatch(leftovers)
+
+    def _maybe_hedge(self, now: float) -> None:
+        """Tail-latency hedging (Dean & Barroso, "The Tail at Scale"):
+        an interactive row that has waited past ``hedge_factor`` x its
+        predicted service time (+ floor) is duplicated onto a second
+        breaker-closed backend. The first terminal record wins at the
+        exactly-once chokepoint; the loser is deadline-preempted at its
+        next chunk boundary via POST /v1/cancel."""
+        with self._lock:
+            cands = [st for st in self._requests.values()
+                     if (not st["delivered"] and not st["hedged"]
+                         and st["class"] == "interactive"
+                         and st["backend"] is not None
+                         and st["dispatch_t"] is not None
+                         and now - st["dispatch_t"]
+                         > self.fcfg.hedge_factor * (st["expect_s"] or 0)
+                         + self.fcfg.hedge_floor_s)]
+        for st in cands:
+            with self._lock:
+                if st["hedged"] or st["delivered"]:
+                    continue
+                primary = st["backend"]
+                tried = set(st["tried"])
+            if primary is None:
+                continue
+            b, _ = self._choose(st["n"], tried | {primary})
+            if b is None:
+                continue   # nowhere to hedge to — the primary stands
+            with self._lock:
+                if st["hedged"] or st["delivered"]:
+                    continue
+                st["hedged"] = True
+                st["hedge_backend"] = b.name
+                self._hedges["fired"] += 1
+            json_record("fleet_hedge", id=st["id"], primary=primary,
+                        hedge=b.name)
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "hedge-fired",
+                    self.tracer.track("fleet router", "resilience"),
+                    cat="fleet", args={"id": st["id"],
+                                       "primary": primary,
+                                       "hedge": b.name})
+            self.registry.note_routed(b.name, 1, st["steps"])
+            threading.Thread(
+                target=self._hedge_relay, args=(st, b.name), daemon=True,
+                name=f"heat-tpu-fleet-hedge-{b.name}").start()
+
+    def _hedge_relay(self, st: dict, name: str) -> None:
+        """Forward the hedge twin (id suffixed ``~hedge``, reserved
+        tenant ``_hedge`` so per-backend ledgers attribute the duplicate
+        cost — the real tenant is billed once, on the primary) and
+        promote its record to the primary id iff it finishes ok; the
+        exactly-once chokepoint settles the race with the primary."""
+        rid = st["id"]
+        hid = f"{rid}~hedge"
+        with self._lock:
+            line = dict(st["line"])
+            dt = st["deadline_t"]
+            steps = st["steps"]
+        line["id"] = hid
+        line["tenant"] = "_hedge"
+        if dt is not None:
+            line["deadline_ms"] = max(1.0,
+                                      (dt - time.monotonic()) * 1e3)
+        won = False
+        b = self.registry.get(name)
+        try:
+            conn = self._conn(b, self.fcfg.stream_timeout_s)
+            conn.request(
+                "POST", "/v1/solve",
+                body=(json.dumps(line, sort_keys=True) + "\n").encode(),
+                headers={"Content-Type": "application/x-ndjson",
+                         "X-Trace-Id": st["trace_id"]})
+            resp = conn.getresponse()
+            if resp.status == 200:
+                while True:
+                    raw = resp.readline()
+                    if not raw:
+                        break
+                    raw = raw.strip()
+                    if not raw:
+                        continue
+                    try:
+                        rec = json.loads(raw)
+                    except ValueError:
+                        continue
+                    if (rec.get("id") == hid
+                            and rec.get("status") in TERMINAL_STATUSES):
+                        # only an OK twin may speak for the primary id:
+                        # a cancelled/failed hedge must never mask a
+                        # primary that is still computing
+                        if rec.get("status") == "ok":
+                            rec2 = dict(rec, id=rid,
+                                        tenant=st["tenant"], hedged=True)
+                            won = self._deliver(rid, rec2, backend=name)
+                        break
+            else:
+                resp.read()
+            conn.close()
+        except (OSError, ValueError, http.client.HTTPException):
+            pass
+        if not won:
+            # the twin lost (or never finished): reverse its pending
+            # accounting — note_done ran for the winner only
+            self.registry.note_unrouted(name, 1, steps)
+            with self._lock:
+                self._hedges["lost"] += 1
+
+    def _cancel_loser(self, rid: str, winner: str, primary: str,
+                      hedge_backend: str, steps: int) -> None:
+        """Deadline-preempt the losing side of a hedged pair at its
+        next chunk boundary (POST /v1/cancel) so it stops burning
+        device time, and settle the accounting for a hedge win."""
+        if winner == hedge_backend:
+            loser, lrid = primary, rid
+            self.registry.note_unrouted(primary, 1, steps)
+            with self._lock:
+                self._hedges["won"] += 1
+        else:
+            loser, lrid = hedge_backend, f"{rid}~hedge"
+        lb = self.registry.get(loser)
+        if lb is None:
+            return
+        try:
+            code, data = self._http(
+                lb, "POST", "/v1/cancel",
+                body=json.dumps({"id": lrid}).encode(),
+                headers={"Content-Type": "application/json"})
+            if code == 200 and json.loads(data).get("cancelled"):
+                with self._lock:
+                    self._hedges["cancelled"] += 1
+        except (OSError, ValueError, http.client.HTTPException):
+            pass
+
     # --- delivery (exactly-once) ------------------------------------------
     def _deliver(self, rid: str, rec: dict,
                  backend: Optional[str]) -> bool:
@@ -565,8 +1067,23 @@ class Router:
             st["rec"] = rec
             q = st["q"]
             steps = st["steps"]
+            hedged = st["hedged"]
+            hedge_backend = st["hedge_backend"]
+            primary = st["backend"]
         if backend is not None:
             self.registry.note_done(backend, steps)
+            self._breaker(backend).note_success()
+            if rec.get("status") == "ok":
+                self._budget.credit()
+            if hedged and hedge_backend is not None:
+                # the other side of the hedged pair is still computing:
+                # deadline-preempt it and settle the accounting (the
+                # loser's eventual record lands here as a duplicate)
+                threading.Thread(
+                    target=self._cancel_loser,
+                    args=(rid, backend, primary, hedge_backend, steps),
+                    daemon=True,
+                    name=f"heat-tpu-fleet-unhedge-{rid}").start()
         tr = self.tracer
         if tr.enabled and backend is not None:
             t1 = tr.now()
@@ -590,8 +1107,23 @@ class Router:
     def _health_tick(self) -> None:
         self.registry.refresh_file()
         now = time.monotonic()
+        if self._plan is not None:
+            # backend-flap chaos: square-wave the fault_down bit so the
+            # router DISCOVERS each edge through its own probes
+            for bname, down in self._plan.backend_flap_states(
+                    now).items():
+                fb = self.registry.get(bname)
+                if fb is None or fb.fault_down == down:
+                    continue
+                self.registry.set_fault_down(bname, down)
+                json_record("fleet_backend_flap", backend=bname,
+                            down=down)
+                if down:
+                    self._close_relays(bname)
         for b in self.registry.snapshot():
             if b.lost:
+                # re-admission goes exclusively through the breaker's
+                # half-open canary (the sweep below), never a bare probe
                 continue
             ok, draining, status = False, False, None
             if not b.fault_down:
@@ -608,6 +1140,16 @@ class Router:
                     ok = False
             was, is_now = self.registry.note_probe(
                 b.name, ok, draining=draining, status=status, now=now)
+            br = self._breaker(b.name)
+            if ok:
+                br.note_success()
+            else:
+                self._breaker_event(b.name,
+                                    br.note_error("probe", now), "probe")
+            self._breaker_event(
+                b.name,
+                br.note_burn(placement.burn_demoted(status), now),
+                "slo-burn")
             if was and not is_now and not draining:
                 # hard down transition (connect failure / 500 / chaos):
                 # recover its orphans; a 503-draining backend still
@@ -616,6 +1158,9 @@ class Router:
                     target=self._recover_backend,
                     args=(b.name, "health-probe"), daemon=True,
                     name=f"heat-tpu-fleet-recover-{b.name}").start()
+        self._canary_sweep(now)
+        if self.fcfg.hedge_factor > 0:
+            self._maybe_hedge(now)
         if self.fcfg.steal_threshold_s > 0:
             self._maybe_steal(now)
 
@@ -623,10 +1168,18 @@ class Router:
         with self._lock:
             if (self._recovering
                     or now - self._last_steal_t
-                    < self.fcfg.steal_cooldown_s):
+                    < self.fcfg.steal_cooldown_s
+                    # breaker-aware cooldown: a breaker that just moved
+                    # means the fleet is mid-incident — a steal now
+                    # would thrash against a flapping backend
+                    or (self._last_breaker_transition_t > 0
+                        and now - self._last_breaker_transition_t
+                        < self.fcfg.steal_cooldown_s)):
                 return
+        blocked = self._breaker_blocked()
         cands = [b for b in self.registry.snapshot()
-                 if b.healthy and not b.lost and not b.fault_down]
+                 if b.healthy and not b.lost and not b.fault_down
+                 and b.name not in blocked]
         if len(cands) < 2:
             return
         scores = {b.name: placement.predicted_backlog_s(b) for b in cands}
@@ -708,6 +1261,9 @@ class Router:
             self._lost += 1
         try:
             self.registry.mark_lost(name)
+            self._breaker_event(
+                name, self._breaker(name).trip("lost", time.monotonic()),
+                "lost")
             b = self.registry.get(name)
             master_print(f"fleet: backend {name} lost ({reason}) — "
                          f"recovering")
@@ -882,7 +1438,16 @@ class Router:
                       "lost": self._lost,
                       "forwards": self._forwards,
                       "draining": self._draining,
+                      "deadline_shed": self._deadline_shed,
+                      "brownout_shed": self._brownout_shed,
+                      "stream_cuts": self._stream_cuts,
+                      "hedges": dict(self._hedges),
                       "steals": list(self._steals)}
+            brs = list(self._breakers.values())
+        # breaker/budget snapshots take their own fleet-rank locks, so
+        # they are read strictly after the router lock is released
+        router["retry_budget"] = self._budget.snapshot()
+        router["breakers"] = dict(resilience.breaker_rows(brs))
         backends = {}
         for b in self.registry.snapshot():
             backends[b.name] = {
@@ -912,6 +1477,9 @@ class Router:
         return {"kind": "heat-tpu-fleet-status",
                 "policy": self.fcfg.policy,
                 "steal_threshold_s": self.fcfg.steal_threshold_s,
+                "hedge_factor": self.fcfg.hedge_factor,
+                "brownout_level": placement.brownout_level(
+                    self.registry.snapshot()),
                 "uptime_s": round(trace_mod.process_uptime_s(), 3),
                 "cache": (self.solvecache.stats()
                           if self.solvecache is not None else None),
@@ -1063,6 +1631,44 @@ def render_fleet_metrics(router: Router) -> str:
     metric("heat_tpu_fleet_flightrec_dumps_total", "counter",
            "Fleet-timeline flight dumps written on backend loss.",
            [([], router.tracer.dumps)])
+    breakers = sorted((s["router"].get("breakers") or {}).items())
+    metric("heat_tpu_fleet_breaker_state", "gauge",
+           "Per-backend circuit-breaker state (0 closed, 1 half-open, "
+           "2 open).",
+           [([("backend", n)], b["code"]) for n, b in breakers]
+           or [([], 0)])
+    metric("heat_tpu_fleet_breaker_transitions_total", "counter",
+           "Circuit-breaker state transitions, per backend.",
+           [([("backend", n)], b["transitions"]) for n, b in breakers]
+           or [([], 0)])
+    hedges = s["router"]["hedges"]
+    metric("heat_tpu_fleet_hedges_total", "counter",
+           "Hedged interactive dispatches by outcome (fired = twin "
+           "sent, won = twin's record reached the client first, lost = "
+           "twin discarded, cancelled = loser preempted mid-solve).",
+           [([("outcome", k)], v) for k, v in sorted(hedges.items())])
+    rb = s["router"]["retry_budget"]
+    metric("heat_tpu_fleet_retry_budget_remaining", "gauge",
+           "Tokens left in the fleet-wide retry budget (retries are "
+           "capped as a fraction of delivered successes).",
+           [([], round(rb["tokens"], 6))])
+    metric("heat_tpu_fleet_retry_budget_denied_total", "counter",
+           "Re-dispatches refused because the retry budget was dry "
+           "(the rows were shed instead of amplifying overload).",
+           [([], rb["denied"])])
+    metric("heat_tpu_fleet_deadline_shed_total", "counter",
+           "Rows shed because their edge-minted deadline budget was "
+           "already spent (at placement, a relay hop, or backend "
+           "admission) — they never started device work.",
+           [([], s["router"]["deadline_shed"])])
+    metric("heat_tpu_fleet_brownout_shed_total", "counter",
+           "Rows shed by class at the edge during fleet-wide brownout "
+           "(every backend burning SLO budget in both windows).",
+           [([], s["router"]["brownout_shed"])])
+    metric("heat_tpu_fleet_stream_cuts_total", "counter",
+           "Mid-stream relay breaks against a still-live backend that "
+           "took the bounded re-drive path instead of loss recovery.",
+           [([], s["router"]["stream_cuts"])])
     return "\n".join(out) + "\n"
 
 
@@ -1094,6 +1700,33 @@ def render_fleet_statusz(router: Router) -> str:
             f"{r['cache_prefix_hints']} prefix placement hint(s), "
             f"{cache['entries']} entr(ies) / "
             f"{cache['bytes'] / 2**20:.2f} MiB on disk")
+    rb = r["retry_budget"]
+    lines.append(
+        f"retry budget: {rb['tokens']:.1f}/{rb['cap']:g} tokens "
+        f"(+{rb['ratio']:g}/success; {rb['taken']} taken, "
+        f"{rb['denied']} denied) — {r['deadline_shed']} deadline-shed, "
+        f"{r['brownout_shed']} brownout-shed"
+        f"{' [BROWNOUT L' + str(s['brownout_level']) + ']' if s.get('brownout_level') else ''}, "
+        f"{r['stream_cuts']} stream cut(s) re-driven")
+    h = r["hedges"]
+    lines.append(
+        f"hedging ({'factor ' + format(s['hedge_factor'], 'g') if s.get('hedge_factor') else 'off'}): "
+        f"{h['fired']} fired, {h['won']} won, {h['lost']} lost, "
+        f"{h['cancelled']} loser(s) cancelled")
+    breakers = r.get("breakers") or {}
+    open_brs = {n: b for n, b in breakers.items()
+                if b["state"] != "closed"}
+    if open_brs:
+        lines.append(f"breakers ({len(open_brs)} not closed):")
+        for n, bs in sorted(open_brs.items()):
+            lines.append(
+                f"  {n}: {bs['state'].upper()} — "
+                f"{bs['consecutive_errors']} consecutive error(s), "
+                f"burn {bs['burn_ticks']} tick(s), cooldown "
+                f"{bs['cooldown_s']:g}s, last {bs['last_reason'] or '-'} "
+                f"({bs['transitions']} transition(s))")
+    else:
+        lines.append(f"breakers: all {len(breakers)} closed")
     lines.append(f"backends ({len(s['backends'])}; "
                  f"{r['lost']} lost so far):")
     for name, b in sorted(s["backends"].items()):

@@ -81,6 +81,25 @@ Fleet-scoped kinds (the router's chaos drills, heat_tpu/fleet/router.py
                                first (a congested/distant backend; the
                                placement policy and imbalance estimator
                                see realistic skew).
+- ``backend-flap:period=M[:backend=K][:times=T]`` — oscillate backend K
+                               (default b0) between down and up every M
+                               ms, for T down half-periods (default 1:
+                               one down pulse, then up forever). The
+                               flapping-host shape the circuit breaker
+                               exists for: without a breaker each down
+                               edge triggers recovery/steal thrash.
+- ``stream-cut@N[:backend=K]`` — kill the router's relay socket to
+                               backend K (default: whichever relay asks
+                               first) after N records have streamed back
+                               (fire-once). The mid-stream break the
+                               hardened exactly-once re-drive path must
+                               absorb with zero lost or duplicated rows.
+- ``backend-partition[:backend=K][:ms=M]`` — backend K accepts the TCP
+                               connect, then stalls M ms (default 1000)
+                               before the router sees a timeout —
+                               distinct from ``backend-down``'s
+                               connection-refused (a network partition /
+                               wedged host, not a dead one).
 
 - ``perturb@N[:req=ID][:eps=E]`` — add a bounded (finite!) perturbation
                                ``eps`` (default 1e3) to one cell of a
@@ -133,7 +152,8 @@ CRASH_RC = 43
 _KINDS = ("crash", "nan", "ckpt-corrupt", "ckpt-truncate",
           "sink-error", "sink-slow", "lane-nan", "fetch-hang", "perturb",
           "engine-kill", "ckpt-manifest-corrupt",
-          "backend-down", "backend-slow", "cache-corrupt", "cache-stale")
+          "backend-down", "backend-slow", "cache-corrupt", "cache-stale",
+          "backend-flap", "stream-cut", "backend-partition")
 
 
 @dataclasses.dataclass
@@ -150,6 +170,8 @@ class Fault:
                                 # enough to escape any envelope tolerance)
     backend: Optional[str] = None  # backend-down: named victim (None =
                                 # whichever backend the Nth forward chose)
+    period: float = 0.0         # backend-flap: half-period in ms
+    t0: Optional[float] = None  # backend-flap: epoch (first evaluation)
     fired: bool = False
 
 
@@ -201,20 +223,25 @@ def parse_spec(spec: str) -> List[Fault]:
         for kv in filter(None, tail.split(":")):
             key, eq, val = kv.partition("=")
             if not eq or key not in ("proc", "times", "ms", "restart",
-                                     "req", "eps", "backend"):
+                                     "req", "eps", "backend", "period"):
                 raise ValueError(
                     f"bad fault param {kv!r} in {entry!r}; keys are "
-                    f"proc=, times=, ms=, restart=, req=, eps=, backend=")
+                    f"proc=, times=, ms=, restart=, req=, eps=, backend=, "
+                    f"period=")
             try:
                 setattr(f, key, val if key in ("req", "backend")
-                        else float(val) if key in ("ms", "eps")
+                        else float(val) if key in ("ms", "eps", "period")
                         else int(val))
             except ValueError:
                 raise ValueError(f"bad value {val!r} for {key} in {entry!r}")
         if (f.kind in ("crash", "nan", "lane-nan", "perturb", "engine-kill",
-                       "backend-down")
+                       "backend-down", "stream-cut")
                 and f.step is None):
             raise ValueError(f"fault {entry!r} needs a step: '{f.kind}@N'")
+        if f.kind == "backend-flap" and f.period <= 0:
+            raise ValueError(
+                f"fault {entry!r} needs a half-period: "
+                f"'backend-flap:period=MS'")
         faults.append(f)
     return faults
 
@@ -332,6 +359,55 @@ class FaultPlan:
                       f"(target {f.backend or '<routed>'}, "
                       f"spec {self.spec!r})", file=sys.stderr, flush=True)
                 return f.backend or ""
+        return None
+
+    def backend_flap_states(self, now: float) -> Dict[str, bool]:
+        """Called from the router's health tick: for each live
+        backend-flap fault, is its target (default ``b0``) DOWN at wall
+        time ``now``? The epoch is stamped on the first evaluation; the
+        flap runs ``times`` down half-periods (each ``period`` ms) with
+        up half-periods between, then stays up forever — a bounded flap
+        the breaker must ride out without steal thrash. Returns
+        {backend_name: down?}; empty dict = no flap faults live."""
+        states: Dict[str, bool] = {}
+        for f in self._live("backend-flap"):
+            if f.t0 is None:
+                f.t0 = now
+            half = f.period / 1000.0
+            phase = int((now - f.t0) // half) if half > 0 else 0
+            # phases 0,2,4,... are down pulses; up in between; after
+            # `times` down pulses (phase >= 2*times - 1) up for good
+            down = phase < 2 * f.times - 1 and phase % 2 == 0
+            states[f.backend or "b0"] = down
+        return states
+
+    def stream_cut_fire(self, backend: str, nrecords: int) -> bool:
+        """Called from the relay read loop with the count of records
+        already streamed back from ``backend``: the first live
+        stream-cut fault targeting it (or untargeted) whose ``@N``
+        threshold is reached is spent (fire-once) and answers True —
+        the relay must sever its socket mid-stream."""
+        for f in self._live("stream-cut"):
+            if f.fired or (f.backend is not None and f.backend != backend):
+                continue
+            if nrecords >= f.step:
+                f.fired = True
+                print(f"fault: injected stream-cut on backend {backend} "
+                      f"after {nrecords} records (spec {self.spec!r})",
+                      file=sys.stderr, flush=True)
+                return True
+        return False
+
+    def backend_partition_ms(self, backend: str) -> Optional[float]:
+        """Called before a router->backend HTTP request: if a live
+        backend-partition fault targets ``backend`` (or is untargeted),
+        answer the stall in ms (default 1000) — the connect is accepted
+        but the response never comes, distinct from backend-down's
+        refusal. Not fire-once: a partition persists until the spec is
+        lifted."""
+        for f in self._live("backend-partition"):
+            if f.backend is None or f.backend == backend:
+                return f.ms if f.ms > 0 else 1000.0
         return None
 
     # --- checkpoint-sink faults (runtime.checkpoint.save/save_shards) ----

@@ -840,6 +840,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._solve(parts)
         elif parts.path == "/v1/resume":
             self._resume()
+        elif parts.path == "/v1/cancel":
+            self._cancel()
         else:
             self._json(404, {"error": f"no route for POST {parts.path}"})
 
@@ -897,6 +899,24 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._json(200, detail)
 
+    def _cancel(self) -> None:
+        """``POST /v1/cancel`` body ``{"id": RID}``: deadline-preempt a
+        queued or running request at its next chunk boundary (the fleet
+        router's hedged-dispatch loser cancel; see Engine.cancel).
+        ``{"cancelled": false}`` for unknown/terminal ids — cancelling
+        finished work is a no-op, not an error."""
+        body = self._read_body()
+        if body is None:
+            return
+        try:
+            rid = json.loads(body.decode("utf-8", "replace") or "{}")["id"]
+        except (ValueError, KeyError, TypeError):
+            self._json(400, {"error": "expected a JSON body "
+                                      "{\"id\": REQUEST_ID}"})
+            return
+        self._json(200, {"id": rid,
+                         "cancelled": self.gw.engine.cancel(str(rid))})
+
     # --- /v1/solve --------------------------------------------------------
     def _read_body(self) -> Optional[bytes]:
         n = self.headers.get("Content-Length")
@@ -932,6 +952,24 @@ class _Handler(BaseHTTPRequestHandler):
                                       "replica"},
                        headers=[("Retry-After", int(gw.retry_after_s))])
             return
+        # cross-host deadline propagation: the fleet edge mints the
+        # budget and decrements it per hop/retry — if it arrives here
+        # already spent, refuse to admit rather than start expired work
+        # (the row would only be shed at the first chunk boundary after
+        # burning device steps the tenant is never billed for).
+        hdr = self.headers.get("X-Deadline-Ms")
+        if hdr is not None:
+            try:
+                remaining_ms = float(hdr)
+            except ValueError:
+                self._json(400, {"error": f"bad X-Deadline-Ms {hdr!r}: "
+                                          "expected milliseconds"})
+                return
+            if remaining_ms <= 0:
+                self._json(504, {"error": "deadline: edge-minted budget "
+                                          "exhausted before this hop; "
+                                          "batch never admitted"})
+                return
         body = self._read_body()
         if body is None:
             return

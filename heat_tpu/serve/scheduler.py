@@ -615,13 +615,17 @@ class _GroupRunner:
                     # request's wait under THIS policy, id-paired so
                     # overlapping waits of one tenant render cleanly
                     policy_mod.note_pop(tr, outer.scfg.policy, req, now)
-                if req.deadline_t is not None and now > req.deadline_t:
+                cut = outer._deadline_cut(req, now)
+                if cut is not None:
                     if tr.enabled:
                         tr.instant("deadline-shed", self.group_track,
                                    trace_id=req.trace_id,
                                    args={"id": req.id}, ts=now)
                     outer._fail_request(
                         req, "deadline",
+                        "deadline: cancelled (deadline-preemption) while "
+                        "still queued (never admitted)"
+                        if cut == "cancelled" else
                         f"deadline: exceeded its "
                         f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms "
                         f"budget while still queued (never admitted)")
@@ -886,15 +890,20 @@ class _GroupRunner:
                                         steps_done=steps_done,
                                         exit_mode=exit_mode)
                 self.occupant[lane] = None
-            elif req.deadline_t is not None and now > req.deadline_t:
+            elif (cut := outer._deadline_cut(req, now)) is not None:
                 done = req.cfg.ntime - int(rem[lane])
                 self._trace_occupancy(lane, req, "deadline")
                 outer._fail_request(
                     req, "deadline",
-                    f"deadline: exceeded its "
-                    f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms budget "
-                    f"with ~{done} of {req.cfg.ntime} steps done; lane "
-                    f"{lane} preempted at the chunk boundary", lane=lane,
+                    (f"deadline: cancelled (deadline-preemption) with "
+                     f"~{done} of {req.cfg.ntime} steps done; lane "
+                     f"{lane} preempted at the chunk boundary"
+                     if cut == "cancelled" else
+                     f"deadline: exceeded its "
+                     f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms "
+                     f"budget with ~{done} of {req.cfg.ntime} steps done; "
+                     f"lane {lane} preempted at the chunk boundary"),
+                    lane=lane,
                     steps_done=done, chunks=int(self.lane_chunks[lane]))
                 outer.deadline_misses += 1
                 # the lane keeps counting down on device (masked garbage
@@ -1321,13 +1330,17 @@ class MegaLaneRunner:
             tr = self.tracer
             if tr.enabled:
                 policy_mod.note_pop(tr, outer.scfg.policy, req, now)
-            if req.deadline_t is not None and now > req.deadline_t:
+            cut = outer._deadline_cut(req, now)
+            if cut is not None:
                 if tr.enabled:
                     tr.instant("deadline-shed", self.group_track,
                                trace_id=req.trace_id,
                                args={"id": req.id}, ts=now)
                 outer._fail_request(
                     req, "deadline",
+                    "deadline: cancelled (deadline-preemption) while "
+                    "still queued (never admitted)"
+                    if cut == "cancelled" else
                     f"deadline: exceeded its "
                     f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms "
                     f"budget while still queued (never admitted)")
@@ -1490,15 +1503,19 @@ class MegaLaneRunner:
             self._handle_nonfinite(req, int(rem[0]), snap)
         elif rem[0] == 0 or self.steady_exit[0] is not None:
             self._retire(req, sync)
-        elif req.deadline_t is not None and now > req.deadline_t:
+        elif (cut := outer._deadline_cut(req, now)) is not None:
             done = req.cfg.ntime - int(rem[0])
             self._trace_occupancy(0, req, "deadline")
             outer._fail_request(
                 req, "deadline",
-                f"deadline: exceeded its "
-                f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms budget "
-                f"with ~{done} of {req.cfg.ntime} steps done; mega lane "
-                f"preempted at the chunk boundary", lane=0,
+                (f"deadline: cancelled (deadline-preemption) with ~{done} "
+                 f"of {req.cfg.ntime} steps done; mega lane preempted at "
+                 f"the chunk boundary"
+                 if cut == "cancelled" else
+                 f"deadline: exceeded its "
+                 f"{1e3 * (req.deadline_t - req.submit_t):.0f} ms budget "
+                 f"with ~{done} of {req.cfg.ntime} steps done; mega lane "
+                 f"preempted at the chunk boundary"), lane=0,
                 steps_done=done, chunks=int(self.lane_chunks[0]))
             outer.deadline_misses += 1
             self._release()
@@ -1872,6 +1889,11 @@ class Engine:
         self.lanes_quarantined = 0   # requests failed nonfinite
         self.rollbacks = 0           # per-lane restore-and-re-step events
         self.deadline_misses = 0     # requests preempted/shed past deadline
+        self._cancel_reqs: set = set()  # deadline-preemption by id
+                                     # (cancel(): hedged-dispatch loser
+                                     # cancel, POST /v1/cancel) — judged
+                                     # at the same chunk-boundary sites
+                                     # as deadline expiry
         # semantic scheduling (ISSUE 16): until=steady early retirements
         # and the device steps they did NOT run (the effective-throughput
         # multiplier the steady lab gates; /metrics + usage ledger bill
@@ -2410,6 +2432,7 @@ class Engine:
         rec = self._by_id[req.id]
         now = wall_clock()
         with self._lock:
+            self._cancel_reqs.discard(req.id)
             start = rec.pop("_start_t", None)
             base = rec.pop("_resumed_lane_s", 0.0)
             if start is not None:
@@ -2609,6 +2632,41 @@ class Engine:
                 fn(snap)
             except Exception:  # noqa: BLE001 — a broken listener must not
                 pass           # fail the request it is being told about
+
+    # --- deadline preemption by id (cancel) --------------------------------
+    def cancel(self, request_id: str) -> bool:
+        """Deadline-preemption by request id — the hedged-dispatch loser
+        cancel (fleet router) and ``POST /v1/cancel``. An unknown or
+        already-terminal id answers False; otherwise the id is marked
+        and the next chunk-boundary deadline judge preempts it with the
+        same status ``deadline`` machinery an expired budget uses (a
+        queued request is shed at pop). The lane is freed at its next
+        boundary — cancellation is cooperative, never mid-chunk."""
+        with self._lock:
+            rec = self._by_id.get(request_id)
+            if rec is None or rec["status"] in TERMINAL_STATUSES:
+                return False
+            self._cancel_reqs.add(request_id)
+            self._cond.notify_all()
+        return True
+
+    def _deadline_cut(self, req: Request, now: float) -> Optional[str]:
+        """``"expired" | "cancelled" | None`` — the one deadline verdict
+        every chunk-boundary judge asks. The unlocked emptiness test
+        keeps the no-cancellations hot path free of lock traffic; the
+        membership read is re-taken under the lock."""
+        if req.deadline_t is not None and now > req.deadline_t:
+            return "expired"
+        # benign emptiness peek: the set object is created once in
+        # __init__ and only mutated (never rebound) under the engine
+        # lock; a stale empty read just defers the cut one boundary,
+        # and the locked re-check below is authoritative
+        if not self._cancel_reqs:
+            return None
+        with self._lock:
+            if req.id in self._cancel_reqs:
+                return "cancelled"
+        return None
 
     # --- incremental consumption (poll / wait / listeners) ----------------
     def poll(self, request_id: str) -> Optional[dict]:

@@ -570,7 +570,7 @@ def test_info_reports_static_analysis_line(capsys):
     out = capsys.readouterr().out
     assert "static analysis: 6 rule families" in out
     assert "lock-order watchdog" in out
-    assert "schema registry 20 event(s)" in out
+    assert "schema registry 29 event(s)" in out
     assert "race guard: guard map" in out
     assert "race sanitizer available" in out
 

@@ -168,6 +168,85 @@ def test_restart_gate_suppresses_fired_faults(monkeypatch):
         solve(cfg.with_(inject="nan@4:restart=-1"))
 
 
+def test_parse_spec_fleet_resilience_kinds_grammar():
+    """The fleet chaos kinds (ISSUE 20): backend-flap needs period=,
+    stream-cut needs a step, backend-partition takes optional ms= and
+    backend= and nothing else."""
+    fs = faults.parse_spec(
+        "backend-flap:period=500:backend=b1:times=2,"
+        "stream-cut@3:backend=b0,stream-cut@7,"
+        "backend-partition:ms=50:backend=b2,backend-partition")
+    assert [f.kind for f in fs] == ["backend-flap", "stream-cut",
+                                    "stream-cut", "backend-partition",
+                                    "backend-partition"]
+    assert fs[0].period == 500.0 and fs[0].backend == "b1"
+    assert fs[0].times == 2
+    assert fs[1].step == 3 and fs[1].backend == "b0"
+    assert fs[2].step == 7 and fs[2].backend is None
+    assert fs[3].ms == 50.0 and fs[3].backend == "b2"
+    assert fs[4].ms == 0.0 and fs[4].backend is None
+    with pytest.raises(ValueError, match="needs a half-period"):
+        faults.parse_spec("backend-flap")
+    with pytest.raises(ValueError, match="needs a half-period"):
+        faults.parse_spec("backend-flap:period=0")
+    with pytest.raises(ValueError, match="needs a step"):
+        faults.parse_spec("stream-cut:backend=b0")
+    with pytest.raises(ValueError):
+        faults.parse_spec("backend-partition:zorp=1")
+
+
+def test_backend_flap_states_square_wave():
+    """The flap schedule is a pure function of (epoch, period, times):
+    down on even phases, up between, up forever after the last down
+    pulse. The epoch stamps on first evaluation."""
+    plan = faults.FaultPlan("backend-flap:period=100:backend=b1:times=2")
+    t0 = 1000.0
+    assert plan.backend_flap_states(t0) == {"b1": True}     # phase 0
+    assert plan.backend_flap_states(t0 + 0.15) == {"b1": False}  # phase 1
+    assert plan.backend_flap_states(t0 + 0.25) == {"b1": True}   # phase 2
+    # after 2 down pulses (phase >= 3): up forever
+    assert plan.backend_flap_states(t0 + 0.35) == {"b1": False}
+    assert plan.backend_flap_states(t0 + 99.0) == {"b1": False}
+    # default target is b0; default times=1 = single pulse
+    p2 = faults.FaultPlan("backend-flap:period=50")
+    assert p2.backend_flap_states(5.0) == {"b0": True}
+    assert p2.backend_flap_states(5.0 + 0.06) == {"b0": False}
+
+
+def test_stream_cut_fires_once_per_matching_backend():
+    plan = faults.FaultPlan("stream-cut@2:backend=b1")
+    assert not plan.stream_cut_fire("b0", 5)   # wrong target
+    assert not plan.stream_cut_fire("b1", 1)   # below threshold
+    assert plan.stream_cut_fire("b1", 2)       # fires
+    assert not plan.stream_cut_fire("b1", 3)   # spent (fire-once)
+    # untargeted: the first relay to reach the threshold takes it
+    p2 = faults.FaultPlan("stream-cut@1")
+    assert p2.stream_cut_fire("bX", 1)
+    assert not p2.stream_cut_fire("bY", 9)
+
+
+def test_backend_partition_persists_and_defaults():
+    plan = faults.FaultPlan("backend-partition:backend=b2:ms=25")
+    assert plan.backend_partition_ms("b2") == 25.0
+    assert plan.backend_partition_ms("b2") == 25.0   # NOT fire-once
+    assert plan.backend_partition_ms("b0") is None
+    # untargeted partition hits every backend; ms defaults to 1000
+    p2 = faults.FaultPlan("backend-partition")
+    assert p2.backend_partition_ms("anything") == 1000.0
+
+
+def test_fleet_kinds_stay_out_of_hot_path(monkeypatch):
+    """No spec -> no plan: the three fleet kinds are strictly opt-in
+    like every other fault (the router carries only plan-is-None
+    tests)."""
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    assert faults.plan_for_spec("") is None
+    plan = faults.plan_for_spec("backend-flap:period=100")
+    assert plan is not None
+    assert plan.backend_partition_ms("b0") is None
+    assert not plan.stream_cut_fire("b0", 100)
+
+
 # --- nan injection + --on-nan ----------------------------------------------
 
 

@@ -113,6 +113,20 @@ def line(**kw):
     return json.dumps(kw) + "\n"
 
 
+def gw_http(gw, method, path, body=None, headers=(), timeout=TIMEOUT):
+    """Direct-to-gateway HTTP (bypassing the router) for pre-loading a
+    backend and for exercising the gateway's own header contracts."""
+    import http.client
+
+    host, port = gw.address.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request(method, path, body=body, headers=dict(headers))
+    resp = conn.getresponse()
+    out = (resp.status, resp.read())
+    conn.close()
+    return out
+
+
 def wait_until(pred, timeout=TIMEOUT, interval=0.1):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -429,5 +443,262 @@ def test_fleet_shared_cache_edge_hit_reconciles(tmp_path):
         # the edge hit is delivered exactly once and replayable by id
         st, rec2 = get_json(rt, "/v1/requests/c1")
         assert st == 200 and rec2["placement"] == "fleet-cache"
+    finally:
+        close_fleet(rt, gws)
+
+
+# --- resilience layer (ISSUE 20) ---------------------------------------------
+
+
+def test_flapping_backend_breaker_opens_then_canary_readmits(tmp_path):
+    """backend-flap chaos square-waves b1's reachability: the breaker
+    opens on the down edge (trip via the lost transition), every
+    request placed during the flap still finishes ok on the survivor,
+    no steal fires while breakers are moving (flap-thrash guard), and
+    re-admission happens exclusively through the half-open sine canary
+    run THROUGH the router path (closed breaker + mark_found)."""
+    rt, gws = make_fleet(
+        tmp_path, 2,
+        fcfg=FleetConfig(health_interval_s=0.2,
+                         inject="backend-flap:period=700:backend=b1",
+                         breaker_cooldown_s=0.4,
+                         steal_threshold_s=0.001, steal_cooldown_s=2.0,
+                         flightrec_dir=str(tmp_path)),
+        buckets=(32, 64))   # the canary's known-answer solve is n=64
+    try:
+        time.sleep(0.6)   # first tick stamps the flap's t0 -> b1 down
+        body = "".join(line(id=f"f{i}", n=24, ntime=48, dtype="float64")
+                       for i in range(4))
+        st, recs, _ = post_solve(rt, body)
+        assert st == 200
+        assert {r["id"]: r["status"] for r in recs} == {
+            f"f{i}": "ok" for i in range(4)}
+        # the breaker opened on the down edge and placement excluded b1
+        snap = rt.snapshot()
+        assert snap["backends"]["b0"]["delivered"] == 4
+        assert "b1" in snap["router"]["breakers"]
+        # the flap ends (one down pulse) -> cooldown elapses -> the
+        # half-open canary solves through the router path -> closed +
+        # found again.  /healthz alone never re-admits a lost backend.
+        assert wait_until(
+            lambda: rt.snapshot()["router"]["breakers"]
+            .get("b1", {}).get("state") == "closed", timeout=30)
+        assert wait_until(
+            lambda: (lambda b: b["healthy"] and not b["lost"])(
+                rt.snapshot()["backends"]["b1"]), timeout=30)
+        snap = rt.snapshot()
+        br = snap["router"]["breakers"]["b1"]
+        assert br["transitions"] >= 3    # open -> half-open -> closed
+        # breaker-aware steal cooldown: transitions kept thrash away
+        assert snap["router"]["steals"] == []
+        metrics = render_fleet_metrics(rt)
+        assert 'heat_tpu_fleet_breaker_state{backend="b1"} 0' in metrics
+        assert 'heat_tpu_fleet_breaker_transitions_total{backend="b1"}' \
+            in metrics
+    finally:
+        close_fleet(rt, gws)
+    ref = solve(HeatConfig(n=24, ntime=48, dtype="float64")).T
+    for i in range(4):
+        with np.load(tmp_path / "g0" / f"f{i}.npz") as z:
+            np.testing.assert_array_equal(z["T"], ref)
+
+
+def test_stream_cut_redrive_is_exactly_once(tmp_path):
+    """stream-cut@2 kills the relay socket to b0 after two records have
+    streamed back while the backend itself stays healthy: the hardened
+    re-drive path polls the SAME backend for the already-admitted rows'
+    terminal records (recomputing elsewhere would waste device steps) —
+    zero rows lost, zero duplicated, bytes identical."""
+    rt, gws = make_fleet(
+        tmp_path, 2,
+        fcfg=FleetConfig(health_interval_s=0.3,
+                         inject="stream-cut@2:backend=b0",
+                         cut_redrive_wait_s=10.0))
+    try:
+        time.sleep(0.5)
+        body = "".join(line(id=f"c{i}", n=24, ntime=48, dtype="float64")
+                       for i in range(6))
+        st, recs, _ = post_solve(rt, body)
+        assert st == 200
+        assert sorted(r["id"] for r in recs) == sorted(
+            f"c{i}" for i in range(6))          # zero lost, zero duped
+        assert all(r["status"] == "ok" for r in recs), recs
+        snap = rt.snapshot()
+        assert snap["router"]["stream_cuts"] >= 1
+        assert snap["router"]["duplicates"] == 0
+        assert "heat_tpu_fleet_stream_cuts_total" \
+            in render_fleet_metrics(rt)
+    finally:
+        close_fleet(rt, gws)
+    ref = solve(HeatConfig(n=24, ntime=48, dtype="float64")).T
+    for i in range(6):
+        paths = [p for p in (tmp_path / "g0" / f"c{i}.npz",
+                             tmp_path / "g1" / f"c{i}.npz") if p.exists()]
+        assert len(paths) == 1, f"c{i}: expected exactly one npz"
+        with np.load(paths[0]) as z:
+            np.testing.assert_array_equal(z["T"], ref)
+
+
+def test_hedged_interactive_row_wins_on_idle_backend(tmp_path):
+    """Tail-latency hedging: b1 is pre-loaded OUTSIDE the router
+    (sink-slow serializes its writer), so the round-robin placement
+    (whose rotation starts at b1) sends the interactive row there and
+    it stalls.  After the hedge delay the row is duplicated onto the
+    idle alternate as tenant
+    ``_hedge``; the twin's ok record wins at the exactly-once
+    chokepoint, the client sees one ok record flagged ``hedged``, the
+    real tenant is billed once, and the twin's bytes are the direct
+    solve's bytes."""
+    rt, gws = make_fleet(
+        tmp_path, 2,
+        fcfg=FleetConfig(health_interval_s=0.15, policy="round-robin",
+                         hedge_factor=0.01, hedge_floor_s=0.3))
+    try:
+        time.sleep(0.4)
+        # 4 slow rows straight to g1: ~2.8s of serialized writer time
+        heavy = "".join(line(id=f"h{i}", n=24, ntime=96,
+                             dtype="float64", tenant="bulk",
+                             inject="sink-slow:ms=700")
+                        for i in range(4))
+        st, _ = gw_http(gws[1], "POST", "/v1/solve?wait=0",
+                        body=heavy.encode())
+        assert st == 202
+        # round-robin sends the first router request to b1 (stale view)
+        st, recs, _ = post_solve(
+            rt, line(id="i0", n=24, ntime=48, dtype="float64",
+                     tenant="acme", **{"class": "interactive"}))
+        assert st == 200
+        (rec,) = [r for r in recs if r["id"] == "i0"]
+        assert rec["status"] == "ok", rec
+        assert rec.get("hedged") is True
+        snap = rt.snapshot()
+        assert snap["router"]["hedges"]["fired"] == 1
+        assert snap["router"]["hedges"]["won"] == 1
+        # the duplicate cost is attributed to the reserved ``_hedge``
+        # tenant; the real tenant is never billed twice
+        assert wait_until(lambda: "_hedge" in rt.fleet_usage()["tenants"])
+        usage = rt.fleet_usage()
+        acme = usage["tenants"].get("acme", {"classes": {}})
+        assert acme["classes"].get("interactive",
+                                   {}).get("requests", 0) <= 1
+        assert usage["totals"]["steps"] == sum(
+            p["totals"]["steps"] for p in usage["per_backend"].values())
+        metrics = render_fleet_metrics(rt)
+        assert 'heat_tpu_fleet_hedges_total{outcome="won"} 1' in metrics
+    finally:
+        close_fleet(rt, gws)
+    # byte-identity of the hedged pair: whichever sides finished, the
+    # bytes are the unhedged solve's bytes
+    ref = solve(HeatConfig(n=24, ntime=48, dtype="float64")).T
+    paths = [p for p in (tmp_path / "g1" / "i0.npz",
+                         tmp_path / "g0" / "i0~hedge.npz") if p.exists()]
+    assert (tmp_path / "g0" / "i0~hedge.npz") in paths   # the winner
+    for p in paths:
+        with np.load(p) as z:
+            np.testing.assert_array_equal(z["T"], ref)
+
+
+def test_deadline_propagates_from_edge_to_backend(tmp_path):
+    """Cross-host deadline propagation: an expired edge-minted budget
+    sheds at placement with a structured ``deadline`` record and zero
+    backend dispatch (never billed); a gateway presented with a spent
+    ``X-Deadline-Ms`` refuses admission with 504; a live budget rides
+    the relay header end-to-end and the request completes."""
+    rt, gws = make_fleet(tmp_path, 2)
+    try:
+        time.sleep(0.4)
+        # 1 microsecond of budget is spent before dispatch runs
+        st, recs, _ = post_solve(
+            rt, line(id="d0", n=24, ntime=48, dtype="float64",
+                     tenant="t0", deadline_ms=0.001))
+        assert st == 200
+        (rec,) = recs
+        assert rec["status"] == "deadline"
+        assert "placement" in rec["error"]
+        assert "zero device steps" in rec["error"]
+        snap = rt.snapshot()
+        assert snap["router"]["deadline_shed"] == 1
+        assert sum(b["routed"] for b in snap["backends"].values()) == 0
+        # never billed: no backend ledger ever saw tenant t0
+        assert "t0" not in rt.fleet_usage()["tenants"]
+        assert "heat_tpu_fleet_deadline_shed_total 1" \
+            in render_fleet_metrics(rt)
+        # the backend's own guard: a spent propagated budget is refused
+        # before admission (the router treats this 504 as terminal)
+        st, data = gw_http(
+            gws[0], "POST", "/v1/solve",
+            body=line(id="x0", n=24, ntime=16, dtype="float64").encode(),
+            headers=[("X-Deadline-Ms", "0")])
+        assert st == 504
+        assert "deadline" in json.loads(data)["error"]
+        st, _ = gw_http(
+            gws[0], "POST", "/v1/solve",
+            body=line(id="x1", n=24, ntime=16, dtype="float64").encode(),
+            headers=[("X-Deadline-Ms", "not-a-number")])
+        assert st == 400
+        # a live budget propagates through the relay and completes
+        st, recs, _ = post_solve(
+            rt, line(id="d1", n=24, ntime=48, dtype="float64",
+                     deadline_ms=60000))
+        assert st == 200 and recs[-1]["status"] == "ok"
+    finally:
+        close_fleet(rt, gws)
+
+
+def test_brownout_sheds_batch_then_standard_never_interactive(tmp_path):
+    """Brownout degradation ladder: when EVERY backend's fast AND slow
+    burn windows fire, the edge sheds batch (level 1), then standard
+    too when the worst fast burn doubles (level 2) — interactive is
+    never shed and still places on the demoted pool."""
+    rt, gws = make_fleet(tmp_path, 2,
+                         fcfg=FleetConfig(health_interval_s=30.0))
+    try:
+        burning = {"mega": {"max_bucket": 64},
+                   "slo_burn": {"interactive": {"fast_burn": 1.4,
+                                                "slow_burn": 1.2}}}
+        for name in ("b0", "b1"):
+            rt.registry.note_probe(name, True, status=burning)
+        assert rt.snapshot()["brownout_level"] == 1
+        # level 1: batch shed with Retry-After, standard+interactive ok
+        st, recs, _ = post_solve(rt, line(id="bt0", n=24, ntime=16,
+                                          dtype="float64",
+                                          **{"class": "batch"}))
+        (rec,) = recs
+        assert rec["status"] == "rejected"
+        assert "brownout" in rec["error"] and "level 1" in rec["error"]
+        assert rec["retry_after_s"] > 0
+        st, recs, _ = post_solve(rt, line(id="sd0", n=24, ntime=16,
+                                          dtype="float64"))
+        assert recs[-1]["status"] == "ok"
+        st, recs, _ = post_solve(rt, line(id="it0", n=24, ntime=16,
+                                          dtype="float64",
+                                          **{"class": "interactive"}))
+        assert recs[-1]["status"] == "ok"
+        # worst fast burn doubles -> level 2: standard sheds too
+        worse = {"mega": {"max_bucket": 64},
+                 "slo_burn": {"interactive": {"fast_burn": 2.5,
+                                              "slow_burn": 1.2}}}
+        for name in ("b0", "b1"):
+            rt.registry.note_probe(name, True, status=worse)
+        assert rt.snapshot()["brownout_level"] == 2
+        st, recs, _ = post_solve(rt, line(id="bt1", n=24, ntime=16,
+                                          dtype="float64",
+                                          **{"class": "batch"}))
+        assert recs[0]["status"] == "rejected"
+        st, recs, _ = post_solve(rt, line(id="sd1", n=24, ntime=16,
+                                          dtype="float64"))
+        (rec,) = recs
+        assert rec["status"] == "rejected"
+        assert "level 2" in rec["error"]
+        st, recs, _ = post_solve(rt, line(id="it1", n=24, ntime=16,
+                                          dtype="float64",
+                                          **{"class": "interactive"}))
+        assert recs[-1]["status"] == "ok"   # interactive is never shed
+        snap = rt.snapshot()
+        assert snap["router"]["brownout_shed"] == 3
+        from heat_tpu.fleet.router import render_fleet_statusz
+        assert "BROWNOUT" in render_fleet_statusz(rt)
+        assert "heat_tpu_fleet_brownout_shed_total 3" \
+            in render_fleet_metrics(rt)
     finally:
         close_fleet(rt, gws)
